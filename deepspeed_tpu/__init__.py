@@ -1,0 +1,22 @@
+"""deepspeed_tpu — a TPU-native distributed training & inference framework.
+
+A from-scratch JAX/XLA/Pallas framework with the capabilities of the
+DeepSpeed reference (see SURVEY.md): config-driven engine, ZeRO-style
+sharded training over a named device mesh, pipeline/tensor/sequence/expert
+parallelism, mixed precision, offload, checkpointing, and ragged-batch
+inference.
+"""
+
+__version__ = "0.1.0"
+
+from .config import Config, load_config                     # noqa: F401
+from .comm import MeshTopology, init_distributed            # noqa: F401
+from .platform import get_platform                          # noqa: F401
+
+
+def initialize(*args, **kwargs):
+    """Build a training engine (reference: deepspeed.initialize,
+    deepspeed/__init__.py:69).  Lazy import keeps base import light."""
+    from .runtime.engine import initialize as _init
+
+    return _init(*args, **kwargs)

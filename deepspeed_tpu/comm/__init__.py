@@ -1,0 +1,16 @@
+from .mesh import (MeshTopology, AXIS_ORDER, PIPE_AXIS, DATA_AXIS, FSDP_AXIS,
+                   EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS, BATCH_AXES)
+from .collectives import (Collectives, init_distributed, get_world_size,
+                          get_rank, log_summary, lax_psum, lax_pmean,
+                          lax_all_gather, lax_reduce_scatter, lax_all_to_all,
+                          lax_ppermute)
+from .comms_logging import comms_logger, CommsLogger, calc_bw_log
+
+__all__ = [
+    "MeshTopology", "AXIS_ORDER", "PIPE_AXIS", "DATA_AXIS", "FSDP_AXIS",
+    "EXPERT_AXIS", "SEQ_AXIS", "TENSOR_AXIS", "BATCH_AXES",
+    "Collectives", "init_distributed", "get_world_size", "get_rank",
+    "log_summary", "lax_psum", "lax_pmean", "lax_all_gather",
+    "lax_reduce_scatter", "lax_all_to_all", "lax_ppermute",
+    "comms_logger", "CommsLogger", "calc_bw_log",
+]

@@ -1,0 +1,3 @@
+from .tpu import TPUPlatform, get_platform
+
+__all__ = ["TPUPlatform", "get_platform"]

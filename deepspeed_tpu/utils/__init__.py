@@ -1,0 +1,5 @@
+from .logging import logger, log_dist, warning_once
+from .timer import SynchronizedWallClockTimer, ThroughputTimer
+
+__all__ = ["logger", "log_dist", "warning_once",
+           "SynchronizedWallClockTimer", "ThroughputTimer"]

@@ -1,0 +1,51 @@
+"""Test harness configuration.
+
+The reference tests "multi-node" logic by spawning N local processes over NCCL
+loopback (tests/unit/common.py:117).  The TPU analog (SURVEY.md §4): run
+single-process with a **virtual 8-device CPU mesh** via
+``--xla_force_host_platform_device_count``, so every sharding/collective path
+compiles and executes without hardware.
+"""
+
+import os
+
+# must be set before jax import; force CPU regardless of ambient settings so
+# the suite always sees the 8-device virtual mesh
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# the environment may pin jax to a hardware platform (e.g. a tunneled TPU);
+# the config update wins over env, forcing the virtual CPU mesh for tests
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def mesh8():
+    """A data=2 × fsdp=2 × tensor=2 mesh on 8 virtual devices."""
+    from deepspeed_tpu.config import MeshConfig
+    from deepspeed_tpu.comm import MeshTopology
+
+    return MeshTopology.build(MeshConfig(data=2, fsdp=2, tensor=2))
+
+
+@pytest.fixture
+def fsdp8():
+    """A pure fsdp=8 mesh (ZeRO-style)."""
+    from deepspeed_tpu.config import MeshConfig
+    from deepspeed_tpu.comm import MeshTopology
+
+    return MeshTopology.build(MeshConfig(data=1, fsdp=8))

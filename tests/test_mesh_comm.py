@@ -1,0 +1,103 @@
+"""Mesh topology + collectives façade tests on the 8-device virtual CPU mesh
+(SURVEY.md §4 test-strategy mapping)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm import (MeshTopology, Collectives, comms_logger,
+                                calc_bw_log, DATA_AXIS, FSDP_AXIS, TENSOR_AXIS)
+from deepspeed_tpu.config import MeshConfig
+
+
+def test_mesh_infer_data_axis():
+    topo = MeshTopology.build(MeshConfig(fsdp=4))
+    assert topo.axis_sizes["fsdp"] == 4
+    assert topo.axis_sizes["data"] == 2  # inferred: 8 / 4
+    assert topo.dp_world_size == 8
+
+
+def test_mesh_explicit(mesh8):
+    assert mesh8.size(DATA_AXIS) == 2
+    assert mesh8.size(FSDP_AXIS) == 2
+    assert mesh8.size(TENSOR_AXIS) == 2
+    assert mesh8.device_count == 8
+    assert set(mesh8.active_axes()) == {"data", "fsdp", "tensor"}
+
+
+def test_mesh_bad_sizes():
+    with pytest.raises(ValueError):
+        MeshTopology.build(MeshConfig(data=3, fsdp=4))  # 12 != 8
+
+
+def test_batch_sharding(fsdp8):
+    x = jnp.arange(16.0).reshape(16, 1)
+    xs = jax.device_put(x, fsdp8.batch_sharding())
+    assert len(xs.sharding.device_set) == 8
+    np.testing.assert_allclose(np.asarray(xs), np.asarray(x))
+
+
+def test_all_reduce(fsdp8):
+    coll = Collectives(fsdp8)
+    x = jnp.ones((4, 4))
+    out = coll.all_reduce(x, axis_name=FSDP_AXIS)
+    np.testing.assert_allclose(np.asarray(out), 8 * np.ones((4, 4)))
+
+
+def test_all_gather_reduce_scatter_roundtrip(fsdp8):
+    coll = Collectives(fsdp8)
+    x = jnp.arange(64.0).reshape(32, 2)
+    xs = jax.device_put(x, fsdp8.sharding(FSDP_AXIS))
+    gathered = coll.all_gather(xs, axis_name=FSDP_AXIS)
+    np.testing.assert_allclose(np.asarray(gathered), np.asarray(x))
+    rs = coll.reduce_scatter(jnp.ones((32, 2)), axis_name=FSDP_AXIS)
+    np.testing.assert_allclose(np.asarray(rs), 8 * np.ones((32, 2)))
+
+
+def test_all_to_all(fsdp8):
+    coll = Collectives(fsdp8)
+    # [8, 8] sharded on dim 0; tiled a2a is a resharding: the global array is
+    # unchanged, the sharded dim moves from 0 to 1
+    x = jnp.arange(64.0).reshape(8, 8)
+    xs = jax.device_put(x, fsdp8.sharding(FSDP_AXIS))
+    out = coll.all_to_all(xs, axis_name=FSDP_AXIS, split_dim=1, concat_dim=0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+    from jax.sharding import PartitionSpec as P
+    assert out.sharding.spec == P(None, FSDP_AXIS)
+
+
+def test_broadcast(fsdp8):
+    coll = Collectives(fsdp8)
+    x = jnp.full((4,), 7.0)
+    out = coll.broadcast(x, axis_name=FSDP_AXIS, src=0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_comms_logger_records(fsdp8):
+    comms_logger.configure(enabled=True, verbose=False, prof_all=True)
+    comms_logger.reset()
+    coll = Collectives(fsdp8)
+    coll.all_reduce(jnp.ones((128, 128)), axis_name=FSDP_AXIS)
+    table = comms_logger.log_all(print_log=False)
+    comms_logger.configure(enabled=False)
+    assert "all_reduce" in table
+    size = 128 * 128 * 4
+    assert size in table["all_reduce"]
+    assert table["all_reduce"][size]["count"] == 1
+
+
+def test_busbw_math():
+    algbw, busbw = calc_bw_log("all_reduce", size_bytes=1 << 30, duration_s=1.0, n=8)
+    assert busbw == pytest.approx(algbw * 2 * 7 / 8)
+    algbw, busbw = calc_bw_log("all_gather", size_bytes=1 << 30, duration_s=1.0, n=8)
+    assert busbw == pytest.approx(algbw * 7 / 8)
+
+
+def test_platform():
+    from deepspeed_tpu.platform import get_platform
+
+    p = get_platform()
+    assert p.device_count() == 8
+    assert p.communication_backend_name() == "xla"
+    assert p.is_bf16_supported()
