@@ -1,0 +1,5 @@
+from .engine import (save_tree, load_tree, save_checkpoint, load_checkpoint,
+                     consolidate)
+
+__all__ = ["save_tree", "load_tree", "save_checkpoint", "load_checkpoint",
+           "consolidate"]

@@ -1,0 +1,7 @@
+from .sharding import (DEFAULT_RULES, spec_for_axes, add_fsdp_to_spec,
+                       tree_specs, infer_logical_axes, named, tree_named)
+from .zero import ZeroPolicy, shard_count
+
+__all__ = ["DEFAULT_RULES", "spec_for_axes", "add_fsdp_to_spec", "tree_specs",
+           "infer_logical_axes", "named", "tree_named", "ZeroPolicy",
+           "shard_count"]

@@ -1,0 +1,17 @@
+from .engine import Engine, TrainState, initialize
+from .optimizers import (Optimizer, build_optimizer, adam, adamw, lion, lamb,
+                         adagrad, sgd, OPTIMIZERS)
+from .lr_schedules import build_schedule, SCHEDULES
+from .loss_scaler import LossScaler, LossScaleState, all_finite
+from .runtime_utils import (global_norm, clip_by_global_norm,
+                            partition_balanced, see_memory_usage, param_count)
+
+__all__ = [
+    "Engine", "TrainState", "initialize",
+    "Optimizer", "build_optimizer", "adam", "adamw", "lion", "lamb",
+    "adagrad", "sgd", "OPTIMIZERS",
+    "build_schedule", "SCHEDULES",
+    "LossScaler", "LossScaleState", "all_finite",
+    "global_norm", "clip_by_global_norm", "partition_balanced",
+    "see_memory_usage", "param_count",
+]

@@ -1,0 +1,436 @@
+"""Training engine: the TPU-native ``DeepSpeedEngine``.
+
+Re-design of the reference engine (``runtime/engine.py:182`` —
+``DeepSpeedEngine.forward/backward/step`` :1838/:1977/:2176, optimizer
+configuration :1272, ZeRO wiring :1532) for the XLA compilation model:
+
+* forward/backward/step collapse into ONE jitted, donated train-step
+  function; gradient accumulation is a ``lax.scan`` over micro-batches
+  (the GAS boundary of engine.py:1960 becomes a scan carry), so a whole
+  optimizer step is a single device dispatch.
+* ZeRO stages are sharding specs (see ``parallel/zero.py``); the grad
+  hooks / bucketing / overlap machinery of stage_1_and_2.py &
+  stage3.py is replaced by the XLA SPMD partitioner, which emits the same
+  reduce-scatter / all-gather schedule, overlapped with compute.
+* fp16 overflow handling (CheckOverflow, dynamic loss scaler) runs inside
+  the step with ``jnp.where`` — no host sync, no global state.
+
+Public API mirrors the reference:
+
+    engine = deepspeed_tpu.initialize(loss_fn=..., params=..., config=...)
+    metrics = engine.train_batch(batch)       # one full optimizer step
+    engine.save_checkpoint(dir); engine.load_checkpoint(dir)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..comm.mesh import DATA_AXIS, FSDP_AXIS, MeshTopology
+from ..comm.collectives import init_distributed
+from ..config.config import Config, load_config
+from ..parallel.zero import ZeroPolicy
+from ..parallel import sharding as shd
+from ..utils.logging import log_dist, logger
+from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+from .loss_scaler import LossScaler, LossScaleState, all_finite
+from .lr_schedules import build_schedule, constant
+from .optimizers import Optimizer, build_optimizer
+from .runtime_utils import clip_by_global_norm, global_norm, param_count
+
+PRECISION_DTYPE = {"fp32": jnp.float32, "fp16": jnp.float16, "bf16": jnp.bfloat16}
+
+
+class TrainState(NamedTuple):
+    """Everything that persists across steps — a single donated pytree."""
+    step: jnp.ndarray          # i32 scalar (optimizer steps taken)
+    master: Any                # fp32 master params (sharded per ZeRO stage)
+    opt_state: Any             # optimizer moments (sharded like master)
+    loss_scale: LossScaleState
+    skipped: jnp.ndarray       # i32 count of overflow-skipped steps
+
+
+class Engine:
+    """TPU-native training engine (reference: DeepSpeedEngine engine.py:182)."""
+
+    def __init__(self,
+                 loss_fn: Callable,
+                 params: Any,
+                 config: Config,
+                 topology: Optional[MeshTopology] = None,
+                 param_axes: Any = None,
+                 sharding_rules: Optional[Dict] = None,
+                 eval_fn: Optional[Callable] = None,
+                 monitor=None):
+        """``loss_fn(params, batch, rng) -> loss`` or ``(loss, aux_dict)``.
+
+        ``params`` is a pytree of arrays (any dtype; cast to fp32 master).
+        ``param_axes`` is an optional matching pytree of logical-axis tuples
+        for TP sharding; absent axes mean replicate-under-TP, fsdp-by-shape.
+        """
+        self.config = config
+        init_distributed()
+        self.topology = topology or MeshTopology.build(config.mesh)
+        self.loss_fn = loss_fn
+        self.eval_fn = eval_fn
+
+        # batch-size triangulation (reference: runtime/config.py:802-884)
+        self.train_batch_size, self.micro_batch_size, self.gas = \
+            config.resolve_batch_sizes(self.topology.dp_world_size)
+
+        # precision policy
+        self.precision = config.precision
+        self.compute_dtype = PRECISION_DTYPE[self.precision]
+        self.scaler = LossScaler.from_config(config.fp16)
+
+        # ZeRO policy + shardings
+        self.param_axes = (param_axes if param_axes is not None
+                           else shd.infer_logical_axes(params))
+        self.zero = ZeroPolicy.from_config(
+            config.zero_optimization, self.topology, rules=sharding_rules)
+        self._build_shardings(params)
+
+        # optimizer + schedule (reference: _configure_basic_optimizer :1322)
+        opt_cfg = config.optimizer
+        lr = opt_cfg.params.get("lr", 1e-3)
+        if config.scheduler is not None:
+            sched_params = dict(config.scheduler.params)
+            if config.scheduler.type in ("WarmupCosineLR",):
+                sched_params.setdefault("lr", lr)
+            self.lr_schedule = build_schedule(config.scheduler.type, sched_params)
+        else:
+            self.lr_schedule = constant(lr)
+        self.optimizer: Optimizer = build_optimizer(
+            opt_cfg.type, self.lr_schedule, opt_cfg.params)
+
+        # state init (sharded via jit out_shardings → no host-side gather)
+        self.state = self._init_state(params)
+        self.global_steps = 0
+        self.global_samples = 0
+
+        self.timers = SynchronizedWallClockTimer()
+        self.tput = ThroughputTimer(batch_size=self.train_batch_size)
+        self.monitor = monitor
+        self._train_step_fn = None
+        self._eval_step_fn = None
+
+        log_dist(
+            f"Engine: {param_count(params):,} params | precision={self.precision} "
+            f"| zero_stage={self.zero.stage} | mesh={self.topology.axis_sizes} "
+            f"| batch={self.train_batch_size} (micro={self.micro_batch_size} "
+            f"x gas={self.gas} x dp={self.topology.dp_world_size})")
+
+    # ------------------------------------------------------------------
+    # sharding setup
+    # ------------------------------------------------------------------
+    def _build_shardings(self, params):
+        topo = self.topology
+        zero = self.zero
+        self.param_specs = zero.tree_param_specs(self.param_axes, params)
+        self.master_specs = zero.tree_master_specs(self.param_axes, params)
+        self.grad_specs = zero.tree_grad_specs(self.param_axes, params)
+        self.param_shardings = zero.tree_named(self.param_specs)
+        self.master_shardings = zero.tree_named(self.master_specs)
+        self.batch_sharding = topo.batch_sharding()
+        self.repl = NamedSharding(topo.mesh, P())
+
+    def _opt_state_shardings(self, opt_state, master):
+        """Optimizer moments mirror the master param sharding.
+
+        Any opt-state subtree whose structure equals the master param tree
+        (e.g. AdamState.m / .v) gets the master shardings; NamedTuple
+        wrappers are recursed into; anything else replicates."""
+        master_def = jax.tree.structure(master)
+
+        def rec(node):
+            if jax.tree.structure(node) == master_def:
+                return self.master_shardings
+            if isinstance(node, tuple) and hasattr(node, "_fields"):
+                return type(node)(*[rec(f) for f in node])
+            return jax.tree.map(lambda _: self.repl, node)
+
+        return rec(opt_state)
+
+    # ------------------------------------------------------------------
+    # state init
+    # ------------------------------------------------------------------
+    def _init_state(self, params) -> TrainState:
+        def init_fn(p):
+            master = jax.tree.map(lambda x: x.astype(jnp.float32), p)
+            opt_state = self.optimizer.init(master)
+            return master, opt_state
+
+        # discover opt-state structure via eval_shape, then jit w/ shardings
+        master_shape, opt_shape = jax.eval_shape(init_fn, params)
+        opt_shardings = self._opt_state_shardings(opt_shape, master_shape)
+        init_jit = jax.jit(init_fn, out_shardings=(self.master_shardings,
+                                                   opt_shardings))
+        master, opt_state = init_jit(params)
+        self.opt_shardings = opt_shardings
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            master=master,
+            opt_state=opt_state,
+            loss_scale=self.scaler.init(),
+            skipped=jnp.zeros((), jnp.int32))
+
+    @property
+    def state_shardings(self) -> TrainState:
+        return TrainState(
+            step=self.repl, master=self.master_shardings,
+            opt_state=self.opt_shardings,
+            loss_scale=LossScaleState(self.repl, self.repl, self.repl),
+            skipped=self.repl)
+
+    # ------------------------------------------------------------------
+    # the train step
+    # ------------------------------------------------------------------
+    def _compute_params(self, master):
+        """Cast fp32 master → compute dtype, re-shard to the compute-param
+        layout.  For ZeRO 1/2 this makes XLA all-gather in the *compute*
+        dtype (half the bytes of an fp32 gather) — the comm-pattern analog
+        of all_gather_dp_groups of fp16 shards (stage_1_and_2.py:1823)."""
+        def cast(p, spec):
+            c = p.astype(self.compute_dtype)
+            return jax.lax.with_sharding_constraint(
+                c, NamedSharding(self.topology.mesh, spec))
+        return jax.tree.map(cast, master, self.param_specs)
+
+    def _micro_loss(self, cparams, batch, rng):
+        out = self.loss_fn(cparams, batch, rng)
+        if isinstance(out, tuple):
+            loss, aux = out
+        else:
+            loss, aux = out, {}
+        return loss, aux
+
+    def _build_train_step(self):
+        gas = self.gas
+        scaler = self.scaler
+        use_scaling = self.precision == "fp16"
+        clip = self.config.gradient_clipping
+        prescale = self.config.prescale_gradients
+        predivide = self.config.gradient_predivide_factor
+
+        def grads_of_microbatch(cparams, batch, rng, scale):
+            def scaled_loss(p):
+                loss, aux = self._micro_loss(p, batch, rng)
+                return loss * scale / gas, (loss, aux)
+            (_, (loss, aux)), grads = jax.value_and_grad(
+                scaled_loss, has_aux=True)(cparams)
+            return loss, aux, grads
+
+        def train_step(state: TrainState, batch, rng):
+            scale = state.loss_scale.scale if use_scaling else jnp.float32(1.0)
+            cparams = self._compute_params(state.master)
+
+            def shard_grads(g):
+                return jax.tree.map(
+                    lambda t, spec: jax.lax.with_sharding_constraint(
+                        t, NamedSharding(self.topology.mesh, spec)),
+                    g, self.grad_specs)
+
+            if gas > 1:
+                # batch leaves have leading [gas, ...]; scan accumulates
+                # fp32 grads in the ZeRO grad layout (reduce-scattered for
+                # stage>=2) — the IPG/bucketing analog, compiler-scheduled.
+                def body(acc, xs):
+                    mb, r = xs
+                    loss, aux, g = grads_of_microbatch(cparams, mb, r, scale)
+                    g = shard_grads(jax.tree.map(
+                        lambda t: t.astype(jnp.float32), g))
+                    acc_g, acc_loss = acc
+                    acc_g = jax.tree.map(jnp.add, acc_g, g)
+                    return (acc_g, acc_loss + loss), aux
+
+                zero_g = jax.tree.map(
+                    lambda p, spec: jax.lax.with_sharding_constraint(
+                        jnp.zeros(np.shape(p), jnp.float32),
+                        NamedSharding(self.topology.mesh, spec)),
+                    cparams, self.grad_specs)
+                rngs = jax.random.split(rng, gas)
+                (grads, loss_sum), aux = jax.lax.scan(
+                    body, (zero_g, jnp.float32(0.0)), (batch, rngs))
+                loss = loss_sum / gas
+                aux = jax.tree.map(lambda a: a[-1], aux)
+            else:
+                loss, aux, grads = grads_of_microbatch(cparams, batch, rng, scale)
+                grads = shard_grads(jax.tree.map(
+                    lambda t: t.astype(jnp.float32), grads))
+
+            # unscale (+ predivide, reference: prescale_gradients)
+            denom = scale * (predivide if prescale else 1.0)
+            grads = jax.tree.map(lambda g: g / denom, grads)
+
+            finite = all_finite(grads) if use_scaling else jnp.asarray(True)
+            grads, gnorm = clip_by_global_norm(grads, clip)
+
+            # optimizer update on the (fsdp-sharded) master partition —
+            # the local-adam-on-owned-shard of stage_1_and_2.py:1823.
+            step_next = state.step + 1
+            updates, new_opt = self.optimizer.update(
+                grads, state.opt_state, state.master, step_next)
+            new_master = jax.tree.map(lambda p, u: p + u, state.master, updates)
+
+            # overflow → skip update (jnp.where keeps shapes static)
+            def sel(new, old):
+                return jax.tree.map(
+                    lambda a, b: jnp.where(finite, a, b), new, old)
+            new_master = sel(new_master, state.master)
+            new_opt = sel(new_opt, state.opt_state)
+            new_step = jnp.where(finite, step_next, state.step)
+            new_scale_state = scaler.update(state.loss_scale, ~finite)
+
+            new_state = TrainState(
+                step=new_step, master=new_master, opt_state=new_opt,
+                loss_scale=new_scale_state,
+                skipped=state.skipped + jnp.where(finite, 0, 1))
+            lr = self.lr_schedule(new_step.astype(jnp.float32))
+            metrics = {
+                "loss": loss.astype(jnp.float32),
+                "grad_norm": gnorm,
+                "lr": lr,
+                "loss_scale": state.loss_scale.scale,
+                "overflow": (~finite).astype(jnp.int32),
+                **{f"aux/{k}": v for k, v in aux.items()},
+            }
+            return new_state, metrics
+
+        state_sh = self.state_shardings
+        return jax.jit(
+            train_step,
+            in_shardings=(state_sh, None, None),
+            out_shardings=(state_sh, None),
+            donate_argnums=(0,))
+
+    # ------------------------------------------------------------------
+    # public API (reference: engine.train_batch / forward+backward+step)
+    # ------------------------------------------------------------------
+    def train_batch(self, batch, rng: Optional[jax.Array] = None) -> Dict[str, Any]:
+        """Run one full optimizer step (forward+backward+step fused).
+
+        ``batch``: pytree of arrays with leading dim ``gas * micro`` (host-
+        local view is fine under multi-host; see ``shard_batch``); with
+        gas>1, leaves are reshaped to [gas, micro, ...] for the scan.
+        """
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        if rng is None:
+            rng = jax.random.PRNGKey(self.config.seed + self.global_steps)
+        batch = self.shard_batch(batch)
+        self.tput.start()
+        self.state, metrics = self._train_step_fn(self.state, batch, rng)
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size
+        metrics = {k: np.asarray(v) for k, v in metrics.items()}
+        self._last_grad_norm = float(metrics["grad_norm"])
+        self.tput.stop()
+        if self.global_steps % self.config.steps_per_print == 0:
+            log_dist(f"step={self.global_steps} loss={metrics['loss']:.4f} "
+                     f"lr={metrics['lr']:.3e} gnorm={metrics['grad_norm']:.3f} "
+                     f"tput={self.tput.avg_samples_per_sec():.1f} samples/s")
+        if self.monitor is not None:
+            self.monitor.write_scalars(self.global_steps, {
+                "Train/loss": float(metrics["loss"]),
+                "Train/lr": float(metrics["lr"]),
+                "Train/grad_norm": float(metrics["grad_norm"]),
+                "Train/loss_scale": float(metrics["loss_scale"]),
+            })
+        return metrics
+
+    def eval_batch(self, batch, rng: Optional[jax.Array] = None):
+        if self._eval_step_fn is None:
+            fn = self.eval_fn or self.loss_fn
+
+            def eval_step(master, batch, rng):
+                cparams = self._compute_params(master)
+                out = fn(cparams, batch, rng)
+                return out[0] if isinstance(out, tuple) else out
+
+            self._eval_step_fn = jax.jit(
+                eval_step, in_shardings=(self.master_shardings, None, None))
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        batch = self.shard_batch(batch, accumulate=False)
+        return np.asarray(self._eval_step_fn(self.state.master, batch, rng))
+
+    def shard_batch(self, batch, accumulate: bool = True):
+        """Device-put host batch with [B] → sharded over data axes; with
+        gas>1 reshape leaves to [gas, micro_global, ...]."""
+        gas = self.gas if accumulate else 1
+
+        def put(x):
+            x = np.asarray(x)
+            if gas > 1:
+                x = x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
+                spec = P(None, (DATA_AXIS, FSDP_AXIS))
+            else:
+                spec = P((DATA_AXIS, FSDP_AXIS))
+            return jax.device_put(x, NamedSharding(self.topology.mesh, spec))
+
+        return jax.tree.map(put, batch)
+
+    # ------------------------------------------------------------------
+    # introspection / params access
+    # ------------------------------------------------------------------
+    @property
+    def compute_params(self):
+        """Current params in compute dtype (jitted gather+cast, cached)."""
+        if not hasattr(self, "_compute_params_fn"):
+            self._compute_params_fn = jax.jit(
+                self._compute_params, in_shardings=(self.master_shardings,))
+        return self._compute_params_fn(self.state.master)
+
+    def get_lr(self) -> float:
+        return float(self.lr_schedule(np.float32(self.global_steps)))
+
+    def get_global_grad_norm(self) -> Optional[float]:
+        return getattr(self, "_last_grad_norm", None)
+
+    # ------------------------------------------------------------------
+    # checkpointing (delegates to deepspeed_tpu.checkpoint)
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
+                        client_state: Optional[Dict] = None):
+        from ..checkpoint.engine import save_checkpoint as _save
+        return _save(self, save_dir, tag=tag, client_state=client_state or {})
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):
+        from ..checkpoint.engine import load_checkpoint as _load
+        return _load(self, load_dir, tag=tag)
+
+
+def initialize(loss_fn: Callable = None,
+               params: Any = None,
+               config: Any = None,
+               topology: Optional[MeshTopology] = None,
+               param_axes: Any = None,
+               sharding_rules: Optional[Dict] = None,
+               model: Any = None,
+               **kwargs) -> Engine:
+    """Build an :class:`Engine` (reference: deepspeed.initialize
+    deepspeed/__init__.py:69).
+
+    Either pass ``loss_fn`` + ``params`` directly, or a ``model`` object
+    exposing ``.loss_fn``, ``.params`` (and optionally ``.param_axes``,
+    ``.sharding_rules``) — the models in ``deepspeed_tpu.models`` do.
+    """
+    cfg = load_config(config)
+    if model is not None:
+        loss_fn = loss_fn or model.loss_fn
+        params = params if params is not None else model.params
+        param_axes = param_axes if param_axes is not None else getattr(
+            model, "param_axes", None)
+        sharding_rules = sharding_rules or getattr(model, "sharding_rules", None)
+    if loss_fn is None or params is None:
+        raise ValueError("initialize() needs loss_fn+params or model=")
+    return Engine(loss_fn=loss_fn, params=params, config=cfg,
+                  topology=topology, param_axes=param_axes,
+                  sharding_rules=sharding_rules, **kwargs)

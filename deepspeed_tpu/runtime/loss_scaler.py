@@ -1,0 +1,99 @@
+"""Dynamic loss scaling as pure functional state.
+
+TPU-native equivalent of the reference's ``LossScaler``/``DynamicLossScaler``
+(``runtime/fp16/loss_scaler.py``) and the overflow machinery
+(``CheckOverflow`` runtime/utils.py:181, ``has_overflow`` stage3.py:2171).
+
+The reference checks overflow by syncing grads to host and allreducing a
+flag; in jax there is no global state, so the scaler lives *inside* the
+jitted train step: scale the loss, compute grads, check all-finite with a
+single fused reduction, and either apply the update or skip it with
+``jnp.where`` — no host round-trip, no recompilation on overflow.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray          # f32 scalar, current loss scale
+    good_steps: jnp.ndarray     # i32 scalar, consecutive non-overflow steps
+    hysteresis: jnp.ndarray     # i32 scalar, remaining overflow tolerance
+
+
+class LossScaler(NamedTuple):
+    """Static config; state travels through the step function."""
+    dynamic: bool
+    init_scale: float
+    scale_window: int
+    scale_factor: float
+    min_scale: float
+    max_hysteresis: int
+    consecutive_hysteresis: bool
+
+    @classmethod
+    def from_config(cls, fp16_cfg) -> "LossScaler":
+        if not fp16_cfg.enabled:
+            return cls(dynamic=False, init_scale=1.0, scale_window=1000,
+                       scale_factor=2.0, min_scale=1.0, max_hysteresis=2,
+                       consecutive_hysteresis=False)
+        if fp16_cfg.dynamic_loss_scale:
+            return cls(dynamic=True,
+                       init_scale=float(2.0 ** fp16_cfg.initial_scale_power),
+                       scale_window=fp16_cfg.loss_scale_window,
+                       scale_factor=2.0,
+                       min_scale=fp16_cfg.min_loss_scale,
+                       max_hysteresis=fp16_cfg.hysteresis,
+                       consecutive_hysteresis=fp16_cfg.consecutive_hysteresis)
+        return cls(dynamic=False, init_scale=float(fp16_cfg.loss_scale),
+                   scale_window=1000, scale_factor=2.0, min_scale=1.0,
+                   max_hysteresis=2, consecutive_hysteresis=False)
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(
+            scale=jnp.asarray(self.init_scale, jnp.float32),
+            good_steps=jnp.zeros((), jnp.int32),
+            hysteresis=jnp.asarray(self.max_hysteresis, jnp.int32))
+
+    def update(self, state: LossScaleState,
+               overflow: jnp.ndarray) -> LossScaleState:
+        """Advance scaler state given this step's overflow flag
+        (reference: DynamicLossScaler.update_scale loss_scaler.py)."""
+        if not self.dynamic:
+            return state
+        # overflow: if hysteresis is exhausted drop the scale, else spend one
+        # hysteresis credit (reference: update_scale — delayed_shift)
+        drop = overflow & (state.hysteresis <= 1)
+        hyst = jnp.where(overflow & (state.hysteresis > 1),
+                         state.hysteresis - 1, state.hysteresis)
+        new_scale = jnp.where(
+            drop, jnp.maximum(state.scale / self.scale_factor, self.min_scale),
+            state.scale)
+        good = jnp.where(overflow, 0, state.good_steps + 1)
+        grow = (~overflow) & (good >= self.scale_window)
+        new_scale = jnp.where(grow, new_scale * self.scale_factor, new_scale)
+        good = jnp.where(grow, 0, good)
+        # hysteresis refill: consecutive_hysteresis=True refills on every
+        # good step (only *consecutive* overflows deplete it); False refills
+        # only when the scale grows — matching the reference exactly.
+        refill = jnp.asarray(self.max_hysteresis, jnp.int32)
+        if self.consecutive_hysteresis:
+            hyst = jnp.where(~overflow, refill, hyst)
+        else:
+            hyst = jnp.where(grow, refill, hyst)
+        return LossScaleState(scale=new_scale, good_steps=good, hysteresis=hyst)
+
+
+def all_finite(tree: Any) -> jnp.ndarray:
+    """Single fused finite-check over a pytree (the CheckOverflow analog)."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.asarray(True)
+    flags = [jnp.all(jnp.isfinite(x)) for x in leaves]
+    return jnp.stack(flags).all()
+
+

@@ -1,0 +1,130 @@
+"""Learning-rate schedules as pure ``step -> lr`` functions.
+
+TPU-native equivalents of the reference's schedule classes
+(``runtime/lr_schedules.py`` — LRRangeTest :267, OneCycle :370, WarmupLR
+:634, WarmupDecayLR :723, WarmupCosineLR :774).  The reference mutates
+optimizer param groups imperatively; here each schedule is a jit-safe pure
+function of the (float32 traced) step counter, composed directly into the
+optimizer update, so the schedule runs on-device with zero host sync.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict
+
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def constant(lr: float) -> Schedule:
+    def f(step):
+        return jnp.asarray(lr, jnp.float32)
+    return f
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3,
+                  lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False) -> Schedule:
+    """Linearly/staircase-increasing LR probe (reference :267)."""
+    def f(step):
+        x = step / lr_range_test_step_size
+        if lr_range_test_staircase:
+            x = jnp.floor(x)
+        return lr_range_test_min_lr * (1.0 + x * lr_range_test_step_rate)
+    return f
+
+
+def one_cycle(cycle_min_lr: float, cycle_max_lr: float,
+              cycle_first_step_size: int = 2000,
+              cycle_second_step_size: int | None = None,
+              decay_step_size: int = 0,
+              decay_lr_rate: float = 0.0) -> Schedule:
+    """Triangular one-cycle policy with optional post-cycle decay
+    (reference :370)."""
+    up = float(cycle_first_step_size)
+    down = float(cycle_second_step_size if cycle_second_step_size else up)
+    total = up + down
+
+    def f(step):
+        in_up = jnp.clip(step / up, 0.0, 1.0)
+        in_down = jnp.clip((step - up) / down, 0.0, 1.0)
+        tri = jnp.where(step <= up,
+                        cycle_min_lr + (cycle_max_lr - cycle_min_lr) * in_up,
+                        cycle_max_lr - (cycle_max_lr - cycle_min_lr) * in_down)
+        if decay_step_size > 0:
+            post = jnp.maximum(step - total, 0.0) / decay_step_size
+            tri = jnp.where(step > total,
+                            cycle_min_lr / (1.0 + post * decay_lr_rate), tri)
+        return jnp.maximum(tri, 0.0)
+    return f
+
+
+def _warmup_factor(step, warmup_num_steps: int, warmup_type: str):
+    w = jnp.maximum(float(warmup_num_steps), 1.0)
+    frac = jnp.clip(step / w, 0.0, 1.0)
+    if warmup_type == "log":
+        # reference WarmupLR: log-spaced warmup (lr_schedules.py:671)
+        return jnp.where(step >= w, 1.0,
+                         jnp.log1p(step) / jnp.log1p(w))
+    return frac
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000,
+              warmup_type: str = "log") -> Schedule:
+    """(reference :634)."""
+    def f(step):
+        fac = _warmup_factor(step, warmup_num_steps, warmup_type)
+        return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * fac
+    return f
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                    warmup_type: str = "log") -> Schedule:
+    """Warmup then linear decay to 0 (reference :723)."""
+    def f(step):
+        fac = _warmup_factor(step, warmup_num_steps, warmup_type)
+        lr = warmup_min_lr + (warmup_max_lr - warmup_min_lr) * fac
+        decay = jnp.clip(
+            (total_num_steps - step) /
+            jnp.maximum(float(total_num_steps - warmup_num_steps), 1.0),
+            0.0, 1.0)
+        return jnp.where(step <= warmup_num_steps, lr, warmup_max_lr * decay)
+    return f
+
+
+def warmup_cosine_lr(total_num_steps: int, warmup_min_ratio: float = 0.0,
+                     warmup_num_steps: int = 1000, cos_min_ratio: float = 1e-4,
+                     warmup_type: str = "linear", lr: float = 1.0) -> Schedule:
+    """Warmup (as ratio of peak) then cosine decay (reference :774)."""
+    def f(step):
+        fac = _warmup_factor(step, warmup_num_steps, warmup_type)
+        warm = warmup_min_ratio + (1.0 - warmup_min_ratio) * fac
+        progress = jnp.clip(
+            (step - warmup_num_steps) /
+            jnp.maximum(float(total_num_steps - warmup_num_steps), 1.0),
+            0.0, 1.0)
+        cos = cos_min_ratio + (1.0 - cos_min_ratio) * 0.5 * (
+            1.0 + jnp.cos(math.pi * progress))
+        return lr * jnp.where(step < warmup_num_steps, warm, cos)
+    return f
+
+
+SCHEDULES: Dict[str, Callable[..., Schedule]] = {
+    "LRRangeTest": lr_range_test,
+    "OneCycle": one_cycle,
+    "WarmupLR": warmup_lr,
+    "WarmupDecayLR": warmup_decay_lr,
+    "WarmupCosineLR": warmup_cosine_lr,
+    "Constant": constant,
+}
+
+
+def build_schedule(name: str, params: Dict[str, Any] | None = None) -> Schedule:
+    if name not in SCHEDULES:
+        raise ValueError(f"Unknown scheduler {name!r}; known: {sorted(SCHEDULES)}")
+    return SCHEDULES[name](**(params or {}))

@@ -1,0 +1,35 @@
+"""Tiny model fixtures (reference: tests/unit/simple_model.py — SimpleModel
+and friends exercising every engine path on random data)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_mlp(in_dim=16, hidden=64, out_dim=16, seed=0, dtype=jnp.float32):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    params = {
+        "w1": (jax.random.normal(k1, (in_dim, hidden)) * 0.1).astype(dtype),
+        "b1": jnp.zeros((hidden,), dtype),
+        "w2": (jax.random.normal(k2, (hidden, out_dim)) * 0.1).astype(dtype),
+        "b2": jnp.zeros((out_dim,), dtype),
+    }
+    axes = {
+        "w1": ("embed", "mlp"), "b1": ("mlp",),
+        "w2": ("mlp", "embed"), "b2": ("embed",),
+    }
+
+    def loss_fn(p, batch, rng):
+        x, y = batch["x"], batch["y"]
+        h = jnp.tanh(x @ p["w1"] + p["b1"])
+        out = h @ p["w2"] + p["b2"]
+        return jnp.mean((out.astype(jnp.float32) - y) ** 2)
+
+    return params, axes, loss_fn
+
+
+def make_batch(n, in_dim=16, out_dim=16, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, in_dim).astype(np.float32)
+    y = np.concatenate([x[:, out_dim // 2:], x[:, :out_dim // 2]], axis=1)
+    return {"x": x, "y": y}

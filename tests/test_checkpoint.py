@@ -1,0 +1,118 @@
+"""Checkpoint fragment-store tests (reference analogs:
+tests/unit/checkpoint/test_zero_optimizer.py — save/load across stages,
+test_universal_checkpoint.py — resume at different parallelism degree via
+DistributedFixture, SURVEY §4)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.checkpoint import consolidate, load_tree, save_tree
+from tests.simple_model import make_batch, make_mlp
+
+
+def cfg_for(stage, mesh, **over):
+    c = {
+        "train_micro_batch_size_per_device": 4,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage},
+        "mesh": mesh,
+        "steps_per_print": 1000,
+    }
+    c.update(over)
+    return c
+
+
+def make_engine(stage=2, mesh=None, seed=0):
+    p, ax, loss_fn = make_mlp(seed=seed)
+    return ds.initialize(loss_fn=loss_fn, params=p, param_axes=ax,
+                         config=cfg_for(stage, mesh or {"data": 2, "fsdp": 4}))
+
+
+class TestTreeRoundtrip:
+    def test_sharded_roundtrip(self, tmp_path, fsdp8):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(fsdp8.mesh, P("fsdp"))
+        x = jax.device_put(jnp.arange(64.0).reshape(8, 8), sh)
+        tree = {"a": x, "b": jnp.float32(3.5)}
+        save_tree(tree, str(tmp_path / "t"))
+        loaded, meta = load_tree(tree, {"a": sh, "b": NamedSharding(
+            fsdp8.mesh, P())}, str(tmp_path / "t"))
+        np.testing.assert_array_equal(np.asarray(loaded["a"]), np.asarray(x))
+        assert float(loaded["b"]) == 3.5
+
+    def test_reshard_on_load(self, tmp_path, fsdp8, mesh8):
+        """Save sharded over fsdp=8, load sharded over data2/fsdp2/tensor2 —
+        the universal-checkpoint property, no offline conversion."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        src = NamedSharding(fsdp8.mesh, P("fsdp", None))
+        x = jax.device_put(jnp.arange(256.0).reshape(16, 16), src)
+        save_tree({"w": x}, str(tmp_path / "t"))
+        dst = NamedSharding(mesh8.mesh, P(("data", "fsdp"), "tensor"))
+        loaded, _ = load_tree({"w": x}, {"w": dst}, str(tmp_path / "t"))
+        np.testing.assert_array_equal(np.asarray(loaded["w"]), np.asarray(x))
+        assert loaded["w"].sharding == dst
+
+
+class TestEngineCheckpoint:
+    def test_save_load_resume(self, tmp_path):
+        eng = make_engine(stage=2)
+        for i in range(3):
+            eng.train_batch(make_batch(eng.train_batch_size, seed=i))
+        eng.save_checkpoint(str(tmp_path), tag="t3",
+                            client_state={"note": "hi"})
+        saved_step = eng.global_steps
+        loss_before = float(eng.train_batch(make_batch(32, seed=99))["loss"])
+
+        eng2 = make_engine(stage=2)
+        _, client = eng2.load_checkpoint(str(tmp_path), tag="t3")
+        assert client["note"] == "hi"
+        assert eng2.global_steps == saved_step
+        # identical state -> identical next-step loss
+        # (rerun same batch on fresh engine from checkpoint)
+        loss_after = float(eng2.train_batch(make_batch(32, seed=99))["loss"])
+        assert loss_after == pytest.approx(loss_before, rel=1e-6)
+
+    def test_latest_pointer(self, tmp_path):
+        eng = make_engine()
+        eng.train_batch(make_batch(eng.train_batch_size))
+        eng.save_checkpoint(str(tmp_path))
+        assert os.path.exists(tmp_path / "latest")
+        eng2 = make_engine()
+        eng2.load_checkpoint(str(tmp_path))       # resolves via latest
+        assert eng2.global_steps == 1
+
+    def test_elastic_resize(self, tmp_path):
+        """Train at fsdp=4/data=2 + ZeRO-2, resume at fsdp=8 + ZeRO-3 —
+        the reference needs universal-checkpoint conversion for this
+        (checkpoint/ds_to_universal.py); here it is the default."""
+        eng = make_engine(stage=2, mesh={"data": 2, "fsdp": 4})
+        for i in range(3):
+            eng.train_batch(make_batch(eng.train_batch_size, seed=i))
+        eng.save_checkpoint(str(tmp_path), tag="resize")
+        before = consolidate(str(tmp_path / "resize"))
+
+        eng2 = make_engine(stage=3, mesh={"data": 1, "fsdp": 8})
+        eng2.load_checkpoint(str(tmp_path), tag="resize")
+        assert eng2.global_steps == 3
+        # trajectories continue identically (same math regardless of layout)
+        a = float(eng.train_batch(make_batch(32, seed=50))["loss"])
+        b = float(eng2.train_batch(make_batch(32, seed=50))["loss"])
+        assert b == pytest.approx(a, rel=1e-5)
+
+    def test_consolidate_fp32(self, tmp_path):
+        """zero_to_fp32 analog: full weights from a sharded checkpoint."""
+        eng = make_engine(stage=3, mesh={"data": 1, "fsdp": 8})
+        eng.train_batch(make_batch(eng.train_batch_size))
+        eng.save_checkpoint(str(tmp_path), tag="c")
+        full = consolidate(str(tmp_path / "c"))
+        w1_key = [k for k in full if "w1" in k]
+        assert len(w1_key) == 1
+        w1 = full[w1_key[0]]
+        assert w1.shape == (16, 64)
+        np.testing.assert_array_equal(
+            w1, np.asarray(jax.device_get(eng.state.master["w1"])))

@@ -1,0 +1,104 @@
+"""LR schedule semantics (reference: runtime/lr_schedules.py test analogs in
+tests/unit/runtime/test_lr_schedulers.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime import lr_schedules as lrs
+
+
+def ev(sched, step):
+    return float(sched(jnp.asarray(step, jnp.float32)))
+
+
+class TestWarmupLR:
+    def test_linear_warmup(self):
+        s = lrs.warmup_lr(warmup_min_lr=0.0, warmup_max_lr=1.0,
+                          warmup_num_steps=100, warmup_type="linear")
+        assert ev(s, 0) == pytest.approx(0.0)
+        assert ev(s, 50) == pytest.approx(0.5)
+        assert ev(s, 100) == pytest.approx(1.0)
+        assert ev(s, 1000) == pytest.approx(1.0)
+
+    def test_log_warmup_reaches_peak(self):
+        s = lrs.warmup_lr(warmup_max_lr=0.1, warmup_num_steps=100,
+                          warmup_type="log")
+        assert ev(s, 100) == pytest.approx(0.1, rel=1e-5)
+        assert 0 < ev(s, 10) < 0.1
+
+
+class TestWarmupDecayLR:
+    def test_decays_to_zero(self):
+        s = lrs.warmup_decay_lr(total_num_steps=1000, warmup_max_lr=0.1,
+                                warmup_num_steps=100, warmup_type="linear")
+        assert ev(s, 100) == pytest.approx(0.1, rel=1e-5)
+        assert ev(s, 550) == pytest.approx(0.05, rel=1e-3)
+        assert ev(s, 1000) == pytest.approx(0.0, abs=1e-7)
+        assert ev(s, 2000) == pytest.approx(0.0, abs=1e-7)
+
+
+class TestWarmupCosineLR:
+    def test_shape(self):
+        s = lrs.warmup_cosine_lr(total_num_steps=1000, warmup_num_steps=100,
+                                 cos_min_ratio=0.1, lr=1.0)
+        assert ev(s, 100) == pytest.approx(1.0, rel=1e-4)
+        mid = ev(s, 550)
+        assert 0.1 < mid < 1.0
+        assert ev(s, 1000) == pytest.approx(0.1, rel=1e-3)
+
+
+class TestOneCycle:
+    def test_triangle(self):
+        s = lrs.one_cycle(cycle_min_lr=0.01, cycle_max_lr=0.1,
+                          cycle_first_step_size=100)
+        assert ev(s, 0) == pytest.approx(0.01)
+        assert ev(s, 100) == pytest.approx(0.1)
+        assert ev(s, 200) == pytest.approx(0.01, rel=1e-4)
+
+    def test_decay_phase(self):
+        s = lrs.one_cycle(cycle_min_lr=0.01, cycle_max_lr=0.1,
+                          cycle_first_step_size=100, decay_step_size=100,
+                          decay_lr_rate=1.0)
+        assert ev(s, 300) < 0.01
+
+
+class TestLRRangeTest:
+    def test_continuous(self):
+        s = lrs.lr_range_test(lr_range_test_min_lr=1e-3,
+                              lr_range_test_step_size=100,
+                              lr_range_test_step_rate=1.0)
+        assert ev(s, 0) == pytest.approx(1e-3)
+        assert ev(s, 100) == pytest.approx(2e-3)
+
+    def test_staircase(self):
+        s = lrs.lr_range_test(lr_range_test_min_lr=1e-3,
+                              lr_range_test_step_size=100,
+                              lr_range_test_staircase=True)
+        assert ev(s, 99) == pytest.approx(1e-3)
+        assert ev(s, 100) == pytest.approx(2e-3)
+        assert ev(s, 199) == pytest.approx(2e-3)
+
+
+class TestRegistry:
+    def test_build(self):
+        s = lrs.build_schedule("WarmupLR", {"warmup_max_lr": 0.5})
+        assert callable(s)
+
+    def test_unknown(self):
+        with pytest.raises(ValueError):
+            lrs.build_schedule("Bogus")
+
+    def test_all_jittable(self):
+        import jax
+        for name, factory in lrs.SCHEDULES.items():
+            if name == "Constant":
+                s = factory(1e-3)
+            elif name in ("WarmupDecayLR", "WarmupCosineLR"):
+                s = factory(total_num_steps=100)
+            elif name == "OneCycle":
+                s = factory(cycle_min_lr=0.0, cycle_max_lr=0.1)
+            else:
+                s = factory()
+            out = jax.jit(s)(jnp.asarray(3.0))
+            assert np.isfinite(float(out))
