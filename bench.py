@@ -1,0 +1,87 @@
+"""Benchmark: GPT-2-small causal-LM training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: BASELINE.json config 1 ("HF GPT-2-small, ZeRO-1, single host").
+The reference publishes no single-chip GPT-2 tokens/sec number, so
+vs_baseline is computed against model-FLOPs utilisation: reference Ulysses
+sustains >54% of peak on A100s (blogs/deepspeed-ulysses/README.md:82);
+we report achieved MFU / 0.54 as the ratio.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    seq = 1024 if on_tpu else 128
+    batch = 32 if on_tpu else 2
+    model = build_model("gpt2", max_seq_len=seq, remat=True,
+                        remat_policy="dots_no_batch",
+                        **({} if on_tpu else
+                           dict(num_layers=2, d_model=128, num_heads=4,
+                                vocab_size=1024)))
+    cfg = model.config
+    config = {
+        "train_micro_batch_size_per_device": batch,
+        "optimizer": {"type": "adamw", "params": {"lr": 3e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"data": -1},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10_000,
+    }
+    engine = ds.initialize(model=model, config=config)
+    rng = np.random.RandomState(0)
+
+    def step():
+        ids = rng.randint(0, cfg.vocab_size, (engine.train_batch_size, seq))
+        return engine.train_batch({"input_ids": ids})
+
+    step()  # compile
+    jax.block_until_ready(engine.state.master)
+    n = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(n):
+        step()
+    jax.block_until_ready(engine.state.master)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = engine.train_batch_size * (seq - 1)
+    tok_s = n * tokens_per_step / dt
+
+    # model FLOPs: 6 * n_params * tokens (fwd+bwd), attention extra term
+    from deepspeed_tpu.runtime import param_count
+    n_params = param_count(model.params)
+    attn_flops = 12 * cfg.num_layers * cfg.d_model * (seq - 1)  # per token
+    flops_per_token = 6 * n_params + attn_flops
+    achieved = tok_s * flops_per_token
+    # bf16 peak per chip by generation; CPU fallback has no meaningful peak
+    peaks = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12,
+             "v5p": 459e12, "v5": 459e12, "v6e": 918e12, "v6": 918e12}
+    kind = getattr(dev, "device_kind", "").lower()
+    peak = next((v for k, v in peaks.items() if k in kind), 197e12) \
+        if on_tpu else 1e12
+    mfu = achieved / peak
+    vs_baseline = mfu / 0.54 if on_tpu else 0.0
+
+    print(json.dumps({
+        "metric": "gpt2s_train_tokens_per_sec_chip",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+from . import layers
+from .transformer import (Model, TransformerConfig, apply, init_params,
+                          cross_entropy_loss, lm_loss_fn, block_apply)
+from .presets import PRESETS, build_config, build_model
+
+__all__ = ["layers", "Model", "TransformerConfig", "apply", "init_params",
+           "cross_entropy_loss", "lm_loss_fn", "block_apply",
+           "PRESETS", "build_config", "build_model"]

@@ -1,0 +1,132 @@
+"""Functional layer library with logical-axis parameter metadata.
+
+Every constructor returns ``(params, axes)`` where ``axes`` is a matching
+pytree of logical-axis tuples consumed by
+:mod:`deepspeed_tpu.parallel.sharding`.  Apply functions are pure.
+
+This replaces the reference's module-injection machinery: where DeepSpeed
+walks an existing torch module tree and slices weights imperatively
+(``module_inject/auto_tp.py:189``, ``module_inject/layers.py:78-124``
+LinearAllreduce/LinearLayer), TPU-native models are *born* with sharding
+metadata and XLA places the collectives.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_init(key, in_dim: int, out_dim: int, in_axis: str, out_axis: str,
+               bias: bool = True, scale: Optional[float] = None):
+    scale = scale if scale is not None else 1.0 / math.sqrt(in_dim)
+    p = {"kernel": jax.random.normal(key, (in_dim, out_dim)) * scale}
+    a = {"kernel": (in_axis, out_axis)}
+    if bias:
+        p["bias"] = jnp.zeros((out_dim,))
+        a["bias"] = (out_axis,)
+    return p, a
+
+
+def dense(p, x):
+    y = x @ p["kernel"].astype(x.dtype)
+    if "bias" in p:
+        y = y + p["bias"].astype(x.dtype)
+    return y
+
+
+def embedding_init(key, vocab: int, dim: int, scale: float = 0.02):
+    return ({"table": jax.random.normal(key, (vocab, dim)) * scale},
+            {"table": ("vocab", "embed")})
+
+
+def embed(p, ids):
+    return jnp.take(p["table"], ids, axis=0)
+
+
+def layernorm_init(dim: int):
+    return ({"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))},
+            {"scale": ("norm",), "bias": ("norm",)})
+
+
+def layernorm(p, x, eps: float = 1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def rmsnorm_init(dim: int):
+    return ({"scale": jnp.ones((dim,))}, {"scale": ("norm",)})
+
+
+def rmsnorm(p, x, eps: float = 1e-6):
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt((x32 * x32).mean(-1, keepdims=True) + eps)
+    return (y * p["scale"]).astype(x.dtype)
+
+
+# --------------------------------------------------------------------------
+# Rotary position embeddings (reference kernel analog:
+# csrc/transformer/inference apply_rotary_pos_emb, v2 kv_rotary)
+# --------------------------------------------------------------------------
+
+def rope_freqs(head_dim: int, max_seq: int, theta: float = 10000.0):
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                           / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    ang = jnp.outer(t, inv)                    # [S, D/2]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, cos, sin, positions=None):
+    """x: [B, S, H, D]; cos/sin: [maxS, D/2]; positions: [B, S] or None."""
+    if positions is None:
+        c = cos[: x.shape[1]][None, :, None, :]
+        s = sin[: x.shape[1]][None, :, None, :]
+    else:
+        c = cos[positions][:, :, None, :]
+        s = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+# --------------------------------------------------------------------------
+# Attention (XLA path; Pallas flash kernel plugs in via the same signature)
+# --------------------------------------------------------------------------
+
+def causal_attention(q, k, v, mask: Optional[jnp.ndarray] = None,
+                     scale: Optional[float] = None):
+    """q: [B, S, H, D]; k/v: [B, Sk, Hkv, D].  GQA via grouped einsum — KV
+    are never materialized at full head count, preserving the memory GQA
+    exists to save.  Softmax in fp32 for stability; XLA fuses the block
+    onto the MXU."""
+    B, S, H, D = q.shape
+    Sk, Hkv = k.shape[1], k.shape[2]
+    rep = H // Hkv
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    qg = q.reshape(B, S, Hkv, rep, D)
+    logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k) * scale
+    logits = logits.astype(jnp.float32)
+    causal = jnp.tril(jnp.ones((S, Sk), bool), k=Sk - S)
+    logits = jnp.where(causal[None, None, None], logits, -1e30)
+    if mask is not None:                        # [B, Sk] padding mask
+        logits = jnp.where(mask[:, None, None, None, :].astype(bool),
+                           logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhrqk,bkhd->bqhrd", probs, v)
+    return out.reshape(B, S, H, D)
+
+
+ACTIVATIONS = {
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu_new": lambda x: jax.nn.gelu(x, approximate=True),
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+}

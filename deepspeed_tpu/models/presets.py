@@ -1,0 +1,73 @@
+"""Model-family presets (the reference's model zoo as configs).
+
+Covers the families the reference injects/implements (SURVEY §2.6:
+gpt2/neo/neox/j, llama/llama2/llama3, mistral, opt, qwen2 — containers in
+``module_inject/containers/`` and ``inference/v2/model_implementations/``)
+as :class:`TransformerConfig` presets for the single transformer core.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from .transformer import Model, TransformerConfig
+
+PRESETS: Dict[str, dict] = {
+    # --- GPT-2 family ---------------------------------------------------
+    "gpt2": dict(vocab_size=50257, num_layers=12, d_model=768, num_heads=12,
+                 max_seq_len=1024, activation="gelu_new", norm="layernorm",
+                 position="learned", tie_embeddings=True),
+    "gpt2-medium": dict(vocab_size=50257, num_layers=24, d_model=1024,
+                        num_heads=16, max_seq_len=1024,
+                        activation="gelu_new", position="learned"),
+    "gpt2-large": dict(vocab_size=50257, num_layers=36, d_model=1280,
+                       num_heads=20, max_seq_len=1024,
+                       activation="gelu_new", position="learned"),
+    "gpt2-xl": dict(vocab_size=50257, num_layers=48, d_model=1600,
+                    num_heads=25, max_seq_len=1024,
+                    activation="gelu_new", position="learned"),
+    # --- Llama family ---------------------------------------------------
+    "llama-tiny": dict(vocab_size=32000, num_layers=4, d_model=256,
+                       num_heads=8, num_kv_heads=4, d_ff=688,
+                       max_seq_len=2048, activation="silu", gated_mlp=True,
+                       norm="rmsnorm", position="rope", tie_embeddings=False,
+                       attn_bias=False, mlp_bias=False, eps=1e-5),
+    "llama2-7b": dict(vocab_size=32000, num_layers=32, d_model=4096,
+                      num_heads=32, d_ff=11008, max_seq_len=4096,
+                      activation="silu", gated_mlp=True, norm="rmsnorm",
+                      position="rope", tie_embeddings=False,
+                      attn_bias=False, mlp_bias=False),
+    "llama3-8b": dict(vocab_size=128256, num_layers=32, d_model=4096,
+                      num_heads=32, num_kv_heads=8, d_ff=14336,
+                      max_seq_len=8192, activation="silu", gated_mlp=True,
+                      norm="rmsnorm", position="rope", rope_theta=500000.0,
+                      tie_embeddings=False, attn_bias=False, mlp_bias=False),
+    "llama3-70b": dict(vocab_size=128256, num_layers=80, d_model=8192,
+                       num_heads=64, num_kv_heads=8, d_ff=28672,
+                       max_seq_len=8192, activation="silu", gated_mlp=True,
+                       norm="rmsnorm", position="rope", rope_theta=500000.0,
+                       tie_embeddings=False, attn_bias=False, mlp_bias=False),
+    # --- Mistral (GQA + high theta) --------------------------------------
+    "mistral-7b": dict(vocab_size=32000, num_layers=32, d_model=4096,
+                       num_heads=32, num_kv_heads=8, d_ff=14336,
+                       max_seq_len=8192, activation="silu", gated_mlp=True,
+                       norm="rmsnorm", position="rope", rope_theta=1000000.0,
+                       tie_embeddings=False, attn_bias=False, mlp_bias=False),
+    # --- OPT ------------------------------------------------------------
+    "opt-125m": dict(vocab_size=50272, num_layers=12, d_model=768,
+                     num_heads=12, max_seq_len=2048, activation="relu",
+                     norm="layernorm", position="learned"),
+}
+
+
+def build_config(name: str, **overrides) -> TransformerConfig:
+    if name not in PRESETS:
+        raise ValueError(f"Unknown model preset {name!r}; "
+                         f"known: {sorted(PRESETS)}")
+    kw = dict(PRESETS[name])
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def build_model(name: str, seed: int = 0, **overrides) -> Model:
+    return Model(build_config(name, **overrides), seed=seed)

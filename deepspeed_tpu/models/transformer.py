@@ -1,0 +1,293 @@
+"""Decoder-only transformer, scan-over-layers, TPU-first.
+
+The model-family core behind ``deepspeed_tpu.models.gpt2 / llama``:
+a single configurable implementation covering the reference's training
+model zoo (megatron-style GPT, llama/llama2/llama3, mistral-ish GQA — the
+containers of ``module_inject/containers/`` and
+``inference/v2/model_implementations/``) as *config presets* rather than
+per-model classes.
+
+TPU-first choices:
+* layer params are **stacked** on a leading ``layers`` dim and the block is
+  applied with ``lax.scan`` — one compiled layer body regardless of depth
+  (fast compiles, natural ``jax.checkpoint`` remat point, and the natural
+  unit for pipeline staging later);
+* logical axes on every param (see parallel/sharding.py) give Megatron-style
+  TP (column-parallel qkv/up, row-parallel out/down) with zero model code;
+* attention is pluggable: XLA softmax attention today, Pallas flash /
+  Ulysses all-to-all / ring attention slot in via ``attention_fn``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    vocab_size: int = 50257
+    num_layers: int = 12
+    d_model: int = 768
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None        # None => MHA
+    d_ff: Optional[int] = None                # None => 4*d_model (or 8/3 gated)
+    max_seq_len: int = 1024
+    activation: str = "gelu"
+    gated_mlp: bool = False                   # SwiGLU-style (llama)
+    norm: str = "layernorm"                   # layernorm | rmsnorm
+    position: str = "learned"                 # learned | rope
+    rope_theta: float = 10000.0
+    tie_embeddings: bool = True
+    attn_bias: bool = True
+    mlp_bias: bool = True
+    eps: float = 1e-5
+    remat: bool = False                       # jax.checkpoint each layer
+    remat_policy: str = "nothing"              # nothing|dots|dots_no_batch
+
+    def __post_init__(self):
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+        if self.d_ff is None:
+            if self.gated_mlp:
+                # llama sizing: 2/3 * 4d, rounded up to a multiple of 256
+                raw = int(8 * self.d_model / 3)
+                self.d_ff = 256 * ((raw + 255) // 256)
+            else:
+                self.d_ff = 4 * self.d_model
+        assert self.d_model % self.num_heads == 0
+        assert self.num_heads % self.num_kv_heads == 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+
+REMAT_POLICIES = {
+    "nothing": None,
+    "dots": lambda: jax.checkpoint_policies.checkpoint_dots,
+    "dots_no_batch": lambda: jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    "everything": lambda: jax.checkpoint_policies.nothing_saveable,
+}
+
+
+# --------------------------------------------------------------------------
+# init
+# --------------------------------------------------------------------------
+
+def init_params(cfg: TransformerConfig, key) -> Tuple[Dict, Dict]:
+    """Returns (params, logical_axes).  Per-layer params are stacked on a
+    leading 'layers' dimension (scan layout)."""
+    keys = jax.random.split(key, 8)
+    H, D, Hkv = cfg.num_heads, cfg.head_dim, cfg.num_kv_heads
+    dm, dff, nl = cfg.d_model, cfg.d_ff, cfg.num_layers
+    out_scale = 1.0 / math.sqrt(dm) / math.sqrt(2.0 * nl)   # GPT-2 depth scaling
+
+    def stack_init(fn, key, *args, **kw):
+        """Init one layer's worth with per-layer keys, stacked on dim 0."""
+        ks = jax.random.split(key, nl)
+        outs = [fn(k, *args, **kw) for k in ks]
+        p0, a0 = outs[0]
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *[o[0] for o in outs])
+        axes = jax.tree.map(lambda ax: ("layers",) + ax, a0,
+                            is_leaf=lambda x: isinstance(x, tuple) and
+                            all(e is None or isinstance(e, str) for e in x))
+        return stacked, axes
+
+    params: Dict[str, Any] = {}
+    axes: Dict[str, Any] = {}
+
+    params["embed"], axes["embed"] = L.embedding_init(keys[0], cfg.vocab_size, dm)
+    if cfg.position == "learned":
+        params["pos_embed"], axes["pos_embed"] = (
+            {"table": jax.random.normal(keys[1], (cfg.max_seq_len, dm)) * 0.01},
+            {"table": (None, "embed")})
+
+    blk_p: Dict[str, Any] = {}
+    blk_a: Dict[str, Any] = {}
+
+    # attention — fused qkv as separate heads-aware tensors
+    def qkv_init(k):
+        k1, k2, k3, k4 = jax.random.split(k, 4)
+        p, a = {}, {}
+        p["wq"] = jax.random.normal(k1, (dm, H, D)) / math.sqrt(dm)
+        a["wq"] = ("embed", "heads", "head_dim")
+        p["wk"] = jax.random.normal(k2, (dm, Hkv, D)) / math.sqrt(dm)
+        a["wk"] = ("embed", "kv_heads", "head_dim")
+        p["wv"] = jax.random.normal(k3, (dm, Hkv, D)) / math.sqrt(dm)
+        a["wv"] = ("embed", "kv_heads", "head_dim")
+        p["wo"] = jax.random.normal(k4, (H, D, dm)) * out_scale
+        a["wo"] = ("heads", "head_dim", "embed")
+        if cfg.attn_bias:
+            p["bq"] = jnp.zeros((H, D)); a["bq"] = ("heads", "head_dim")
+            p["bk"] = jnp.zeros((Hkv, D)); a["bk"] = ("kv_heads", "head_dim")
+            p["bv"] = jnp.zeros((Hkv, D)); a["bv"] = ("kv_heads", "head_dim")
+            p["bo"] = jnp.zeros((dm,)); a["bo"] = ("embed",)
+        return p, a
+
+    blk_p["attn"], blk_a["attn"] = stack_init(qkv_init, keys[2])
+
+    def mlp_init(k):
+        k1, k2, k3 = jax.random.split(k, 3)
+        p, a = {}, {}
+        p["wi"] = jax.random.normal(k1, (dm, dff)) / math.sqrt(dm)
+        a["wi"] = ("embed", "mlp")
+        if cfg.gated_mlp:
+            p["wg"] = jax.random.normal(k3, (dm, dff)) / math.sqrt(dm)
+            a["wg"] = ("embed", "mlp")
+        p["wo"] = jax.random.normal(k2, (dff, dm)) * out_scale
+        a["wo"] = ("mlp", "embed")
+        if cfg.mlp_bias:
+            p["bi"] = jnp.zeros((dff,)); a["bi"] = ("mlp",)
+            p["bo"] = jnp.zeros((dm,)); a["bo"] = ("embed",)
+        return p, a
+
+    blk_p["mlp"], blk_a["mlp"] = stack_init(mlp_init, keys[3])
+
+    norm_init = L.layernorm_init if cfg.norm == "layernorm" else L.rmsnorm_init
+    blk_p["ln1"], blk_a["ln1"] = stack_init(
+        lambda k: norm_init(dm), keys[4])
+    blk_p["ln2"], blk_a["ln2"] = stack_init(
+        lambda k: norm_init(dm), keys[5])
+
+    params["blocks"] = blk_p
+    axes["blocks"] = blk_a
+
+    params["ln_f"], axes["ln_f"] = norm_init(dm)
+    if not cfg.tie_embeddings:
+        params["lm_head"], axes["lm_head"] = (
+            {"kernel": jax.random.normal(keys[6], (dm, cfg.vocab_size))
+             / math.sqrt(dm)},
+            {"kernel": ("embed", "vocab")})
+    return params, axes
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+def _norm(cfg):
+    fn = L.layernorm if cfg.norm == "layernorm" else L.rmsnorm
+    return partial(fn, eps=cfg.eps)
+
+
+def block_apply(cfg: TransformerConfig, lp, x, cos, sin,
+                mask=None, attention_fn: Callable = L.causal_attention):
+    """One decoder layer. lp: this layer's (unstacked) params.
+    x: [B, S, dm]."""
+    norm = _norm(cfg)
+    act = L.ACTIVATIONS[cfg.activation]
+    ap = lp["attn"]
+
+    h = norm(lp["ln1"], x)
+    dt = x.dtype
+    q = jnp.einsum("bsd,dhk->bshk", h, ap["wq"].astype(dt))
+    k = jnp.einsum("bsd,dhk->bshk", h, ap["wk"].astype(dt))
+    v = jnp.einsum("bsd,dhk->bshk", h, ap["wv"].astype(dt))
+    if cfg.attn_bias:
+        q = q + ap["bq"].astype(dt)
+        k = k + ap["bk"].astype(dt)
+        v = v + ap["bv"].astype(dt)
+    if cfg.position == "rope":
+        q = L.apply_rope(q, cos, sin)
+        k = L.apply_rope(k, cos, sin)
+    o = attention_fn(q, k, v, mask=mask)
+    o = jnp.einsum("bshk,hkd->bsd", o, ap["wo"].astype(dt))
+    if cfg.attn_bias:
+        o = o + ap["bo"].astype(dt)
+    x = x + o
+
+    mp = lp["mlp"]
+    h = norm(lp["ln2"], x)
+    u = h @ mp["wi"].astype(dt)
+    if cfg.mlp_bias:
+        u = u + mp["bi"].astype(dt)
+    if cfg.gated_mlp:
+        u = act(h @ mp["wg"].astype(dt)) * u
+    else:
+        u = act(u)
+    d = u @ mp["wo"].astype(dt)
+    if cfg.mlp_bias:
+        d = d + mp["bo"].astype(dt)
+    return x + d
+
+
+def apply(cfg: TransformerConfig, params, input_ids, mask=None,
+          attention_fn: Callable = L.causal_attention,
+          dtype=None):
+    """Forward pass → logits [B, S, vocab]."""
+    dt = dtype or params["embed"]["table"].dtype
+    x = L.embed(params["embed"], input_ids).astype(dt)
+    if cfg.position == "learned":
+        S = input_ids.shape[1]
+        x = x + params["pos_embed"]["table"][:S].astype(dt)
+        cos = sin = None
+    else:
+        cos, sin = L.rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+
+    def body(h, lp):
+        return block_apply(cfg, lp, h, cos, sin, mask=mask,
+                           attention_fn=attention_fn), None
+
+    if cfg.remat:
+        policy = REMAT_POLICIES[cfg.remat_policy]
+        body = jax.checkpoint(body, policy=policy() if policy else None)
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    x = _norm(cfg)(params["ln_f"], x)
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"]["table"].astype(dt).T
+    else:
+        logits = x @ params["lm_head"]["kernel"].astype(dt)
+    return logits
+
+
+def cross_entropy_loss(logits, labels, mask=None):
+    """Next-token LM loss; logits [B,S,V], labels [B,S] (already shifted
+    or raw ids — caller shifts).  fp32 softmax."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        mask = mask.astype(jnp.float32)
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return nll.mean()
+
+
+def lm_loss_fn(cfg: TransformerConfig,
+               attention_fn: Callable = L.causal_attention):
+    """Standard causal-LM loss over a batch {input_ids, [attention_mask]}."""
+
+    def loss_fn(params, batch, rng):
+        ids = batch["input_ids"]
+        mask = batch.get("attention_mask")
+        logits = apply(cfg, params, ids[:, :-1],
+                       mask=mask[:, :-1] if mask is not None else None,
+                       attention_fn=attention_fn)
+        tgt_mask = mask[:, 1:] if mask is not None else None
+        loss = cross_entropy_loss(logits, ids[:, 1:], tgt_mask)
+        return loss
+
+    return loss_fn
+
+
+class Model:
+    """Bundles config+params+loss for ``deepspeed_tpu.initialize(model=…)``."""
+
+    def __init__(self, cfg: TransformerConfig, seed: int = 0,
+                 attention_fn: Callable = L.causal_attention):
+        self.config = cfg
+        self.params, self.param_axes = init_params(cfg, jax.random.PRNGKey(seed))
+        self.loss_fn = lm_loss_fn(cfg, attention_fn)
+        self.attention_fn = attention_fn
+
+    def apply(self, params, input_ids, **kw):
+        kw.setdefault("attention_fn", self.attention_fn)
+        return apply(self.config, params, input_ids, **kw)

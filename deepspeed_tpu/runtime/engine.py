@@ -389,7 +389,10 @@ class Engine:
         return self._compute_params_fn(self.state.master)
 
     def get_lr(self) -> float:
-        return float(self.lr_schedule(np.float32(self.global_steps)))
+        # schedule position = optimizer steps actually applied (state.step
+        # excludes overflow-skipped steps; global_steps would drift under fp16)
+        return float(self.lr_schedule(
+            np.asarray(self.state.step).astype(np.float32)))
 
     def get_global_grad_norm(self) -> Optional[float]:
         return getattr(self, "_last_grad_norm", None)
