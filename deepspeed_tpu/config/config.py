@@ -227,7 +227,7 @@ class PipelineConfig(ConfigModel):
     """(reference: runtime/pipe/module.py, schedule.py)."""
     stages: int = 1
     partition_method: str = "parameters"   # parameters | uniform | type:<regex>
-    num_microbatches: int = 1
+    num_microbatches: int = 0              # 0 => one per pipeline stage
     activation_checkpoint_interval: int = 0
     schedule: str = "1f1b"                 # 1f1b | gpipe | interleaved
 
@@ -468,6 +468,26 @@ class Config(ConfigModel):
     def __post_init__(self):
         if self.gradient_clipping < 0:
             raise ConfigError("gradient_clipping must be >= 0")
+        self.reconcile_mesh()
+
+    def reconcile_mesh(self) -> None:
+        """Propagate per-feature parallel sizes (sequence_parallel.size,
+        pipeline.stages, tensor_parallel.size, moe.expert_parallel_size)
+        into the mesh axes, erroring on contradictions — so configuring a
+        feature without hand-editing the mesh Just Works."""
+        pairs = [("seq", self.sequence_parallel.size),
+                 ("pipe", self.pipeline.stages),
+                 ("tensor", self.tensor_parallel.size),
+                 ("expert", self.moe.expert_parallel_size)]
+        for axis, size in pairs:
+            if size and size > 1:
+                mesh_size = getattr(self.mesh, axis)
+                if mesh_size in (None, 0, -1, 1):
+                    setattr(self.mesh, axis, size)
+                elif mesh_size != size:
+                    raise ConfigError(
+                        f"mesh.{axis}={mesh_size} contradicts the "
+                        f"feature-level parallel size {size}")
 
 
 def load_config(config: Any) -> Config:

@@ -53,6 +53,20 @@ PRESETS: Dict[str, dict] = {
                        max_seq_len=8192, activation="silu", gated_mlp=True,
                        norm="rmsnorm", position="rope", rope_theta=1000000.0,
                        tie_embeddings=False, attn_bias=False, mlp_bias=False),
+    # --- Mixtral (MoE, reference: v2 model_implementations/mixtral) -------
+    "mixtral-tiny": dict(vocab_size=32000, num_layers=4, d_model=256,
+                         num_heads=8, num_kv_heads=4, d_ff=512,
+                         max_seq_len=2048, activation="silu", gated_mlp=True,
+                         norm="rmsnorm", position="rope",
+                         tie_embeddings=False, attn_bias=False,
+                         mlp_bias=False, num_experts=8, moe_top_k=2),
+    "mixtral-8x7b": dict(vocab_size=32000, num_layers=32, d_model=4096,
+                         num_heads=32, num_kv_heads=8, d_ff=14336,
+                         max_seq_len=8192, activation="silu", gated_mlp=True,
+                         norm="rmsnorm", position="rope",
+                         rope_theta=1000000.0, tie_embeddings=False,
+                         attn_bias=False, mlp_bias=False,
+                         num_experts=8, moe_top_k=2),
     # --- OPT ------------------------------------------------------------
     "opt-125m": dict(vocab_size=50272, num_layers=12, d_model=768,
                      num_heads=12, max_seq_len=2048, activation="relu",

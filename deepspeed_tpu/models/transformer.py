@@ -51,6 +51,13 @@ class TransformerConfig:
     eps: float = 1e-5
     remat: bool = False                       # jax.checkpoint each layer
     remat_policy: str = "nothing"              # nothing|dots|dots_no_batch
+    # --- MoE (reference: deepspeed/moe; presets: mixtral) ----------------
+    num_experts: int = 1                      # >1 => every layer is MoE
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
+    min_capacity: int = 4
+    noise_policy: Optional[str] = None        # None | Jitter | RSample
+    aux_loss_coef: float = 0.01
 
     def __post_init__(self):
         if self.num_kv_heads is None:
@@ -134,6 +141,16 @@ def init_params(cfg: TransformerConfig, key) -> Tuple[Dict, Dict]:
 
     blk_p["attn"], blk_a["attn"] = stack_init(qkv_init, keys[2])
 
+    if cfg.num_experts > 1:
+        from ..parallel import moe as M
+
+        blk_p["gate"], blk_a["gate"] = stack_init(
+            lambda k: M.gate_init(k, dm, cfg.num_experts), keys[7])
+        blk_p["experts"], blk_a["experts"] = stack_init(
+            lambda k: M.experts_init(k, cfg.num_experts, dm, dff,
+                                     gated=cfg.gated_mlp,
+                                     out_scale=out_scale), keys[3])
+
     def mlp_init(k):
         k1, k2, k3 = jax.random.split(k, 3)
         p, a = {}, {}
@@ -149,7 +166,8 @@ def init_params(cfg: TransformerConfig, key) -> Tuple[Dict, Dict]:
             p["bo"] = jnp.zeros((dm,)); a["bo"] = ("embed",)
         return p, a
 
-    blk_p["mlp"], blk_a["mlp"] = stack_init(mlp_init, keys[3])
+    if cfg.num_experts <= 1:
+        blk_p["mlp"], blk_a["mlp"] = stack_init(mlp_init, keys[3])
 
     norm_init = L.layernorm_init if cfg.norm == "layernorm" else L.rmsnorm_init
     blk_p["ln1"], blk_a["ln1"] = stack_init(
@@ -179,9 +197,10 @@ def _norm(cfg):
 
 
 def block_apply(cfg: TransformerConfig, lp, x, cos, sin,
-                mask=None, attention_fn: Callable = L.causal_attention):
+                mask=None, attention_fn: Callable = L.causal_attention,
+                rng=None):
     """One decoder layer. lp: this layer's (unstacked) params.
-    x: [B, S, dm]."""
+    x: [B, S, dm].  Returns (x, metrics) — metrics non-empty for MoE."""
     norm = _norm(cfg)
     act = L.ACTIVATIONS[cfg.activation]
     ap = lp["attn"]
@@ -204,25 +223,37 @@ def block_apply(cfg: TransformerConfig, lp, x, cos, sin,
         o = o + ap["bo"].astype(dt)
     x = x + o
 
-    mp = lp["mlp"]
     h = norm(lp["ln2"], x)
-    u = h @ mp["wi"].astype(dt)
-    if cfg.mlp_bias:
-        u = u + mp["bi"].astype(dt)
-    if cfg.gated_mlp:
-        u = act(h @ mp["wg"].astype(dt)) * u
+    metrics: Dict[str, Any] = {}
+    if cfg.num_experts > 1:
+        from ..parallel import moe as M
+
+        d, metrics = M.moe_ffn(
+            lp["gate"], lp["experts"], h, top_k=cfg.moe_top_k,
+            capacity_factor=cfg.capacity_factor,
+            min_capacity=cfg.min_capacity, activation=act,
+            gated=cfg.gated_mlp, rng=rng, noise_policy=cfg.noise_policy)
     else:
-        u = act(u)
-    d = u @ mp["wo"].astype(dt)
-    if cfg.mlp_bias:
-        d = d + mp["bo"].astype(dt)
-    return x + d
+        mp = lp["mlp"]
+        u = h @ mp["wi"].astype(dt)
+        if cfg.mlp_bias:
+            u = u + mp["bi"].astype(dt)
+        if cfg.gated_mlp:
+            u = act(h @ mp["wg"].astype(dt)) * u
+        else:
+            u = act(u)
+        d = u @ mp["wo"].astype(dt)
+        if cfg.mlp_bias:
+            d = d + mp["bo"].astype(dt)
+    return x + d, metrics
 
 
 def apply(cfg: TransformerConfig, params, input_ids, mask=None,
           attention_fn: Callable = L.causal_attention,
-          dtype=None):
-    """Forward pass → logits [B, S, vocab]."""
+          dtype=None, rng=None, with_aux: bool = False):
+    """Forward pass → logits [B, S, vocab] (or (logits, aux) with
+    with_aux=True; aux carries MoE load-balancing metrics averaged over
+    layers)."""
     dt = dtype or params["embed"]["table"].dtype
     x = L.embed(params["embed"], input_ids).astype(dt)
     if cfg.position == "learned":
@@ -232,21 +263,45 @@ def apply(cfg: TransformerConfig, params, input_ids, mask=None,
     else:
         cos, sin = L.rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
 
-    def body(h, lp):
-        return block_apply(cfg, lp, h, cos, sin, mask=mask,
-                           attention_fn=attention_fn), None
+    have_rng = rng is not None
+    layer_rngs = (jax.random.split(rng, cfg.num_layers) if have_rng
+                  else jnp.zeros((cfg.num_layers, 2), jnp.uint32))
+
+    def body(h, xs):
+        lp, r = xs
+        h, metrics = block_apply(cfg, lp, h, cos, sin, mask=mask,
+                                 attention_fn=attention_fn,
+                                 rng=r if have_rng else None)
+        return h, metrics
 
     if cfg.remat:
         policy = REMAT_POLICIES[cfg.remat_policy]
         body = jax.checkpoint(body, policy=policy() if policy else None)
 
-    x, _ = jax.lax.scan(body, x, params["blocks"])
+    x, metrics = jax.lax.scan(body, x, (params["blocks"], layer_rngs))
     x = _norm(cfg)(params["ln_f"], x)
     if cfg.tie_embeddings:
         logits = x @ params["embed"]["table"].astype(dt).T
     else:
         logits = x @ params["lm_head"]["kernel"].astype(dt)
+    if with_aux:
+        aux = {k: v.mean() for k, v in metrics.items()} if metrics else {}
+        return logits, aux
     return logits
+
+
+def rolled_lm_targets(ids, mask=None):
+    """Next-token targets by rolling left with the final position masked —
+    equivalent to the shift-by-one convention but length-preserving, so it
+    divides evenly under sequence/pipeline sharding.  Returns
+    (labels, target_mask)."""
+    labels = jnp.roll(ids, -1, axis=1)
+    S = ids.shape[1]
+    tgt_mask = jnp.broadcast_to(
+        (jnp.arange(S) < S - 1).astype(jnp.float32)[None, :], ids.shape)
+    if mask is not None:
+        tgt_mask = tgt_mask * jnp.roll(mask, -1, axis=1)
+    return labels, tgt_mask
 
 
 def cross_entropy_loss(logits, labels, mask=None):
@@ -268,11 +323,14 @@ def lm_loss_fn(cfg: TransformerConfig,
     def loss_fn(params, batch, rng):
         ids = batch["input_ids"]
         mask = batch.get("attention_mask")
-        logits = apply(cfg, params, ids[:, :-1],
-                       mask=mask[:, :-1] if mask is not None else None,
-                       attention_fn=attention_fn)
-        tgt_mask = mask[:, 1:] if mask is not None else None
-        loss = cross_entropy_loss(logits, ids[:, 1:], tgt_mask)
+        logits, aux = apply(cfg, params, ids, mask=mask,
+                            attention_fn=attention_fn, rng=rng,
+                            with_aux=True)
+        labels, tgt_mask = rolled_lm_targets(ids, mask)
+        loss = cross_entropy_loss(logits, labels, tgt_mask)
+        if "moe_aux_loss" in aux:
+            loss = loss + cfg.aux_loss_coef * aux["moe_aux_loss"]
+            return loss, aux
         return loss
 
     return loss_fn

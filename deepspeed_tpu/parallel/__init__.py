@@ -1,7 +1,12 @@
 from .sharding import (DEFAULT_RULES, spec_for_axes, add_fsdp_to_spec,
                        tree_specs, infer_logical_axes, named, tree_named)
 from .zero import ZeroPolicy, shard_count
+from .sequence import (make_attention, make_ulysses_attention,
+                       make_ring_attention)
+from .pipeline import make_pipelined_loss_fn
+from . import moe
 
 __all__ = ["DEFAULT_RULES", "spec_for_axes", "add_fsdp_to_spec", "tree_specs",
            "infer_logical_axes", "named", "tree_named", "ZeroPolicy",
-           "shard_count"]
+           "shard_count", "make_attention", "make_ulysses_attention",
+           "make_ring_attention", "make_pipelined_loss_fn", "moe"]

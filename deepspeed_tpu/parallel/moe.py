@@ -1,0 +1,181 @@
+"""Mixture-of-Experts: top-k gating + expert-parallel dispatch.
+
+TPU-native re-design of the reference MoE stack
+(``deepspeed/moe/layer.py:17`` MoE, ``moe/sharded_moe.py`` — ``TopKGate``
+:374, top-1/2/k gating with capacity/jitter/RSample :183-449, ``MOELayer``
+einsum dispatch → all_to_all → local experts → all_to_all → combine :533,
+``_AllToAll`` autograd :96, ``Experts`` moe/experts.py:13).
+
+Here the dispatch is the GShard dense-einsum formulation: build
+``dispatch [T,E,C]`` / ``combine [T,E,C]`` masks from the gate top-k with
+per-expert capacity, then
+
+    expert_in  = einsum('tec,td->ecd', dispatch, x)     # XLA: all_to_all
+    expert_out = ff_e(expert_in)                        # E sharded on mesh
+    y          = einsum('tec,ecd->td', combine, expert_out)
+
+With expert weights sharded over the ``expert`` mesh axis and tokens over
+the batch axes, the SPMD partitioner inserts exactly the reference's
+all_to_all pair.  Capacity keeps every shape static (XLA requirement —
+and the reference drops tokens the same way, sharded_moe.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GateOutput(NamedTuple):
+    dispatch: jnp.ndarray   # [T, E, C] float (0/1)
+    combine: jnp.ndarray    # [T, E, C] float (gate weights)
+    aux_loss: jnp.ndarray   # scalar load-balancing loss
+    dropped: jnp.ndarray    # scalar fraction of tokens dropped
+
+
+def top_k_gating(logits: jnp.ndarray, top_k: int, capacity: int,
+                 rng: Optional[jax.Array] = None,
+                 noise_policy: Optional[str] = None) -> GateOutput:
+    """logits: [T, E].  (reference: top1gating/top2gating/topkgating
+    sharded_moe.py:183,290,449)."""
+    T, E = logits.shape
+    if noise_policy == "RSample" and rng is not None:
+        logits = logits + jax.random.normal(rng, logits.shape) / E
+
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)   # [T, E]
+
+    # iterative top-k: mask out previous choices
+    dispatch_parts = []
+    combine_parts = []
+    remaining = gates
+    sel_masks = []
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)                      # [T]
+        sel = jax.nn.one_hot(idx, E, dtype=jnp.float32)           # [T, E]
+        sel_masks.append(sel)
+        remaining = remaining * (1.0 - sel)
+
+    # aux loss from the top-1 assignment (Switch/GShard style,
+    # reference sharded_moe.py l_aux)
+    me = gates.mean(axis=0)                                       # [E]
+    ce = sel_masks[0].mean(axis=0)                                # [E]
+    aux_loss = (me * ce).sum() * E
+
+    # capacity assignment: position of each token within its expert,
+    # counting across all k choices in priority order
+    prev_counts = jnp.zeros((E,), jnp.float32)
+    kept_any = jnp.zeros((T,), jnp.float32)
+    for sel in sel_masks:
+        pos = jnp.cumsum(sel, axis=0) - 1.0 + prev_counts[None, :]  # [T, E]
+        keep = sel * (pos < capacity)
+        pos_idx = (pos * keep).astype(jnp.int32)
+        disp = keep[:, :, None] * jax.nn.one_hot(
+            pos_idx, capacity, dtype=jnp.float32)
+        gate_val = (gates * keep).sum(axis=-1, keepdims=True)     # [T, 1]
+        dispatch_parts.append(disp)
+        combine_parts.append(disp * gate_val[:, :, None])
+        prev_counts = prev_counts + sel.sum(axis=0)
+        kept_any = jnp.maximum(kept_any, keep.sum(axis=-1))
+
+    dispatch = sum(dispatch_parts)
+    combine = sum(combine_parts)
+    if top_k > 1:
+        # renormalize kept gate weights to sum 1 per token (reference: top2
+        # normalization sharded_moe.py:290; top-1 keeps the raw probability
+        # as in Switch / reference top1gating)
+        denom = combine.sum(axis=(1, 2), keepdims=True)
+        combine = combine / jnp.maximum(denom, 1e-9)
+    dropped = 1.0 - kept_any.mean()
+    return GateOutput(dispatch=dispatch, combine=combine,
+                      aux_loss=aux_loss, dropped=dropped)
+
+
+def capacity_for(tokens: int, num_experts: int, top_k: int,
+                 capacity_factor: float, min_capacity: int = 4) -> int:
+    """(reference: _capacity sharded_moe.py)."""
+    cap = int(math.ceil(tokens * top_k * capacity_factor / num_experts))
+    return max(cap, min_capacity)
+
+
+# --------------------------------------------------------------------------
+# Expert FFN params (stacked on a leading expert dim)
+# --------------------------------------------------------------------------
+
+def experts_init(key, num_experts: int, d_model: int, d_ff: int,
+                 gated: bool = False, out_scale: float = None):
+    """Params [E, ...] with logical axes led by 'expert'
+    (reference: Experts moe/experts.py:13 — a python list of FFNs; here one
+    stacked tensor so a single grouped matmul serves all local experts)."""
+    out_scale = out_scale or 1.0 / math.sqrt(d_ff)
+    k1, k2, k3 = jax.random.split(key, 3)
+    p = {"wi": jax.random.normal(k1, (num_experts, d_model, d_ff))
+         / math.sqrt(d_model),
+         "wo": jax.random.normal(k2, (num_experts, d_ff, d_model)) * out_scale}
+    a = {"wi": ("expert", "embed", "mlp"), "wo": ("expert", "mlp", "embed")}
+    if gated:
+        p["wg"] = jax.random.normal(k3, (num_experts, d_model, d_ff)) \
+            / math.sqrt(d_model)
+        a["wg"] = ("expert", "embed", "mlp")
+    return p, a
+
+
+def experts_apply(p, x, activation, gated: bool = False):
+    """x: [E, C, d_model] -> [E, C, d_model]; one grouped matmul per
+    projection (megablox-style grouped GEMM is the Pallas upgrade path,
+    reference cutlass moe_gemm)."""
+    dt = x.dtype
+    u = jnp.einsum("ecd,edf->ecf", x, p["wi"].astype(dt))
+    if gated:
+        u = activation(jnp.einsum("ecd,edf->ecf", x, p["wg"].astype(dt))) * u
+    else:
+        u = activation(u)
+    return jnp.einsum("ecf,efd->ecd", u, p["wo"].astype(dt))
+
+
+def gate_init(key, d_model: int, num_experts: int):
+    return ({"kernel": jax.random.normal(key, (d_model, num_experts)) * 0.01},
+            {"kernel": ("embed", None)})
+
+
+def moe_ffn(gate_p, expert_p, x, *, top_k: int, capacity_factor: float,
+            min_capacity: int = 4, activation=jax.nn.gelu,
+            gated: bool = False, rng: Optional[jax.Array] = None,
+            noise_policy: Optional[str] = None
+            ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Full MoE FFN over x [B, S, d_model] (reference: MOELayer.forward
+    sharded_moe.py:533).  Returns (y, metrics) with metrics carrying the
+    aux load-balancing loss.
+
+    Tokens are gated **per group** (one group per sequence, the GShard
+    grouping) so the dispatch/combine masks are [G, Tg, E, Cg] — linear in
+    total tokens rather than quadratic (Cg is the per-group capacity).
+    A megablox-style grouped-matmul kernel is the planned Pallas upgrade
+    (reference analog: cutlass moe_gemm)."""
+    B, S, dm = x.shape
+    E = expert_p["wi"].shape[0]
+    cap = capacity_for(S, E, top_k, capacity_factor, min_capacity)
+    if noise_policy == "Jitter" and rng is not None:
+        xg = x * jax.random.uniform(rng, x.shape, minval=0.98, maxval=1.02)
+    else:
+        xg = x
+    logits = jnp.einsum("gtd,de->gte", xg, gate_p["kernel"].astype(x.dtype))
+    rngs = jax.random.split(rng, B) if rng is not None else None
+    gate_fn = functools.partial(top_k_gating, top_k=top_k, capacity=cap,
+                                noise_policy=noise_policy)
+    if rngs is None:
+        gate = jax.vmap(lambda l: gate_fn(l, rng=None))(logits)
+    else:
+        gate = jax.vmap(lambda l, r: gate_fn(l, rng=r))(logits, rngs)
+    dt = x.dtype
+    # [G,Tg,E,Cg] x [G,Tg,d] -> [E, G*Cg, d]; SPMD inserts the all_to_all
+    expert_in = jnp.einsum("gtec,gtd->egcd", gate.dispatch.astype(dt), x)
+    expert_in = expert_in.reshape(E, B * cap, dm)
+    expert_out = experts_apply(expert_p, expert_in, activation, gated)
+    expert_out = expert_out.reshape(E, B, cap, dm)
+    y = jnp.einsum("gtec,egcd->gtd", gate.combine.astype(dt), expert_out)
+    return y, {"moe_aux_loss": gate.aux_loss.mean(),
+               "moe_dropped": gate.dropped.mean()}

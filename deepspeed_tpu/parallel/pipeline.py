@@ -1,0 +1,170 @@
+"""Pipeline parallelism over the ``pipe`` mesh axis.
+
+TPU-native re-design of the reference pipeline stack
+(``runtime/pipe/module.py:86`` PipelineModule layer partitioning,
+``schedule.py:189`` TrainSchedule/1F1B instruction generator,
+``pipe/engine.py:61`` PipelineEngine instruction interpreter with p2p
+send/recv ``pipe/p2p.py:46``).
+
+The reference interprets instruction lists per rank with explicit
+send/recv.  Under SPMD there is no per-rank program: the pipeline is a
+single ``lax.scan`` over ``T = M + S - 1`` ticks inside a ``shard_map``
+over the ``pipe`` axis (GPipe schedule).  Each tick every stage applies
+its layer slice and hands its activation to the next stage via
+``lax.ppermute`` — the instruction schedule *is* the scan, the p2p layer
+*is* ppermute riding ICI neighbor links, and the bubble is the standard
+(S-1)/T fraction.
+
+Layer placement: the model's stacked ``blocks`` (leading ``layers`` dim)
+are sharded over ``pipe`` — contiguous equal slices, the 'uniform'
+partition method of module.py:391.  Embedding/unembedding stay replicated
+across stages (the reference's tied-layer broadcast, module.py:77, without
+the tie-grad allreduce since SPMD psums automatically).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..comm.mesh import BATCH_AXES, MeshTopology, PIPE_AXIS
+from ..models import layers as L
+from ..models.transformer import (TransformerConfig, block_apply,
+                                  rolled_lm_targets, _norm)
+
+
+def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
+                           num_microbatches: int,
+                           attention_fn: Callable = L.causal_attention):
+    """Build ``loss_fn(params, batch, rng)`` running the GPipe schedule.
+
+    Requirements: ``num_layers % pipe == 0``; the global micro-batch (the
+    engine's per-step batch) divisible by ``num_microbatches``.
+    """
+    mesh = topology.mesh
+    S = topology.pp_size
+    M = num_microbatches
+    if cfg.num_layers % S:
+        raise ValueError(f"num_layers {cfg.num_layers} not divisible by "
+                         f"pipe stages {S}")
+    if cfg.num_experts > 1:
+        raise NotImplementedError("pipeline + MoE not yet supported")
+
+    norm = _norm(cfg)
+
+    dp = topology.dp_world_size
+
+    def loss_fn(params, batch, rng):
+        ids = batch["input_ids"]
+        B, seq = ids.shape
+        if (B // dp) % M:
+            raise ValueError(
+                f"per-dp-shard batch {B}//{dp} not divisible by "
+                f"num_microbatches {M}")
+        amask = batch.get("attention_mask")
+        labels, tgt_mask = rolled_lm_targets(ids, amask)
+        if amask is None:
+            amask = jnp.ones_like(ids, jnp.float32)
+
+        if cfg.position == "rope":
+            cos, sin = L.rope_freqs(cfg.head_dim, cfg.max_seq_len,
+                                    cfg.rope_theta)
+        else:
+            cos = sin = None
+
+        def stage_fwd(blocks_local, x, attn_mask):
+            def body(h, lp):
+                h, _ = block_apply(cfg, lp, h, cos, sin, mask=attn_mask,
+                                   attention_fn=attention_fn)
+                return h, None
+            body_fn = jax.checkpoint(body) if cfg.remat else body
+            x, _ = lax.scan(body_fn, x, blocks_local)
+            return x
+
+        def local(blocks, shared, ids, labels, tgt_mask, amask):
+            """Runs per pipe shard.  blocks: [L/S, ...] local slice;
+            shared (embed/pos/ln_f/head): replicated."""
+            stage = lax.axis_index(PIPE_AXIS)
+            first, last = stage == 0, stage == S - 1
+            dt = shared["embed"]["table"].dtype
+
+            # ids here is the per-(data,fsdp)-shard slice
+            mb = ids.shape[0] // M
+            ids_mb = ids.reshape(M, mb, seq)
+            labels_mb = labels.reshape(M, mb, seq)
+            mask_mb = tgt_mask.reshape(M, mb, seq)
+            amask_mb = amask.reshape(M, mb, seq)
+
+            T = M + S - 1
+            perm = [(i, i + 1) for i in range(S - 1)]
+
+            def tick(carry, t):
+                buf, loss_sum, tok_sum = carry
+                # stage 0 ingests microbatch t (clamped; masked later)
+                t_in = jnp.clip(t, 0, M - 1)
+                x0 = L.embed(shared["embed"],
+                             lax.dynamic_index_in_dim(
+                                 ids_mb, t_in, 0, keepdims=False)).astype(dt)
+                if cfg.position == "learned":
+                    x0 = x0 + shared["pos_embed"]["table"][:seq].astype(dt)
+                x = jnp.where(first, x0, buf)
+                # stage s processes microbatch t-s at tick t
+                t_here = jnp.clip(t - stage, 0, M - 1)
+                m_att = lax.dynamic_index_in_dim(amask_mb, t_here, 0,
+                                                 keepdims=False)
+                y = stage_fwd(blocks, x, m_att)
+
+                # last stage: unembed + loss for microbatch t-(S-1)
+                t_out = jnp.clip(t - (S - 1), 0, M - 1)
+                h = norm(shared["ln_f"], y)
+                if cfg.tie_embeddings:
+                    logits = h @ shared["embed"]["table"].astype(dt).T
+                else:
+                    logits = h @ shared["lm_head"]["kernel"].astype(dt)
+                lbl = lax.dynamic_index_in_dim(labels_mb, t_out, 0,
+                                               keepdims=False)
+                msk = lax.dynamic_index_in_dim(mask_mb, t_out, 0,
+                                               keepdims=False)
+                logits32 = logits.astype(jnp.float32)
+                logp = jax.nn.log_softmax(logits32, axis=-1)
+                nll = -jnp.take_along_axis(logp, lbl[..., None],
+                                           axis=-1)[..., 0]
+                valid = last & (t >= S - 1)
+                contrib = jnp.where(valid, (nll * msk).sum(), 0.0)
+                toks = jnp.where(valid, msk.sum(), 0.0)
+
+                # hand activation to the next stage
+                buf_next = lax.ppermute(y, PIPE_AXIS, perm) if S > 1 else y
+                return (buf_next, loss_sum + contrib, tok_sum + toks), None
+
+            buf0 = jnp.zeros((mb, seq, cfg.d_model), dt)
+            (_, loss_sum, tok_sum), _ = lax.scan(
+                tick, (buf0, jnp.float32(0.0), jnp.float32(0.0)),
+                jnp.arange(T))
+            # broadcast the last stage's loss to every stage
+            loss_sum = lax.psum(loss_sum, PIPE_AXIS)
+            tok_sum = lax.psum(tok_sum, PIPE_AXIS)
+            return loss_sum / jnp.maximum(tok_sum, 1.0)
+
+        blocks = params["blocks"]
+        shared = {k: v for k, v in params.items() if k != "blocks"}
+
+        blocks_specs = jax.tree.map(lambda _: P(PIPE_AXIS), blocks)
+        shared_specs = jax.tree.map(lambda _: P(), shared)
+        data_spec = P(BATCH_AXES)
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(blocks_specs, shared_specs, data_spec, data_spec,
+                      data_spec, data_spec),
+            out_specs=P(),
+            check_vma=False)(blocks, shared, ids, labels, tgt_mask, amask)
+
+    return loss_fn

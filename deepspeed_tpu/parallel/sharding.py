@@ -22,7 +22,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..comm.mesh import (AXIS_ORDER, DATA_AXIS, EXPERT_AXIS, FSDP_AXIS,
-                         MeshTopology, SEQ_AXIS, TENSOR_AXIS)
+                         MeshTopology, PIPE_AXIS, SEQ_AXIS, TENSOR_AXIS)
 
 # A logical axis annotation: tuple of names, one per tensor dim (None = never shard)
 LogicalAxes = Tuple[Optional[str], ...]
@@ -33,6 +33,8 @@ DEFAULT_RULES: Dict[str, Sequence[str]] = {
     # activations / batch-like
     "batch": (DATA_AXIS, FSDP_AXIS),
     "seq": (SEQ_AXIS,),
+    # stacked layer dim: pipeline stages own contiguous layer slices
+    "layers": (PIPE_AXIS,),
     # parameter axes
     "vocab": (TENSOR_AXIS,),
     "embed": (),                      # residual stream: replicated under TP

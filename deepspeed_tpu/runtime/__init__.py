@@ -5,6 +5,7 @@ from .lr_schedules import build_schedule, SCHEDULES
 from .loss_scaler import LossScaler, LossScaleState, all_finite
 from .runtime_utils import (global_norm, clip_by_global_norm,
                             partition_balanced, see_memory_usage, param_count)
+from .dataloader import DataLoader, synthetic_lm_data
 
 __all__ = [
     "Engine", "TrainState", "initialize",
@@ -14,4 +15,5 @@ __all__ = [
     "LossScaler", "LossScaleState", "all_finite",
     "global_norm", "clip_by_global_norm", "partition_balanced",
     "see_memory_usage", "param_count",
+    "DataLoader", "synthetic_lm_data",
 ]

@@ -1,0 +1,73 @@
+"""Data loading: deterministic sharded batches for the engine.
+
+TPU-native analog of ``DeepSpeedDataLoader`` (``runtime/dataloader.py`` —
+DistributedSampler + curriculum hook via ``deepspeed_io`` engine.py:1743).
+
+On TPU each *process* loads its slice of the global batch
+(``jax.process_index()``-strided, like the reference's DistributedSampler
+rank striding); the engine's ``shard_batch`` then lays it onto the mesh.
+A ``batch_fn`` hook covers curriculum-style transforms
+(reference: data_pipeline/curriculum_scheduler.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class DataLoader:
+    """Iterate epoch-shuffled, process-sharded global batches from a dict
+    of arrays (or anything indexable)."""
+
+    def __init__(self, data: Dict[str, Any], batch_size: int,
+                 shuffle: bool = True, seed: int = 0,
+                 drop_last: bool = True,
+                 batch_fn: Optional[Callable[[Dict, int], Dict]] = None):
+        self.data = {k: np.asarray(v) for k, v in data.items()}
+        sizes = {k: len(v) for k, v in self.data.items()}
+        if len(set(sizes.values())) != 1:
+            raise ValueError(f"Mismatched field lengths: {sizes}")
+        self.n = next(iter(sizes.values()))
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.batch_fn = batch_fn
+        self.epoch = 0
+        if not drop_last and self.n % batch_size:
+            raise ValueError("drop_last=False requires n % batch_size == 0")
+
+    def __len__(self) -> int:
+        return self.n // self.batch_size
+
+    def set_epoch(self, epoch: int) -> None:
+        """(reference: DistributedSampler.set_epoch)."""
+        self.epoch = epoch
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        order = np.arange(self.n)
+        if self.shuffle:
+            np.random.RandomState(self.seed + self.epoch).shuffle(order)
+        # process-sharded: each host reads its interleaved slice of every
+        # global batch (rank striding like the reference sampler)
+        pc, pi = jax.process_count(), jax.process_index()
+        per_proc = self.batch_size // pc if self.batch_size % pc == 0 else None
+        for step in range(len(self)):
+            sel = order[step * self.batch_size:(step + 1) * self.batch_size]
+            if per_proc is not None and pc > 1:
+                sel = sel[pi::pc]
+            batch = {k: v[sel] for k, v in self.data.items()}
+            if self.batch_fn is not None:
+                batch = self.batch_fn(batch, step)
+            yield batch
+
+
+def synthetic_lm_data(vocab_size: int, n_samples: int, seq_len: int,
+                      seed: int = 0) -> Dict[str, np.ndarray]:
+    """Random-token corpus for tests/benches (reference: the random-data
+    loaders in tests/unit/simple_model.py)."""
+    r = np.random.RandomState(seed)
+    return {"input_ids": r.randint(0, vocab_size, (n_samples, seq_len))}

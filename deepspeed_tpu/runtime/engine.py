@@ -365,14 +365,18 @@ class Engine:
         """Device-put host batch with [B] → sharded over data axes; with
         gas>1 reshape leaves to [gas, micro_global, ...]."""
         gas = self.gas if accumulate else 1
+        sp = self.topology.sp_size
+        from ..comm.mesh import SEQ_AXIS
 
         def put(x):
             x = np.asarray(x)
+            # dim after batch is the sequence: shard it over the seq axis
+            seq_entry = (SEQ_AXIS,) if (sp > 1 and x.ndim >= 2) else ()
             if gas > 1:
                 x = x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
-                spec = P(None, (DATA_AXIS, FSDP_AXIS))
+                spec = P(None, (DATA_AXIS, FSDP_AXIS), *seq_entry)
             else:
-                spec = P((DATA_AXIS, FSDP_AXIS))
+                spec = P((DATA_AXIS, FSDP_AXIS), *seq_entry)
             return jax.device_put(x, NamedSharding(self.topology.mesh, spec))
 
         return jax.tree.map(put, batch)
@@ -427,11 +431,36 @@ def initialize(loss_fn: Callable = None,
     """
     cfg = load_config(config)
     if model is not None:
-        loss_fn = loss_fn or model.loss_fn
         params = params if params is not None else model.params
         param_axes = param_axes if param_axes is not None else getattr(
             model, "param_axes", None)
         sharding_rules = sharding_rules or getattr(model, "sharding_rules", None)
+        # sequence parallelism: swap the model's attention for the
+        # Ulysses/ring wrapper over this run's mesh
+        seq_size = max(cfg.mesh.seq, cfg.sequence_parallel.size)
+        pipe_size = max(cfg.mesh.pipe, cfg.pipeline.stages)
+        if loss_fn is None and seq_size > 1 and hasattr(model, "config"):
+            if pipe_size > 1:
+                raise NotImplementedError(
+                    "sequence parallel + pipeline not yet composable")
+            from ..parallel.sequence import make_attention
+            from ..models.transformer import lm_loss_fn
+
+            topology = topology or MeshTopology.build(cfg.mesh)
+            attn = make_attention(topology, cfg.sequence_parallel.mode)
+            loss_fn = lm_loss_fn(model.config, attn)
+        # pipeline parallelism: GPipe loss over the pipe axis
+        if loss_fn is None and pipe_size > 1 and hasattr(model, "config"):
+            from ..parallel.pipeline import make_pipelined_loss_fn
+
+            topology = topology or MeshTopology.build(cfg.mesh)
+            M = cfg.pipeline.num_microbatches or pipe_size
+            kw = {}
+            model_attn = getattr(model, "attention_fn", None)
+            if model_attn is not None:
+                kw["attention_fn"] = model_attn
+            loss_fn = make_pipelined_loss_fn(model.config, topology, M, **kw)
+        loss_fn = loss_fn or model.loss_fn
     if loss_fn is None or params is None:
         raise ValueError("initialize() needs loss_fn+params or model=")
     return Engine(loss_fn=loss_fn, params=params, config=cfg,
