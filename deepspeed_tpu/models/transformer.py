@@ -51,6 +51,7 @@ class TransformerConfig:
     eps: float = 1e-5
     remat: bool = False                       # jax.checkpoint each layer
     remat_policy: str = "nothing"              # nothing|dots|dots_no_batch
+    attention_impl: str = "xla"                # xla | flash (Pallas kernel)
     # --- MoE (reference: deepspeed/moe; presets: mixtral) ----------------
     num_experts: int = 1                      # >1 => every layer is MoE
     moe_top_k: int = 2
@@ -82,6 +83,10 @@ REMAT_POLICIES = {
     "dots": lambda: jax.checkpoint_policies.checkpoint_dots,
     "dots_no_batch": lambda: jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
     "everything": lambda: jax.checkpoint_policies.nothing_saveable,
+    # save flash-attention outputs (its VJP self-recomputes) + non-batch dots
+    "flash": lambda: jax.checkpoint_policies.save_from_both_policies(
+        jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+        jax.checkpoint_policies.save_only_these_names("flash_out")),
 }
 
 
@@ -305,11 +310,15 @@ def rolled_lm_targets(ids, mask=None):
 
 
 def cross_entropy_loss(logits, labels, mask=None):
-    """Next-token LM loss; logits [B,S,V], labels [B,S] (already shifted
-    or raw ids — caller shifts).  fp32 softmax."""
-    logits = logits.astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    """Next-token LM loss; logits [B,S,V], labels [B,S].
+
+    Written as ``lse - target_logit`` with fp32 *reductions* rather than
+    ``log_softmax`` so XLA fuses the bf16→fp32 convert into the reduce and
+    never materializes an fp32 [B,S,V] buffer (6.6 GB for GPT-2 vocab at
+    batch 32·1024 — the difference between fitting in HBM or not)."""
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    tgt = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    nll = lse - tgt.astype(jnp.float32)
     if mask is not None:
         mask = mask.astype(jnp.float32)
         return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
@@ -340,8 +349,14 @@ class Model:
     """Bundles config+params+loss for ``deepspeed_tpu.initialize(model=…)``."""
 
     def __init__(self, cfg: TransformerConfig, seed: int = 0,
-                 attention_fn: Callable = L.causal_attention):
+                 attention_fn: Optional[Callable] = None):
         self.config = cfg
+        if attention_fn is None:
+            if cfg.attention_impl == "flash":
+                from ..ops.flash_attention import flash_attention
+                attention_fn = flash_attention
+            else:
+                attention_fn = L.causal_attention
         self.params, self.param_axes = init_params(cfg, jax.random.PRNGKey(seed))
         self.loss_fn = lm_loss_fn(cfg, attention_fn)
         self.attention_fn = attention_fn

@@ -1,0 +1,345 @@
+"""Flash attention — Pallas TPU kernels.
+
+TPU-native replacement for the reference's fused attention kernels
+(``csrc/transformer/softmax.cu`` + ``attention_softmax_context`` family,
+the triton alternates in ``deepspeed/ops/transformer/inference/triton/``,
+and the training-side fused softmax of ``csrc/transformer``).
+
+Blockwise streaming-softmax attention (Flash-Attention-2 style):
+- forward: grid (B, H, Sq/BQ); per q-block, fori_loop over kv blocks with
+  the causal upper bound, (m, l, o) carried in registers/VMEM, fp32
+  accumulation, bf16 MXU matmuls; saves per-row LSE for backward.
+- backward: recomputation-based two-pass — a dq kernel (grid over
+  q-blocks) and a dkv kernel (grid over kv-blocks, accumulating over the
+  GQA query-head group), with delta = rowsum(dO*O) precomputed.
+
+Memory: O(S·D) per (batch, head) instead of O(S²) — the whole point; the
+attention-probability tensor that forced remat in the XLA path never
+materializes.
+
+Falls back to the XLA softmax-attention path for padding masks, ragged
+block sizes, or non-TPU backends (interpret mode covers CPU tests).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..models.layers import causal_attention
+
+NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ==========================================================================
+# forward
+# ==========================================================================
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                block_q: int, block_k: int, scale: float, causal: bool):
+    i = pl.program_id(2)
+    q = q_ref[0, 0]                                        # [BQ, D] bf16
+    S = k_ref.shape[2]
+    n_k = S // block_k
+    if causal:
+        # blocks whose start <= this q block's last row
+        jmax = jax.lax.div((i + 1) * block_q + block_k - 1, block_k)
+        jmax = jnp.minimum(jmax, n_k)
+    else:
+        jmax = n_k
+
+    D = q_ref.shape[3]
+
+    def body(j, carry):
+        o, m, l = carry
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]    # [BK, D]
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        # bf16 MXU matmul with fp32 accumulation
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # [BQ, BK]
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                              # [BQ, BK]
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [BQ, D]
+        o_new = o * corr + pv
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((block_q, D), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, jmax, body, (o0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (o / l).astype(o_ref.dtype)
+    # 128-lane broadcast keeps the block tileable (Mosaic needs the last
+    # two block dims (8k, 128) or full-size)
+    lse_ref[0, 0] = jnp.broadcast_to(m + jnp.log(l), (block_q, 128))
+
+
+def _fwd(q, k, v, scale: float, causal: bool,
+         block_q: int, block_k: int):
+    """q: [B,H,S,D]; k/v: [B,Hkv,S,D] → (o [B,H,S,D], lse [B,H,S])."""
+    B, H, S, D = q.shape
+    Hkv = k.shape[1]
+    rep = H // Hkv
+    grid = (B, H, S // block_q)
+
+    kv_spec = pl.BlockSpec((1, 1, S, D),
+                           lambda b, h, i: (b, h // rep, 0, 0),
+                           memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
+                          scale=scale, causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            kv_spec, kv_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, 128), lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S, 128), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(q, k, v)
+    return out[0], out[1]
+
+
+# ==========================================================================
+# backward
+# ==========================================================================
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               block_q: int, block_k: int, scale: float, causal: bool):
+    i = pl.program_id(2)
+    q = q_ref[0, 0]                                        # [BQ, D] bf16
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0][:, :1]                             # [BQ, 1] f32
+    delta = delta_ref[0, 0][:, :1]
+    S = k_ref.shape[2]
+    n_k = S // block_k
+    if causal:
+        jmax = jnp.minimum(
+            jax.lax.div((i + 1) * block_q + block_k - 1, block_k), n_k)
+    else:
+        jmax = n_k
+
+    def body(j, dq):
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                               # [BQ, BK]
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(k.dtype)
+        return dq + jax.lax.dot_general(
+            ds, k, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    D = q_ref.shape[3]
+    dq = jax.lax.fori_loop(0, jmax,
+                           body, jnp.zeros((block_q, D), jnp.float32))
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, block_q: int, block_k: int,
+                scale: float, causal: bool, rep: int):
+    j = pl.program_id(2)
+    k = k_ref[0, 0]                                        # [BK, D] bf16
+    v = v_ref[0, 0]
+    Sq = q_ref.shape[3]                                    # q_ref [1,1,rep,S,D]
+    n_q = Sq // block_q
+    D = k_ref.shape[3]
+
+    dk0 = jnp.zeros((block_k, D), jnp.float32)
+    dv0 = jnp.zeros((block_k, D), jnp.float32)
+
+    def head_loop(r, carry):
+        dk, dv = carry
+        if causal:
+            imin = jax.lax.div(j * block_k, block_q)
+        else:
+            imin = 0
+
+        def body(i, carry):
+            dk, dv = carry
+            q = q_ref[0, 0, r, pl.ds(i * block_q, block_q), :]  # [BQ, D]
+            do = do_ref[0, 0, r, pl.ds(i * block_q, block_q), :]
+            lse = lse_ref[0, 0, r, pl.ds(i * block_q, block_q), :1]
+            delta = delta_ref[0, 0, r, pl.ds(i * block_q, block_q), :1]
+            s = jax.lax.dot_general(
+                q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [BQ, BK]
+            if causal:
+                rows = i * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                cols = j * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(rows >= cols, s, NEG_INF)
+            p = jnp.exp(s - lse)
+            dv = dv + jax.lax.dot_general(
+                p.astype(do.dtype), do,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)         # [BK, D]
+            dp = jax.lax.dot_general(
+                do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)         # [BQ, BK]
+            ds = (p * (dp - delta)).astype(q.dtype)
+            dk = dk + jax.lax.dot_general(
+                ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return dk, dv
+
+        return jax.lax.fori_loop(imin, n_q, body, (dk, dv))
+
+    dk, dv = jax.lax.fori_loop(0, rep, head_loop, (dk0, dv0))
+    # s = scale·qkᵀ ⇒ dk = scale·dsᵀq (q enters the matmul unscaled)
+    dk_ref[0, 0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, scale: float, causal: bool,
+         block_q: int, block_k: int):
+    B, H, S, D = q.shape
+    Hkv = k.shape[1]
+    rep = H // Hkv
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)
+    delta = jnp.broadcast_to(delta[..., None], (B, H, S, 128))
+
+    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h // rep, 0, 0),
+                           memory_space=pltpu.VMEM)
+    vec_spec = pl.BlockSpec((1, 1, block_q, 128), lambda b, h, i: (b, h, i, 0),
+                            memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_q=block_q, block_k=block_k,
+                          scale=scale, causal=causal),
+        grid=(B, H, S // block_q),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, vec_spec, vec_spec],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct((B, H, S, D), q.dtype)],
+        interpret=_use_interpret(),
+    )(q, k, v, do, lse, delta)[0]
+
+    # dkv: grid over kv blocks; q/do/lse/delta views grouped by kv head
+    qg = q.reshape(B, Hkv, rep, S, D)
+    dog = do.reshape(B, Hkv, rep, S, D)
+    lseg = lse.reshape(B, Hkv, rep, S, 128)
+    deltag = delta.reshape(B, Hkv, rep, S, 128)
+
+    kv_blk_spec = pl.BlockSpec((1, 1, block_k, D),
+                               lambda b, h, j: (b, h, j, 0),
+                               memory_space=pltpu.VMEM)
+    qg_spec = pl.BlockSpec((1, 1, rep, S, D),
+                           lambda b, h, j: (b, h, 0, 0, 0),
+                           memory_space=pltpu.VMEM)
+    vg_spec = pl.BlockSpec((1, 1, rep, S, 128),
+                           lambda b, h, j: (b, h, 0, 0, 0),
+                           memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, block_k=block_k,
+                          scale=scale, causal=causal, rep=rep),
+        grid=(B, Hkv, S // block_k),
+        in_specs=[qg_spec, kv_blk_spec, kv_blk_spec, qg_spec, vg_spec,
+                  vg_spec],
+        out_specs=[kv_blk_spec, kv_blk_spec],
+        out_shape=[jax.ShapeDtypeStruct((B, Hkv, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, Hkv, S, D), v.dtype)],
+        interpret=_use_interpret(),
+    )(qg, k, v, dog, lseg, deltag)
+    return dq, dk, dv
+
+
+# ==========================================================================
+# public API (custom VJP)
+# ==========================================================================
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    o, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    o, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, mask: Optional[jnp.ndarray] = None,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    causal: bool = True):
+    """Drop-in ``attention_fn`` ([B, S, H, D] layout, GQA k/v allowed).
+
+    Falls back to the XLA path when a padding mask is supplied or the
+    sequence doesn't tile evenly (the reference keeps an unfused python
+    softmax path the same way)."""
+    B, S, H, D = q.shape
+    bq, bk = min(block_q, S), min(block_k, S)
+    # VMEM guard: the current kernels pin K/V (and the dkv pass q/do per
+    # GQA group) wholly in VMEM; beyond ~10MB fall back to XLA.  The
+    # blocked-KV-through-grid variant lifts this cap (planned).
+    rep = H // k.shape[2] if k.shape[2] else 1
+    itemsize = jnp.dtype(q.dtype).itemsize
+    vmem_est = (2 + 2 * rep) * S * D * itemsize
+    if (mask is not None or S % bq or S % bk or (H % k.shape[2])
+            or vmem_est > 10 * 1024 * 1024):
+        return causal_attention(q, k, v, mask=mask, scale=scale)
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    qt = q.transpose(0, 2, 1, 3)                   # [B, H, S, D]
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    o = _flash(qt, kt, vt, float(scale), causal, bq, bk)
+    # named so the 'flash' remat policy saves it: flash's custom VJP already
+    # recomputes attention internally — replaying the forward kernel under
+    # jax.checkpoint would recompute it twice
+    from jax.ad_checkpoint import checkpoint_name
+
+    o = checkpoint_name(o, "flash_out")
+    return o.transpose(0, 2, 1, 3)

@@ -42,7 +42,8 @@ from ..models.transformer import (TransformerConfig, block_apply,
 
 def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
                            num_microbatches: int,
-                           attention_fn: Callable = L.causal_attention):
+                           attention_fn: Callable = L.causal_attention,
+                           schedule: str = "gpipe"):
     """Build ``loss_fn(params, batch, rng)`` running the GPipe schedule.
 
     Requirements: ``num_layers % pipe == 0``; the global micro-batch (the
@@ -56,6 +57,10 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
                          f"pipe stages {S}")
     if cfg.num_experts > 1:
         raise NotImplementedError("pipeline + MoE not yet supported")
+    if schedule not in ("gpipe", "1f1b"):
+        raise NotImplementedError(f"pipeline schedule {schedule!r}; "
+                                  "'gpipe' is implemented ('1f1b' runs as "
+                                  "gpipe — same math, more live memory)")
 
     norm = _norm(cfg)
 
@@ -132,10 +137,12 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
                                                keepdims=False)
                 msk = lax.dynamic_index_in_dim(mask_mb, t_out, 0,
                                                keepdims=False)
-                logits32 = logits.astype(jnp.float32)
-                logp = jax.nn.log_softmax(logits32, axis=-1)
-                nll = -jnp.take_along_axis(logp, lbl[..., None],
-                                           axis=-1)[..., 0]
+                # lse - target_logit form: no fp32 [mb,seq,V] buffer
+                # (same rationale as cross_entropy_loss)
+                lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+                tgt = jnp.take_along_axis(logits, lbl[..., None],
+                                          axis=-1)[..., 0]
+                nll = lse - tgt.astype(jnp.float32)
                 valid = last & (t >= S - 1)
                 contrib = jnp.where(valid, (nll * msk).sum(), 0.0)
                 toks = jnp.where(valid, msk.sum(), 0.0)
@@ -148,9 +155,12 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
             (_, loss_sum, tok_sum), _ = lax.scan(
                 tick, (buf0, jnp.float32(0.0), jnp.float32(0.0)),
                 jnp.arange(T))
-            # broadcast the last stage's loss to every stage
-            loss_sum = lax.psum(loss_sum, PIPE_AXIS)
-            tok_sum = lax.psum(tok_sum, PIPE_AXIS)
+            # reduce over the pipe axis (only the last stage contributed)
+            # AND the batch axes — each data/fsdp shard saw different
+            # samples, and the global loss is sum/sum, not shard 0's mean
+            axes = (PIPE_AXIS,) + tuple(BATCH_AXES)
+            loss_sum = lax.psum(loss_sum, axes)
+            tok_sum = lax.psum(tok_sum, axes)
             return loss_sum / jnp.maximum(tok_sum, 1.0)
 
         blocks = params["blocks"]

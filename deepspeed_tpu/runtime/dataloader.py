@@ -54,10 +54,13 @@ class DataLoader:
         # process-sharded: each host reads its interleaved slice of every
         # global batch (rank striding like the reference sampler)
         pc, pi = jax.process_count(), jax.process_index()
-        per_proc = self.batch_size // pc if self.batch_size % pc == 0 else None
+        if self.batch_size % pc:
+            raise ValueError(
+                f"batch_size {self.batch_size} not divisible by "
+                f"process_count {pc}")
         for step in range(len(self)):
             sel = order[step * self.batch_size:(step + 1) * self.batch_size]
-            if per_proc is not None and pc > 1:
+            if pc > 1:
                 sel = sel[pi::pc]
             batch = {k: v[sel] for k, v in self.data.items()}
             if self.batch_fn is not None:

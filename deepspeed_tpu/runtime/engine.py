@@ -447,7 +447,9 @@ def initialize(loss_fn: Callable = None,
             from ..models.transformer import lm_loss_fn
 
             topology = topology or MeshTopology.build(cfg.mesh)
-            attn = make_attention(topology, cfg.sequence_parallel.mode)
+            base = getattr(model, "attention_fn", None)
+            attn = make_attention(topology, cfg.sequence_parallel.mode,
+                                  **({"base_attention": base} if base else {}))
             loss_fn = lm_loss_fn(model.config, attn)
         # pipeline parallelism: GPipe loss over the pipe axis
         if loss_fn is None and pipe_size > 1 and hasattr(model, "config"):
@@ -455,7 +457,7 @@ def initialize(loss_fn: Callable = None,
 
             topology = topology or MeshTopology.build(cfg.mesh)
             M = cfg.pipeline.num_microbatches or pipe_size
-            kw = {}
+            kw = {"schedule": cfg.pipeline.schedule}
             model_attn = getattr(model, "attention_fn", None)
             if model_attn is not None:
                 kw["attention_fn"] = model_attn
