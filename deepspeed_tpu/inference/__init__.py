@@ -1,0 +1,7 @@
+from .engine import InferenceConfig, InferenceEngine
+from .sampler import SamplingParams, sample
+from .ragged.state import KVCacheConfig, StateManager, RaggedBatch
+from .ragged.allocator import BlockedAllocator
+
+__all__ = ["InferenceConfig", "InferenceEngine", "SamplingParams", "sample",
+           "KVCacheConfig", "StateManager", "RaggedBatch", "BlockedAllocator"]

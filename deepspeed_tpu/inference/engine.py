@@ -1,0 +1,223 @@
+"""Serving engine: continuous ragged batching with Dynamic SplitFuse.
+
+TPU-native re-design of the reference inference engines
+(``InferenceEngineV2.put/query/flush`` engine_v2.py:107/158/242,
+schedulability checks ``can_schedule`` :184 + ``scheduling_utils.py``;
+v1 ``deepspeed.init_inference`` engine.py:41 is subsumed — there is no
+kernel-injection step because models are born with fused TPU kernels).
+
+Dynamic SplitFuse (the FastGen scheduling insight,
+blogs/deepspeed-fastgen): every step runs a FIXED token budget mixing
+decode tokens (1/seq) with prompt chunks.  On TPU this is doubly right:
+the forward is compiled once for [budget] and never re-specializes.
+
+API:
+    eng = InferenceEngine(model, InferenceConfig(...))
+    eng.put(uid, prompt_tokens)      # enqueue / continue a request
+    out = eng.step()                 # one SplitFuse step -> {uid: token}
+    eng.generate(prompts, sampling)  # convenience loop
+    eng.flush(uid)                   # free a finished sequence
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import Model, TransformerConfig
+from ..utils.logging import logger
+from .model import ragged_forward
+from .ragged.state import KVCacheConfig, RaggedBatch, StateManager
+from .sampler import SamplingParams, sample
+
+
+@dataclasses.dataclass
+class InferenceConfig:
+    """(reference: RaggedInferenceEngineConfig inference/v2/config_v2.py —
+    DSStateManagerConfig: max_ragged_batch_size/token budget,
+    memory_config num blocks)."""
+    token_budget: int = 256          # tokens per step (SplitFuse budget)
+    max_seqs: int = 8                # concurrent sequences
+    kv_block_size: int = 64
+    num_kv_blocks: int = 256         # pool size
+    max_seq_len: Optional[int] = None   # default: model max
+    kv_dtype: object = jnp.bfloat16
+    param_dtype: object = jnp.bfloat16
+
+
+class InferenceEngine:
+    def __init__(self, model: Model, config: InferenceConfig = None):
+        self.model = model
+        self.cfg: TransformerConfig = model.config
+        self.icfg = config or InferenceConfig()
+        max_len = self.icfg.max_seq_len or self.cfg.max_seq_len
+        # a sequence can never hold more blocks than the pool has
+        self.max_blocks_per_seq = min(-(-max_len // self.icfg.kv_block_size),
+                                      self.icfg.num_kv_blocks)
+        kv_cfg = KVCacheConfig(
+            num_layers=self.cfg.num_layers,
+            num_kv_heads=self.cfg.num_kv_heads,
+            head_dim=self.cfg.head_dim,
+            block_size=self.icfg.kv_block_size,
+            num_blocks=self.icfg.num_kv_blocks,
+            dtype=self.icfg.kv_dtype)
+        self.state = StateManager(kv_cfg, max_seqs=self.icfg.max_seqs,
+                                  max_blocks_per_seq=self.max_blocks_per_seq)
+        self.params = jax.tree.map(
+            lambda x: x.astype(self.icfg.param_dtype)
+            if x.dtype == jnp.float32 else x, model.params)
+        self._pending: Dict[int, List[int]] = {}   # uid -> unprocessed toks
+        self._ctx_exhausted: set = set()
+        self._rng = jax.random.PRNGKey(0)
+        self._step_fn = None
+
+    # ------------------------------------------------------------------
+    def _build_step(self):
+        cfg = self.cfg
+        bs = self.icfg.kv_block_size
+        mbs = self.max_blocks_per_seq
+
+        def step(params, kv, batch: RaggedBatch):
+            return ragged_forward(cfg, params, kv, batch, bs, mbs)
+
+        return jax.jit(step, donate_argnums=(1,))
+
+    # ------------------------------------------------------------------
+    # request API (reference: engine_v2.put :107)
+    # ------------------------------------------------------------------
+    def put(self, uid: int, tokens: Sequence[int]) -> None:
+        self._pending.setdefault(uid, []).extend(int(t) for t in tokens)
+
+    def flush(self, uid: int) -> None:
+        """(reference: engine_v2.flush :242)."""
+        self._pending.pop(uid, None)
+        self.state.release(uid)
+
+    def query(self, uid: int) -> Dict:
+        """(reference: engine_v2.query :158)."""
+        seq = self.state.seqs.get(uid)
+        return {
+            "pending_tokens": len(self._pending.get(uid, [])),
+            "seen_tokens": seq.seen_tokens if seq else 0,
+            "generated": list(seq.tokens) if seq else [],
+            "max_context": self.max_blocks_per_seq * self.icfg.kv_block_size,
+        }
+
+    # ------------------------------------------------------------------
+    def _schedule(self) -> List[tuple]:
+        """Dynamic SplitFuse: pack the fixed token budget — decode tokens
+        first (latency), then prompt chunks (throughput) — while
+        *reserving* KV blocks and slots as requests are admitted so the
+        collective admission can never exceed the pool
+        (reference: can_schedule engine_v2.py:184 + SchedulingResult)."""
+        budget = self.icfg.token_budget
+        free_blocks = self.state.allocator.free_blocks
+        free_slots = len(self.state._free_slots)
+        bs = self.icfg.kv_block_size
+        sched: List[tuple] = []
+
+        def admit(uid, toks):
+            nonlocal budget, free_blocks, free_slots
+            seq = self.state.seqs.get(uid)
+            ctx_rem = self.state.context_remaining(uid)
+            if ctx_rem <= 0:
+                self._ctx_exhausted.add(uid)
+                return
+            n = min(len(toks), budget, ctx_rem)
+            needs_slot = seq is None or uid not in self.state._slots
+            if needs_slot and free_slots <= 0:
+                return
+            while n > 0:
+                seen = seq.seen_tokens if seq else 0
+                have = len(seq.blocks) if seq else 0
+                need = max(0, -(-(seen + n) // bs) - have)
+                if need <= free_blocks:
+                    break
+                n //= 2
+            if n <= 0:
+                return
+            sched.append((uid, toks[:n]))
+            del toks[:n]
+            budget -= n
+            free_blocks -= need
+            if needs_slot:
+                free_slots -= 1
+
+        pending = [(uid, t) for uid, t in self._pending.items() if t]
+        # decode requests (continuing sequences, single token) first
+        decodes = [p for p in pending
+                   if len(p[1]) == 1 and p[0] in self.state.seqs]
+        prefills = [p for p in pending if p not in decodes]
+        for uid, toks in decodes + prefills:
+            if budget <= 0:
+                break
+            admit(uid, toks)
+        return sched
+
+    def step(self, rng: Optional[jax.Array] = None,
+             sampling: SamplingParams = SamplingParams()) -> Dict[int, int]:
+        """Run one engine step; returns {uid: next_token} for sequences
+        whose last pending token was consumed (i.e. ready to sample)."""
+        sched = self._schedule()
+        if not sched:
+            return {}
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        batch = self.state.build_batch(sched, self.icfg.token_budget)
+        logits, self.state.kv = self._step_fn(self.params, self.state.kv,
+                                              batch)
+        if rng is None and sampling.temperature > 0.0:
+            self._rng, rng = jax.random.split(self._rng)
+        toks = sample(logits, sampling, rng)
+        out: Dict[int, int] = {}
+        toks_np = np.asarray(toks)
+        for uid, scheduled in sched:
+            if self._pending.get(uid):
+                continue                       # prompt not fully ingested
+            slot = self.state.slot(uid)
+            tok = int(toks_np[slot])
+            self.state.seqs[uid].tokens.append(tok)
+            out[uid] = tok
+        return out
+
+    # ------------------------------------------------------------------
+    def generate(self, prompts: Dict[int, Sequence[int]],
+                 sampling: SamplingParams = SamplingParams(),
+                 rng: Optional[jax.Array] = None) -> Dict[int, List[int]]:
+        """Convenience loop: run all prompts to max_new_tokens/stop."""
+        for uid, p in prompts.items():
+            self.put(uid, p)
+        done: Dict[int, List[int]] = {uid: [] for uid in prompts}
+        active = set(prompts)
+        i = 0
+        while active:
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            out = self.step(rng=sub, sampling=sampling)
+            # sequences that hit the context limit end their generation
+            for uid in list(self._ctx_exhausted):
+                if uid in active:
+                    active.discard(uid)
+                    self.flush(uid)
+                self._ctx_exhausted.discard(uid)
+            for uid, tok in out.items():
+                if uid not in active:
+                    continue
+                done[uid].append(tok)
+                stop = (sampling.stop_token is not None
+                        and tok == sampling.stop_token)
+                if stop or len(done[uid]) >= sampling.max_new_tokens:
+                    active.discard(uid)
+                    self.flush(uid)
+                else:
+                    self.put(uid, [tok])
+            i += 1
+            if i > 100_000:
+                raise RuntimeError("generate() did not terminate")
+        return done

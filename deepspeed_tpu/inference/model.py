@@ -1,0 +1,159 @@
+"""Ragged-batch model forward with paged KV cache.
+
+TPU-native analog of the reference's FastGen model layer
+(``inference/v2/model_implementations/inference_transformer_base.py:48``
+building per-layer DSModules, and the ragged kernel suite
+``linear_blocked_kv_rotary`` (QKV+rotary written straight into paged KV),
+``blocked_flash`` (paged attention over block tables), ``ragged_embed``,
+``logits_gather`` (last-token-only unembed) — SURVEY §2.2/§3.4).
+
+One jit-compiled function processes a fixed token budget T of mixed
+prefill/decode tokens (Dynamic SplitFuse's fixed-shape forward is exactly
+XLA-friendly):
+  embed [T] → per layer: qkv + rope(positions) → scatter K/V into the
+  paged cache → per-token attention over the owning sequence's block
+  table → mlp/moe → final norm → unembed only at each sequence's last
+  scheduled token.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models import layers as L
+from ..models.transformer import TransformerConfig, _norm
+from .ragged.state import RaggedBatch
+
+
+def _write_kv(kv_layer, k, v, batch: RaggedBatch, block_size: int):
+    """Scatter per-token K/V into the paged cache.
+
+    kv_layer: [blocks, bs, 2, Hkv, D]; k/v: [T, Hkv, D]
+    (reference kernel: linear_blocked_kv_rotary / linear_kv_copy).
+    """
+    blk = batch.block_tables[batch.seq_slot,
+                             batch.positions // block_size]      # [T]
+    # budget-padding tokens write to the trash block (last row) so they
+    # can never clobber a live sequence's KV
+    trash = kv_layer.shape[0] - 1
+    blk = jnp.where(batch.token_valid, blk, trash)
+    off = batch.positions % block_size                           # [T]
+    kv_layer = kv_layer.at[blk, off, 0].set(k)
+    kv_layer = kv_layer.at[blk, off, 1].set(v)
+    return kv_layer
+
+
+def _paged_attention(kv_layer, q, batch: RaggedBatch, block_size: int,
+                     max_blocks_per_seq: int, scale: float):
+    """Per-token attention over the owning sequence's context
+    (reference kernel: blocked_flash / flash_attn_by_atoms).
+
+    q: [T, H, D] → out [T, H, D].  XLA formulation: gather each token's
+    block table (bounded by max_blocks_per_seq), mask by position.  The
+    Pallas double-buffered variant drops in behind the same signature.
+    """
+    T, H, D = q.shape
+    Hkv = kv_layer.shape[3]
+    rep = H // Hkv
+    C = max_blocks_per_seq * block_size
+
+    tables = batch.block_tables[batch.seq_slot, :max_blocks_per_seq]  # [T, nb]
+    ctx = kv_layer[tables]            # [T, nb, bs, 2, Hkv, D]
+    ctx = ctx.reshape(T, C, 2, Hkv, D)
+    k_ctx, v_ctx = ctx[:, :, 0], ctx[:, :, 1]                     # [T, C, Hkv, D]
+
+    qg = q.reshape(T, Hkv, rep, D)
+    s = jnp.einsum("thrd,tchd->thrc", qg, k_ctx).astype(jnp.float32) * scale
+    cols = jnp.arange(C)[None, :]                                  # [1, C]
+    valid = cols <= batch.positions[:, None]                       # [T, C]
+    s = jnp.where(valid[:, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    o = jnp.einsum("thrc,tchd->thrd", p, v_ctx)
+    return o.reshape(T, H, D)
+
+
+def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
+                   block_size: int, max_blocks_per_seq: int,
+                   rng: Optional[jax.Array] = None
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """→ (last_token_logits [max_seqs, vocab], new_kv).
+
+    ``kv``: [L, blocks, bs, 2, Hkv, D].  Rows of the logits output whose
+    ``batch.logits_idx`` is -1 are garbage (callers mask by it).
+    """
+    dt = params["embed"]["table"].dtype
+    norm = _norm(cfg)
+    act = L.ACTIVATIONS[cfg.activation]
+    scale = 1.0 / (cfg.head_dim ** 0.5)
+
+    x = L.embed(params["embed"], batch.token_ids).astype(dt)       # [T, dm]
+    if cfg.position == "learned":
+        x = x + params["pos_embed"]["table"][batch.positions].astype(dt)
+        cos = sin = None
+    else:
+        cos, sin = L.rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+
+    def block(x, xs):
+        lp, kv_layer = xs
+        ap = lp["attn"]
+        h = norm(lp["ln1"], x)
+        q = jnp.einsum("td,dhk->thk", h, ap["wq"].astype(dt))
+        k = jnp.einsum("td,dhk->thk", h, ap["wk"].astype(dt))
+        v = jnp.einsum("td,dhk->thk", h, ap["wv"].astype(dt))
+        if cfg.attn_bias:
+            q = q + ap["bq"].astype(dt)
+            k = k + ap["bk"].astype(dt)
+            v = v + ap["bv"].astype(dt)
+        if cfg.position == "rope":
+            # apply_rope expects [B, S, H, D]; use B=1 with per-token pos
+            pos = batch.positions[None]
+            q = L.apply_rope(q[None], cos, sin, positions=pos)[0]
+            k = L.apply_rope(k[None], cos, sin, positions=pos)[0]
+        kv_layer = _write_kv(kv_layer, k, v, batch, block_size)
+        o = _paged_attention(kv_layer, q, batch, block_size,
+                             max_blocks_per_seq, scale)
+        o = jnp.einsum("thk,hkd->td", o, ap["wo"].astype(dt))
+        if cfg.attn_bias:
+            o = o + ap["bo"].astype(dt)
+        x = x + o
+
+        h = norm(lp["ln2"], x)
+        if cfg.num_experts > 1:
+            from ..parallel import moe as M
+
+            d, _ = M.moe_ffn(lp["gate"], lp["experts"], h[None],
+                             top_k=cfg.moe_top_k,
+                             capacity_factor=cfg.eval_capacity_factor,
+                             min_capacity=cfg.min_capacity,
+                             activation=act, gated=cfg.gated_mlp)
+            d = d[0]
+        else:
+            mp = lp["mlp"]
+            u = h @ mp["wi"].astype(dt)
+            if cfg.mlp_bias:
+                u = u + mp["bi"].astype(dt)
+            if cfg.gated_mlp:
+                u = act(h @ mp["wg"].astype(dt)) * u
+            else:
+                u = act(u)
+            d = u @ mp["wo"].astype(dt)
+            if cfg.mlp_bias:
+                d = d + mp["bo"].astype(dt)
+        return x + d, kv_layer
+
+    x, new_kv = jax.lax.scan(block, x, (params["blocks"], kv))
+
+    # logits only at each sequence's last scheduled token
+    # (reference kernel: gather_for_logits / logits_gather)
+    idx = jnp.maximum(batch.logits_idx, 0)
+    last = x[idx]                                                  # [S, dm]
+    last = norm(params["ln_f"], last)
+    if cfg.tie_embeddings:
+        logits = last @ params["embed"]["table"].astype(dt).T
+    else:
+        logits = last @ params["lm_head"]["kernel"].astype(dt)
+    return logits.astype(jnp.float32), new_kv

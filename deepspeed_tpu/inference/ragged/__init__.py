@@ -1,0 +1,5 @@
+from .allocator import BlockedAllocator
+from .state import KVCacheConfig, RaggedBatch, SequenceDescriptor, StateManager
+
+__all__ = ["BlockedAllocator", "KVCacheConfig", "RaggedBatch",
+           "SequenceDescriptor", "StateManager"]

@@ -1,0 +1,193 @@
+"""Ragged inference state: sequence descriptors + paged KV cache + batch
+metadata.
+
+TPU-native re-design of the reference's ragged subsystem
+(``inference/v2/ragged/``): ``DSSequenceDescriptor``
+(sequence_descriptor.py, 280 LoC), ``BlockedKVCache`` (kv_cache.py, 208),
+``DSStateManager`` (ragged_manager.py), ``RaggedBatchWrapper``
+(ragged_wrapper.py, 292 — pinned host-staged batch metadata).
+
+Differences forced/afforded by XLA:
+* the KV cache is one jnp array [L, num_blocks, block_size, 2, Hkv, D]
+  updated functionally with scatter (donated across steps — in-place in
+  practice);
+* batch metadata is a fixed-shape numpy struct (XLA needs static shapes —
+  the reference's pinned "fast host buffer" maps to plain numpy staged
+  via device_put, its variable batch to padding up to the token budget).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .allocator import BlockedAllocator
+
+
+@dataclasses.dataclass
+class KVCacheConfig:
+    num_layers: int
+    num_kv_heads: int
+    head_dim: int
+    block_size: int = 64
+    num_blocks: int = 128
+    dtype: object = jnp.bfloat16
+
+    @property
+    def max_context(self) -> int:
+        return self.num_blocks * self.block_size
+
+
+@dataclasses.dataclass
+class SequenceDescriptor:
+    """(reference: DSSequenceDescriptor sequence_descriptor.py)."""
+    uid: int
+    seen_tokens: int = 0                       # tokens already in KV
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    tokens: List[int] = dataclasses.field(default_factory=list)  # generated
+
+    def blocks_needed(self, new_tokens: int, block_size: int) -> int:
+        total = self.seen_tokens + new_tokens
+        needed = -(-total // block_size)       # ceil
+        return max(0, needed - len(self.blocks))
+
+
+class RaggedBatch(NamedTuple):
+    """Fixed-shape device view of one engine step (the RaggedBatchWrapper
+    analog).  All arrays are padded to (token_budget, max_seqs)."""
+    token_ids: jnp.ndarray       # [T] i32
+    positions: jnp.ndarray       # [T] i32, position within its sequence
+    seq_slot: jnp.ndarray        # [T] i32, row into block_tables
+    token_valid: jnp.ndarray     # [T] bool, False for budget padding
+    block_tables: jnp.ndarray    # [max_seqs, max_blocks] i32; -1 pad
+                                 # (wraps to the trash row on gather)
+    context_lens: jnp.ndarray    # [max_seqs] i32, ctx len AFTER this step
+    logits_idx: jnp.ndarray      # [max_seqs] i32, flat idx of each seq's
+                                 # last token this step (-1 if none)
+    n_tokens: int                # real token count (static python int)
+    n_seqs: int
+
+
+class StateManager:
+    """Owns allocator + sequence table + the paged KV cache
+    (reference: DSStateManager ragged_manager.py)."""
+
+    def __init__(self, cfg: KVCacheConfig, max_seqs: int = 16,
+                 max_blocks_per_seq: Optional[int] = None):
+        self.cfg = cfg
+        self.max_seqs = max_seqs
+        self.max_blocks_per_seq = max_blocks_per_seq or cfg.num_blocks
+        self.allocator = BlockedAllocator(cfg.num_blocks)
+        self.seqs: Dict[int, SequenceDescriptor] = {}
+        self._slots: Dict[int, int] = {}       # uid -> batch row
+        self._free_slots = list(range(max_seqs))
+        # paged KV: [L, blocks+1, block_size, 2, Hkv, D] — the extra row is
+        # the trash block that padding tokens' KV writes are routed to
+        self.kv = jnp.zeros(
+            (cfg.num_layers, cfg.num_blocks + 1, cfg.block_size, 2,
+             cfg.num_kv_heads, cfg.head_dim), cfg.dtype)
+
+    # ---- sequence lifecycle ---------------------------------------------
+    def get_or_create(self, uid: int) -> SequenceDescriptor:
+        if uid not in self.seqs:
+            if not self._free_slots:
+                raise RuntimeError("No free sequence slots")
+            self.seqs[uid] = SequenceDescriptor(uid=uid)
+            self._slots[uid] = self._free_slots.pop(0)
+        return self.seqs[uid]
+
+    def slot(self, uid: int) -> int:
+        return self._slots[uid]
+
+    def release(self, uid: int) -> None:
+        """(reference: flush engine_v2.py:242)."""
+        seq = self.seqs.pop(uid, None)
+        if seq is None:
+            return
+        if seq.blocks:
+            self.allocator.free(seq.blocks)
+        self._free_slots.append(self._slots.pop(uid))
+
+    # ---- scheduling query ------------------------------------------------
+    @property
+    def max_context_tokens(self) -> int:
+        return self.max_blocks_per_seq * self.cfg.block_size
+
+    def context_remaining(self, uid: int) -> int:
+        seq = self.seqs.get(uid)
+        seen = seq.seen_tokens if seq else 0
+        return self.max_context_tokens - seen
+
+    def can_schedule(self, uid: int, new_tokens: int) -> bool:
+        """(reference: can_schedule engine_v2.py:184)."""
+        seq = self.seqs.get(uid) or SequenceDescriptor(uid=uid)
+        need = seq.blocks_needed(new_tokens, self.cfg.block_size)
+        slot_ok = uid in self._slots or bool(self._free_slots)
+        return (need <= self.allocator.free_blocks and slot_ok
+                and new_tokens <= self.context_remaining(uid))
+
+    # ---- batch building --------------------------------------------------
+    def build_batch(self, requests: List[tuple], token_budget: int
+                    ) -> RaggedBatch:
+        """requests: [(uid, list_of_new_token_ids)]; allocates KV blocks and
+        produces the padded device metadata."""
+        max_blocks = self.cfg.num_blocks
+        T = token_budget
+        token_ids = np.zeros(T, np.int32)
+        positions = np.zeros(T, np.int32)
+        seq_slot = np.full(T, 0, np.int32)
+        # -1 pad: negative gather wraps to the KV array's last row, which
+        # is the zeroed trash block — padded columns can never alias a
+        # live block (they are also masked by position)
+        block_tables = np.full((self.max_seqs, max_blocks), -1, np.int32)
+        context_lens = np.zeros(self.max_seqs, np.int32)
+        logits_idx = np.full(self.max_seqs, -1, np.int32)
+
+        # keep existing sequences' tables valid even if not in this batch
+        for uid, seq in self.seqs.items():
+            s = self._slots[uid]
+            block_tables[s, :len(seq.blocks)] = seq.blocks
+            context_lens[s] = seq.seen_tokens
+
+        cursor = 0
+        n_seqs = 0
+        for uid, new_tokens in requests:
+            n = len(new_tokens)
+            if n == 0:
+                continue
+            if cursor + n > T:
+                raise ValueError(f"token budget {T} exceeded")
+            seq = self.get_or_create(uid)
+            if n > self.context_remaining(uid):
+                raise ValueError(
+                    f"uid {uid}: {n} new tokens exceed remaining context "
+                    f"({self.context_remaining(uid)} of "
+                    f"{self.max_context_tokens})")
+            need = seq.blocks_needed(n, self.cfg.block_size)
+            if need:
+                seq.blocks.extend(self.allocator.allocate(need))
+            s = self._slots[uid]
+            block_tables[s, :len(seq.blocks)] = seq.blocks
+            token_ids[cursor:cursor + n] = new_tokens
+            positions[cursor:cursor + n] = np.arange(
+                seq.seen_tokens, seq.seen_tokens + n)
+            seq_slot[cursor:cursor + n] = s
+            seq.seen_tokens += n
+            context_lens[s] = seq.seen_tokens
+            logits_idx[s] = cursor + n - 1
+            cursor += n
+            n_seqs += 1
+
+        return RaggedBatch(
+            token_ids=jnp.asarray(token_ids),
+            positions=jnp.asarray(positions),
+            seq_slot=jnp.asarray(seq_slot),
+            token_valid=jnp.asarray(np.arange(T) < cursor),
+            block_tables=jnp.asarray(block_tables),
+            context_lens=jnp.asarray(context_lens),
+            logits_idx=jnp.asarray(logits_idx),
+            n_tokens=cursor, n_seqs=n_seqs)

@@ -1,0 +1,46 @@
+"""Token samplers (greedy / temperature / top-k / top-p).
+
+The reference delegates sampling to MII / HF ``generate``; a serving
+engine needs one in-repo, so this is a small jit-safe sampler family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 0.0          # 0 => greedy
+    top_k: int = 0                    # 0 => disabled
+    top_p: float = 1.0                # 1.0 => disabled
+    max_new_tokens: int = 64
+    stop_token: Optional[int] = None
+
+
+def sample(logits: jnp.ndarray, params: SamplingParams,
+           rng: Optional[jax.Array] = None) -> jnp.ndarray:
+    """logits [S, V] → token ids [S]."""
+    if params.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if rng is None:
+        raise ValueError("temperature sampling requires an rng key "
+                         "(the engine supplies one automatically)")
+    logits = logits / params.temperature
+    if params.top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -params.top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if params.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set with cumulative prob >= top_p; keep at least 1
+        cutoff_idx = jnp.sum(cum < params.top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None],
+                                     axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

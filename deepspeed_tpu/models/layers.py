@@ -101,11 +101,11 @@ def apply_rope(x, cos, sin, positions=None):
 # --------------------------------------------------------------------------
 
 def causal_attention(q, k, v, mask: Optional[jnp.ndarray] = None,
-                     scale: Optional[float] = None):
+                     scale: Optional[float] = None, causal: bool = True):
     """q: [B, S, H, D]; k/v: [B, Sk, Hkv, D].  GQA via grouped einsum — KV
     are never materialized at full head count, preserving the memory GQA
     exists to save.  Softmax in fp32 for stability; XLA fuses the block
-    onto the MXU."""
+    onto the MXU.  ``causal=False`` gives bidirectional attention."""
     B, S, H, D = q.shape
     Sk, Hkv = k.shape[1], k.shape[2]
     rep = H // Hkv
@@ -113,8 +113,9 @@ def causal_attention(q, k, v, mask: Optional[jnp.ndarray] = None,
     qg = q.reshape(B, S, Hkv, rep, D)
     logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k) * scale
     logits = logits.astype(jnp.float32)
-    causal = jnp.tril(jnp.ones((S, Sk), bool), k=Sk - S)
-    logits = jnp.where(causal[None, None, None], logits, -1e30)
+    if causal:
+        keep = jnp.tril(jnp.ones((S, Sk), bool), k=Sk - S)
+        logits = jnp.where(keep[None, None, None], logits, -1e30)
     if mask is not None:                        # [B, Sk] padding mask
         logits = jnp.where(mask[:, None, None, None, :].astype(bool),
                            logits, -1e30)

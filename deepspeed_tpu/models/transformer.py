@@ -56,6 +56,7 @@ class TransformerConfig:
     num_experts: int = 1                      # >1 => every layer is MoE
     moe_top_k: int = 2
     capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0         # inference-time capacity
     min_capacity: int = 4
     noise_policy: Optional[str] = None        # None | Jitter | RSample
     aux_loss_coef: float = 0.01

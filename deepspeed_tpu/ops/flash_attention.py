@@ -330,7 +330,8 @@ def flash_attention(q, k, v, mask: Optional[jnp.ndarray] = None,
     vmem_est = (2 + 2 * rep) * S * D * itemsize
     if (mask is not None or S % bq or S % bk or (H % k.shape[2])
             or vmem_est > 10 * 1024 * 1024):
-        return causal_attention(q, k, v, mask=mask, scale=scale)
+        return causal_attention(q, k, v, mask=mask, scale=scale,
+                                causal=causal)
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     qt = q.transpose(0, 2, 1, 3)                   # [B, H, S, D]
     kt = k.transpose(0, 2, 1, 3)

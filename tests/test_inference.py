@@ -1,0 +1,255 @@
+"""Inference/ragged-batching tests (reference analogs:
+tests/unit/inference/v2/ragged/test_blocked_allocator.py,
+test_ragged_wrapper.py; engine-level scheduling tests; decode parity
+with the dense forward)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference import (BlockedAllocator, InferenceConfig,
+                                     InferenceEngine, SamplingParams,
+                                     StateManager, KVCacheConfig)
+from deepspeed_tpu.inference.sampler import sample
+from deepspeed_tpu.models import apply, build_model
+
+
+def tiny_model(**over):
+    kw = dict(vocab_size=128, num_layers=2, d_model=64, num_heads=4,
+              num_kv_heads=2, d_ff=128, max_seq_len=128)
+    kw.update(over)
+    return build_model("llama-tiny", **kw)
+
+
+def make_engine(m, **over):
+    kw = dict(token_budget=32, max_seqs=4, kv_block_size=16,
+              num_kv_blocks=64)
+    kw.update(over)
+    return InferenceEngine(m, InferenceConfig(**kw))
+
+
+def make_fp32_engine(m, **over):
+    """fp32 engine for exact-parity tests (bf16 argmax near-ties are
+    legitimately order-sensitive)."""
+    return make_engine(m, kv_dtype=jnp.float32, param_dtype=jnp.float32,
+                       **over)
+
+
+class TestBlockedAllocator:
+    def test_allocate_free_cycle(self):
+        a = BlockedAllocator(8)
+        blocks = a.allocate(5)
+        assert len(blocks) == 5 and a.free_blocks == 3
+        a.free(blocks[:2])
+        assert a.free_blocks == 5
+
+    def test_over_allocate_raises(self):
+        a = BlockedAllocator(4)
+        with pytest.raises(ValueError, match="Cannot allocate"):
+            a.allocate(5)
+
+    def test_double_free_raises(self):
+        a = BlockedAllocator(4)
+        b = a.allocate(2)
+        a.free(b)
+        with pytest.raises(ValueError, match="Double free"):
+            a.free([b[0]])
+
+    def test_invalid_block_raises(self):
+        a = BlockedAllocator(4)
+        with pytest.raises(ValueError, match="Invalid block"):
+            a.free([99])
+
+
+class TestStateManager:
+    def cfg(self):
+        return KVCacheConfig(num_layers=2, num_kv_heads=2, head_dim=16,
+                             block_size=4, num_blocks=16)
+
+    def test_sequence_lifecycle(self):
+        sm = StateManager(self.cfg(), max_seqs=2)
+        sm.build_batch([(0, [1, 2, 3, 4, 5])], token_budget=8)
+        assert sm.seqs[0].seen_tokens == 5
+        assert len(sm.seqs[0].blocks) == 2          # ceil(5/4)
+        free_before = sm.allocator.free_blocks
+        sm.release(0)
+        assert sm.allocator.free_blocks == free_before + 2
+        assert 0 not in sm.seqs
+
+    def test_can_schedule_respects_blocks(self):
+        sm = StateManager(self.cfg(), max_seqs=2)
+        assert sm.can_schedule(0, 16 * 4)
+        assert not sm.can_schedule(0, 16 * 4 + 1)
+
+    def test_slot_exhaustion(self):
+        sm = StateManager(self.cfg(), max_seqs=1)
+        sm.build_batch([(0, [1])], token_budget=4)
+        assert not sm.can_schedule(1, 1)
+
+    def test_batch_metadata(self):
+        sm = StateManager(self.cfg(), max_seqs=2)
+        b = sm.build_batch([(0, [1, 2, 3]), (1, [7])], token_budget=8)
+        assert b.n_tokens == 4 and b.n_seqs == 2
+        np.testing.assert_array_equal(np.asarray(b.positions[:4]),
+                                      [0, 1, 2, 0])
+        assert int(b.logits_idx[sm.slot(0)]) == 2
+        assert int(b.logits_idx[sm.slot(1)]) == 3
+        assert not bool(b.token_valid[4])
+
+    def test_budget_overflow_raises(self):
+        sm = StateManager(self.cfg(), max_seqs=2)
+        with pytest.raises(ValueError, match="budget"):
+            sm.build_batch([(0, list(range(9)))], token_budget=8)
+
+
+class TestDecodeParity:
+    def test_greedy_matches_full_forward(self):
+        m = tiny_model()
+        eng = make_fp32_engine(m)
+        prompt = [5, 17, 99, 3, 42]
+        out = eng.generate({0: prompt}, SamplingParams(max_new_tokens=8))
+        params = m.params
+        seq = list(prompt)
+        for _ in range(8):
+            logits = apply(m.config, params, jnp.asarray([seq]))
+            seq.append(int(jnp.argmax(logits[0, -1])))
+        assert out[0] == seq[len(prompt):]
+
+    def test_gpt2_style_learned_positions(self):
+        m = build_model("gpt2", vocab_size=128, num_layers=2, d_model=64,
+                        num_heads=4, max_seq_len=64)
+        eng = make_fp32_engine(m)
+        prompt = [1, 2, 3]
+        out = eng.generate({0: prompt}, SamplingParams(max_new_tokens=5))
+        params = m.params
+        seq = list(prompt)
+        for _ in range(5):
+            logits = apply(m.config, params, jnp.asarray([seq]))
+            seq.append(int(jnp.argmax(logits[0, -1])))
+        assert out[0] == seq[len(prompt):]
+
+    def test_continuous_batching_isolation(self):
+        """Interleaved sequences decode identically to solo runs."""
+        m = tiny_model()
+        eng = make_engine(m)
+        out = eng.generate({1: [3, 1, 4], 2: [2, 7, 1, 8, 2, 8]},
+                           SamplingParams(max_new_tokens=5))
+        for uid, p in ((1, [3, 1, 4]), (2, [2, 7, 1, 8, 2, 8])):
+            solo = make_engine(m).generate({uid: p},
+                                           SamplingParams(max_new_tokens=5))
+            assert solo[uid] == out[uid]
+
+    def test_splitfuse_chunked_prefill(self):
+        """Prompt longer than the budget is ingested over several steps
+        and still decodes identically (Dynamic SplitFuse)."""
+        m = tiny_model()
+        prompt = list(np.random.RandomState(0).randint(1, 128, 50))
+        small = make_engine(m, token_budget=16)
+        big = make_engine(m, token_budget=64)
+        a = small.generate({0: prompt}, SamplingParams(max_new_tokens=4))
+        b = big.generate({0: prompt}, SamplingParams(max_new_tokens=4))
+        assert a[0] == b[0]
+
+    def test_moe_decode(self):
+        m = build_model("mixtral-tiny", vocab_size=128, num_layers=2,
+                        d_model=64, num_heads=4, num_kv_heads=2, d_ff=128,
+                        num_experts=4, capacity_factor=4.0)
+        eng = make_engine(m)
+        out = eng.generate({0: [1, 2, 3]}, SamplingParams(max_new_tokens=4))
+        assert len(out[0]) == 4
+
+
+class TestEngineAPI:
+    def test_query_flush(self):
+        m = tiny_model()
+        eng = make_engine(m)
+        eng.put(7, [1, 2, 3])
+        assert eng.query(7)["pending_tokens"] == 3
+        eng.step()
+        q = eng.query(7)
+        assert q["seen_tokens"] == 3
+        eng.flush(7)
+        assert eng.query(7)["seen_tokens"] == 0
+
+    def test_stop_token(self):
+        m = tiny_model()
+        eng = make_fp32_engine(m)
+        out = eng.generate({0: [5, 17, 99, 3, 42]},
+                           SamplingParams(max_new_tokens=50, stop_token=26))
+        # first generated token for this model/prompt is 26 (see parity test)
+        assert out[0] == [26]
+
+
+class TestSampler:
+    def test_greedy(self):
+        logits = jnp.asarray([[0.0, 3.0, 1.0], [2.0, 0.0, 0.0]])
+        toks = sample(logits, SamplingParams(temperature=0.0))
+        np.testing.assert_array_equal(np.asarray(toks), [1, 0])
+
+    def test_top_k_restricts(self):
+        logits = jnp.asarray([[0.0, 10.0, 9.0, -5.0]])
+        rng = jax.random.PRNGKey(0)
+        for i in range(10):
+            t = sample(logits, SamplingParams(temperature=1.0, top_k=2),
+                       jax.random.fold_in(rng, i))
+            assert int(t[0]) in (1, 2)
+
+    def test_top_p_restricts(self):
+        logits = jnp.asarray([[10.0, 9.5, -20.0, -20.0]])
+        rng = jax.random.PRNGKey(0)
+        for i in range(10):
+            t = sample(logits, SamplingParams(temperature=1.0, top_p=0.9),
+                       jax.random.fold_in(rng, i))
+            assert int(t[0]) in (0, 1)
+
+
+class TestSchedulerSafety:
+    def test_overcommit_blocks_no_crash(self):
+        """Two prompts that jointly exceed the KV pool must be admitted
+        incrementally, not crash build_batch mid-step."""
+        m = tiny_model()
+        eng = make_engine(m, num_kv_blocks=4, kv_block_size=16,
+                          token_budget=128, max_seqs=4)
+        p1 = list(np.random.RandomState(1).randint(1, 128, 33))
+        p2 = list(np.random.RandomState(2).randint(1, 128, 33))
+        eng.put(0, p1)
+        eng.put(1, p2)
+        for _ in range(10):
+            eng.step()
+        # both prompts eventually fully ingested or bounded by pool
+        assert eng.query(0)["seen_tokens"] + eng.query(1)["seen_tokens"] <= 64
+
+    def test_slot_overcommit_no_crash(self):
+        m = tiny_model()
+        eng = make_engine(m, max_seqs=1)
+        eng.put(0, [1, 2])
+        eng.put(1, [3, 4])
+        eng.step()
+        assert eng.query(0)["seen_tokens"] == 2
+        assert eng.query(1)["seen_tokens"] == 0   # deferred, not crashed
+        eng.flush(0)
+        eng.step()
+        assert eng.query(1)["seen_tokens"] == 2
+
+    def test_context_limit_ends_generation(self):
+        m = tiny_model()
+        # 2 blocks x 16 = 32-token max context
+        eng = make_engine(m, num_kv_blocks=2, kv_block_size=16,
+                          max_seqs=1, max_seq_len=32)
+        out = eng.generate({0: [1, 2, 3, 4]},
+                           SamplingParams(max_new_tokens=100))
+        # last token is sampled when seen==32; generation then stops:
+        # 4 prompt + 28 fed-back tokens ingested -> 29 sampled
+        assert len(out[0]) == 29
+
+    def test_decode_prioritized_over_prefill(self):
+        """A decoding sequence is not starved by a long new prompt."""
+        m = tiny_model()
+        eng = make_engine(m, token_budget=8)
+        eng.put(0, [1, 2, 3])
+        eng.step()                      # seq 0 ready to decode
+        eng.put(0, [42])                # decode token
+        eng.put(1, list(range(1, 30)))  # long prefill
+        eng.step()
+        assert eng.query(0)["seen_tokens"] == 4   # decode went through
