@@ -1,3 +1,4 @@
 from .flash_attention import flash_attention
+from .builder import AsyncIOBuilder, BuildError, OpBuilder
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "AsyncIOBuilder", "BuildError", "OpBuilder"]

@@ -1,0 +1,87 @@
+"""Python handle over the native aio thread pool.
+
+API parity with the reference's ``aio_handle``
+(``csrc/aio/py_lib/py_ds_aio.cpp:15-80`` — block_size/queue_depth/
+num_threads ctor; sync/async pread/pwrite; wait) consumed by the swap
+machinery (``runtime/swap_tensor/partitioned_param_swapper.py:83``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from .builder import AsyncIOBuilder
+
+
+class AsyncIOHandle:
+    """Chunked, threaded file I/O for numpy buffers.
+
+    ``queue_depth``/``single_submit``/``overlap_events`` exist for config
+    parity with the reference handle only: the pool here is thread-based
+    pread/pwrite (its submission queue is unbounded and always
+    overlapped), so they change nothing and are merely recorded.
+    """
+
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 128,
+                 thread_count: int = 4, single_submit: bool = False,
+                 overlap_events: bool = True):
+        lib = AsyncIOBuilder().load()
+        lib.aio_create.restype = ctypes.c_void_p
+        lib.aio_create.argtypes = [ctypes.c_int, ctypes.c_long]
+        lib.aio_destroy.argtypes = [ctypes.c_void_p]
+        for fn in ("aio_pread", "aio_pwrite"):
+            getattr(lib, fn).argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                ctypes.c_long, ctypes.c_long]
+        lib.aio_wait.argtypes = [ctypes.c_void_p]
+        lib.aio_wait.restype = ctypes.c_int
+        lib.aio_pending.argtypes = [ctypes.c_void_p]
+        lib.aio_pending.restype = ctypes.c_int
+        self._lib = lib
+        self._h = lib.aio_create(thread_count, block_size)
+        self.block_size = block_size
+        self.queue_depth = queue_depth
+        self.thread_count = thread_count
+        self.single_submit = single_submit
+        self.overlap_events = overlap_events
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.aio_destroy(h)
+            self._h = None
+
+    # ---- async (reference: async_pread/async_pwrite) --------------------
+    def async_pread(self, buffer: np.ndarray, path: str, offset: int = 0):
+        if not buffer.flags["C_CONTIGUOUS"]:
+            raise ValueError("buffer must be C-contiguous")
+        self._lib.aio_pread(self._h, os.fspath(path).encode(),
+                            buffer.ctypes.data_as(ctypes.c_void_p),
+                            buffer.nbytes, offset)
+
+    def async_pwrite(self, buffer: np.ndarray, path: str, offset: int = 0):
+        if not buffer.flags["C_CONTIGUOUS"]:
+            raise ValueError("buffer must be C-contiguous")
+        self._lib.aio_pwrite(self._h, os.fspath(path).encode(),
+                             buffer.ctypes.data_as(ctypes.c_void_p),
+                             buffer.nbytes, offset)
+
+    def wait(self) -> int:
+        """Drain outstanding requests; returns number of failed chunks."""
+        return self._lib.aio_wait(self._h)
+
+    def pending(self) -> int:
+        return self._lib.aio_pending(self._h)
+
+    # ---- sync (reference: sync_pread/sync_pwrite) ------------------------
+    def sync_pread(self, buffer: np.ndarray, path: str, offset: int = 0) -> int:
+        self.async_pread(buffer, path, offset)
+        return self.wait()
+
+    def sync_pwrite(self, buffer: np.ndarray, path: str, offset: int = 0) -> int:
+        self.async_pwrite(buffer, path, offset)
+        return self.wait()

@@ -1,0 +1,90 @@
+"""JIT builder for native C++ extensions.
+
+TPU-native analog of the reference's op-builder subsystem
+(``op_builder/builder.py:109`` OpBuilder ABC, ``jit_load`` :513/:532 via
+torch cpp_extension/ninja): compiles C++ sources under
+``deepspeed_tpu/native/`` to shared objects with g++ at first use, caches
+by source hash, and loads them through ``ctypes`` (pybind11 is not in the
+image; a C ABI + ctypes is the stable boundary).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from ..utils.logging import logger
+
+NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
+CACHE_DIR = Path(os.environ.get(
+    "DEEPSPEED_TPU_CACHE", os.path.expanduser("~/.cache/deepspeed_tpu")))
+
+_lock = threading.Lock()
+_loaded: Dict[str, ctypes.CDLL] = {}
+
+
+class BuildError(RuntimeError):
+    pass
+
+
+class OpBuilder:
+    """One native extension = sources + flags (reference: OpBuilder)."""
+
+    name: str = ""
+    sources: List[str] = []
+    extra_flags: List[str] = []
+
+    def source_paths(self) -> List[Path]:
+        return [NATIVE_DIR / s for s in self.sources]
+
+    def is_compatible(self) -> bool:
+        """Whether this op can build on the current host
+        (reference: OpBuilder.is_compatible)."""
+        from shutil import which
+
+        return which("g++") is not None
+
+    def _hash(self) -> str:
+        h = hashlib.sha256()
+        for p in self.source_paths():
+            h.update(p.read_bytes())
+        h.update(" ".join(self.extra_flags).encode())
+        return h.hexdigest()[:16]
+
+    def load(self) -> ctypes.CDLL:
+        """Compile (if needed) and dlopen (reference: OpBuilder.load)."""
+        with _lock:
+            if self.name in _loaded:
+                return _loaded[self.name]
+            so = self._build()
+            lib = ctypes.CDLL(str(so))
+            _loaded[self.name] = lib
+            return lib
+
+    def _build(self) -> Path:
+        CACHE_DIR.mkdir(parents=True, exist_ok=True)
+        so = CACHE_DIR / f"{self.name}_{self._hash()}.so"
+        if so.exists():
+            return so
+        if not self.is_compatible():
+            raise BuildError(f"No g++ available to build {self.name}")
+        cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-pthread",
+               *self.extra_flags,
+               *[str(p) for p in self.source_paths()], "-o", str(so)]
+        logger.info("building native op %s: %s", self.name, " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise BuildError(
+                f"build of {self.name} failed:\n{proc.stderr[:4000]}")
+        return so
+
+
+class AsyncIOBuilder(OpBuilder):
+    """(reference: op_builder/async_io.py)."""
+    name = "aio"
+    sources = ["aio.cpp"]

@@ -51,12 +51,18 @@ class ZeroPolicy:
     topology: MeshTopology
     rules: Optional[Dict[str, Sequence[str]]] = None
     param_persistence_threshold: int = 10_000
+    # ZeRO-Offload shards masters over the *full* DP world (data x fsdp),
+    # like the reference partitions optimizer state across all DP ranks
+    # (stage_1_and_2.py:646): minimises host DRAM per rank and keeps every
+    # leaf partitioned, which XLA host-memory placement requires.
+    offload: bool = False
 
     @classmethod
     def from_config(cls, zcfg: ZeroConfig, topology: MeshTopology,
                     rules: Optional[Dict[str, Sequence[str]]] = None) -> "ZeroPolicy":
         return cls(stage=zcfg.stage, topology=topology, rules=rules,
-                   param_persistence_threshold=zcfg.param_persistence_threshold)
+                   param_persistence_threshold=zcfg.param_persistence_threshold,
+                   offload=zcfg.offload_optimizer.device == "cpu")
 
     # ---- spec builders ---------------------------------------------------
     def _tp_spec(self, axes, shape) -> P:
@@ -75,6 +81,9 @@ class ZeroPolicy:
         spec = self._tp_spec(axes, shape)
         if self.stage >= 1:
             spec = shd.add_fsdp_to_spec(spec, shape, self.topology, min_size=0)
+        if self.offload:
+            spec = shd.add_fsdp_to_spec(spec, shape, self.topology, min_size=0,
+                                        axis=shd.DATA_AXIS)
         return spec
 
     def grad_spec(self, axes, shape) -> P:

@@ -141,6 +141,51 @@ class Engine:
         self.batch_sharding = topo.batch_sharding()
         self.repl = NamedSharding(topo.mesh, P())
 
+        # ZeRO-Offload: master params + optimizer moments live in host DRAM
+        # (memory_kind pinned_host); XLA streams them through the device at
+        # step time.  The reference's analogous path is CPU optimizer state
+        # + DeepSpeedCPUAdam (stage_1_and_2 cpu_offload, csrc/adam) — under
+        # XLA the "CPU adam" is the compiler-scheduled host<->HBM transfer
+        # around the same fused update.
+        self.offload_active = False
+        self._offload_validated = False
+        if self.config.zero_optimization.offload_optimizer.device == "cpu":
+            if self.config.optimizer.type.lower() == "lamb":
+                # LAMB trust ratios need whole-tensor norms; the offload
+                # update runs per-shard inside shard_map, which would
+                # silently compute per-shard ratios.
+                logger.warning(
+                    "optimizer offload is not supported with LAMB "
+                    "(per-tensor trust ratios); keeping optimizer state "
+                    "in device memory")
+            elif self._host_memory_supported():
+                # Per-leaf placement: only sharded leaves move to host DRAM.
+                # Under multi-device SPMD, fully-replicated leaves (tiny
+                # params the mesh can't divide) stay in HBM — the
+                # partitioner cannot express a memory-space transfer of a
+                # replicated value, and their footprint is negligible.  On
+                # a single-chip mesh there is no partitioning, so
+                # everything pins to host (the reference's 1-GPU
+                # ZeRO-Offload headline case).
+                multi = self.topology.mesh.size > 1
+                self.master_shardings = jax.tree.map(
+                    lambda sh: sh if (multi and sh.is_fully_replicated)
+                    else sh.with_memory_kind("pinned_host"),
+                    self.master_shardings)
+                self.offload_active = True
+            else:
+                logger.warning(
+                    "offload_optimizer.device=cpu requested but this "
+                    "backend has no pinned_host memory space; ignoring")
+
+    @staticmethod
+    def _host_memory_supported() -> bool:
+        try:
+            jax.devices()[0].memory("pinned_host")
+            return True
+        except Exception:
+            return False
+
     def _opt_state_shardings(self, opt_state, master):
         """Optimizer moments mirror the master param sharding.
 
@@ -167,12 +212,36 @@ class Engine:
             opt_state = self.optimizer.init(master)
             return master, opt_state
 
-        # discover opt-state structure via eval_shape, then jit w/ shardings
+        # discover opt-state structure via eval_shape, then jit w/ device
+        # shardings; host (pinned_host) placement happens *outside* jit via
+        # device_put — out_shardings with host memory kinds trip the SPMD
+        # partitioner on some backends when the value aliases an input.
         master_shape, opt_shape = jax.eval_shape(init_fn, params)
+        device_master_sh = jax.tree.map(
+            lambda sh: NamedSharding(self.topology.mesh, sh.spec),
+            self.master_shardings)
         opt_shardings = self._opt_state_shardings(opt_shape, master_shape)
-        init_jit = jax.jit(init_fn, out_shardings=(self.master_shardings,
-                                                   opt_shardings))
+        device_opt_sh = jax.tree.map(
+            lambda sh: NamedSharding(self.topology.mesh, sh.spec),
+            opt_shardings)
+        init_jit = jax.jit(init_fn, out_shardings=(device_master_sh,
+                                                   device_opt_sh))
         master, opt_state = init_jit(params)
+        if self.offload_active:
+            try:
+                master = jax.device_put(master, self.master_shardings)
+                opt_state = jax.device_put(opt_state, opt_shardings)
+            except Exception as e:
+                logger.warning(
+                    "optimizer offload unsupported for this mesh/layout "
+                    "(%s); keeping optimizer state in device memory",
+                    str(e).splitlines()[0][:120])
+                self.offload_active = False
+                self.master_shardings = device_master_sh
+                opt_shardings = device_opt_sh
+                # the first put may have committed master to host already
+                master = jax.device_put(master, device_master_sh)
+                opt_state = jax.device_put(opt_state, device_opt_sh)
         self.opt_shardings = opt_shardings
         return TrainState(
             step=jnp.zeros((), jnp.int32),
@@ -197,11 +266,78 @@ class Engine:
         layout.  For ZeRO 1/2 this makes XLA all-gather in the *compute*
         dtype (half the bytes of an fp32 gather) — the comm-pattern analog
         of all_gather_dp_groups of fp16 shards (stage_1_and_2.py:1823)."""
-        def cast(p, spec):
+        offloaded = self.offload_active
+
+        def cast(p, spec, msh):
+            if offloaded and getattr(msh, "memory_kind", None) == "pinned_host":
+                # host->HBM transfer first (jit-legal device_put), then cast
+                p = jax.device_put(p, NamedSharding(
+                    self.topology.mesh, msh.spec, memory_kind="device"))
             c = p.astype(self.compute_dtype)
             return jax.lax.with_sharding_constraint(
                 c, NamedSharding(self.topology.mesh, spec))
-        return jax.tree.map(cast, master, self.param_specs)
+        return jax.tree.map(cast, master, self.param_specs,
+                            self.master_shardings)
+
+    def _offload_update(self, grads, opt_state, master, step, finite):
+        """ZeRO-Offload optimizer step: fp32 master + moments live in host
+        DRAM and the update executes as XLA host compute — the TPU analog
+        of the reference's DeepSpeedCPUAdam path (stage_1_and_2.py
+        cpu_offload + csrc/adam/cpu_adam_impl.cpp), with the
+        compiler-scheduled grad HBM->host stream standing in for the
+        hand-rolled async grad copy (async_accumulate_grad_in_cpu_via_gpu,
+        stage_1_and_2.py:1190).
+
+        Runs inside shard_map: under manual sharding every op carries a
+        sharding, which the SPMD partitioner requires of memory-space
+        transfer annotations (a *replicated* transfer is inexpressible —
+        the reason replicated leaves stay in HBM, see _build_shardings)."""
+        from jax.experimental.compute_on import compute_on
+
+        opt_specs = jax.tree.map(lambda sh: sh.spec, self.opt_shardings)
+
+        def host_flags(shardings):
+            return jax.tree.map(
+                lambda sh: getattr(sh, "memory_kind", None) == "pinned_host",
+                shardings)
+
+        m_host, o_host = (host_flags(self.master_shardings),
+                          host_flags(self.opt_shardings))
+
+        def put(tree, flags, space):
+            # host-flagged leaves never move (host is both where they
+            # arrive and where they belong); the rest transfer to `space`
+            # — Host on entry for the update, Device on exit to restore.
+            return jax.tree.map(
+                lambda x, h: x if h else jax.device_put(x, space),
+                tree, flags)
+
+        def local(g, o, m, step, finite):
+            g = jax.tree.map(
+                lambda x: jax.device_put(x, jax.memory.Space.Host), g)
+            o = put(o, o_host, jax.memory.Space.Host)
+            m = put(m, m_host, jax.memory.Space.Host)
+            step_h = jax.device_put(step, jax.memory.Space.Host)
+            finite_h = jax.device_put(finite, jax.memory.Space.Host)
+            with compute_on("device_host"):
+                updates, new_o = self.optimizer.update(g, o, m, step_h)
+                new_m = jax.tree.map(lambda p, u: p + u, m, updates)
+
+                def sel(new, old):
+                    return jax.tree.map(
+                        lambda a, b: jnp.where(finite_h, a, b), new, old)
+                new_m, new_o = sel(new_m, m), sel(new_o, o)
+            # leaves that live in HBM go back before leaving the region
+            new_m = put(new_m, m_host, jax.memory.Space.Device)
+            new_o = put(new_o, o_host, jax.memory.Space.Device)
+            return new_m, new_o
+
+        return jax.shard_map(
+            local, mesh=self.topology.mesh,
+            in_specs=(self.master_specs, opt_specs, self.master_specs,
+                      P(), P()),
+            out_specs=(self.master_specs, opt_specs),
+        )(grads, opt_state, master, step, finite)
 
     def _micro_loss(self, cparams, batch, rng):
         out = self.loss_fn(cparams, batch, rng)
@@ -218,6 +354,7 @@ class Engine:
         clip = self.config.gradient_clipping
         prescale = self.config.prescale_gradients
         predivide = self.config.gradient_predivide_factor
+        offloaded = self.offload_active
 
         def grads_of_microbatch(cparams, batch, rng, scale):
             def scaled_loss(p):
@@ -272,26 +409,44 @@ class Engine:
             finite = all_finite(grads) if use_scaling else jnp.asarray(True)
             grads, gnorm = clip_by_global_norm(grads, clip)
 
-            # optimizer update on the (fsdp-sharded) master partition —
-            # the local-adam-on-owned-shard of stage_1_and_2.py:1823.
-            step_next = state.step + 1
-            updates, new_opt = self.optimizer.update(
-                grads, state.opt_state, state.master, step_next)
-            new_master = jax.tree.map(lambda p, u: p + u, state.master, updates)
-
             # overflow → skip update (jnp.where keeps shapes static)
             def sel(new, old):
                 return jax.tree.map(
                     lambda a, b: jnp.where(finite, a, b), new, old)
-            new_master = sel(new_master, state.master)
-            new_opt = sel(new_opt, state.opt_state)
+
+            # optimizer update on the (fsdp-sharded) master partition —
+            # the local-adam-on-owned-shard of stage_1_and_2.py:1823.
+            step_next = state.step + 1
+
+            def update_master(grads, opt_state, master):
+                updates, new_opt = self.optimizer.update(
+                    grads, opt_state, master, step_next)
+                new_master = jax.tree.map(lambda p, u: p + u, master, updates)
+                return sel(new_master, master), sel(new_opt, opt_state)
+
+            if offloaded:
+                new_master, new_opt = self._offload_update(
+                    grads, state.opt_state, state.master, step_next, finite)
+            else:
+                new_master, new_opt = update_master(
+                    grads, state.opt_state, state.master)
             new_step = jnp.where(finite, step_next, state.step)
             new_scale_state = scaler.update(state.loss_scale, ~finite)
+            new_skipped = state.skipped + jnp.where(finite, 0, 1)
+            if offloaded:
+                # mixed memory kinds make jit annotate every output's
+                # placement; scalar outputs need an explicit (replicated)
+                # sharding attached or the SPMD partitioner rejects the
+                # annotation op (hlo->has_sharding() RET_CHECK).
+                rep = lambda x: jax.lax.with_sharding_constraint(x, self.repl)
+                new_step = rep(new_step)
+                new_skipped = rep(new_skipped)
+                new_scale_state = jax.tree.map(rep, new_scale_state)
 
             new_state = TrainState(
                 step=new_step, master=new_master, opt_state=new_opt,
                 loss_scale=new_scale_state,
-                skipped=state.skipped + jnp.where(finite, 0, 1))
+                skipped=new_skipped)
             lr = self.lr_schedule(new_step.astype(jnp.float32))
             metrics = {
                 "loss": loss.astype(jnp.float32),
@@ -308,7 +463,7 @@ class Engine:
             train_step,
             in_shardings=(state_sh, None, None),
             out_shardings=(state_sh, None),
-            donate_argnums=(0,))
+            donate_argnums=() if offloaded else (0,))
 
     # ------------------------------------------------------------------
     # public API (reference: engine.train_batch / forward+backward+step)
@@ -326,7 +481,17 @@ class Engine:
             rng = jax.random.PRNGKey(self.config.seed + self.global_steps)
         batch = self.shard_batch(batch)
         self.tput.start()
-        self.state, metrics = self._train_step_fn(self.state, batch, rng)
+        try:
+            self.state, metrics = self._train_step_fn(self.state, batch, rng)
+        except jax.errors.JaxRuntimeError as e:
+            # only the *first* execution may fall back — a later failure is
+            # a genuine runtime error, not a backend capability gap
+            if not self.offload_active or self._offload_validated:
+                raise
+            self._disable_offload(e)
+            self._train_step_fn = self._build_train_step()
+            self.state, metrics = self._train_step_fn(self.state, batch, rng)
+        self._offload_validated = True
         self.global_steps += 1
         self.global_samples += self.train_batch_size
         metrics = {k: np.asarray(v) for k, v in metrics.items()}
@@ -359,7 +524,42 @@ class Engine:
         if rng is None:
             rng = jax.random.PRNGKey(0)
         batch = self.shard_batch(batch, accumulate=False)
-        return np.asarray(self._eval_step_fn(self.state.master, batch, rng))
+        try:
+            out = np.asarray(self._eval_step_fn(self.state.master, batch, rng))
+        except jax.errors.JaxRuntimeError as e:
+            if not self.offload_active or self._offload_validated:
+                raise
+            self._disable_offload(e)
+            return self.eval_batch(batch, rng)
+        self._offload_validated = True
+        return out
+
+    def _disable_offload(self, err: Exception) -> None:
+        """Fall back to device-resident optimizer state.
+
+        The pinned_host placement compiles on real TPU but some backends
+        (notably multi-device CPU SPMD, used by the virtual test mesh)
+        cannot partition memory-space transfer annotations at all; detect
+        that at first compile and keep training instead of dying."""
+        logger.warning(
+            "optimizer offload unsupported on this backend (%s); "
+            "falling back to device-resident optimizer state",
+            str(err).splitlines()[0][:120])
+        self.offload_active = False
+        to_dev = lambda sh: NamedSharding(self.topology.mesh, sh.spec)
+        self.master_shardings = jax.tree.map(to_dev, self.master_shardings)
+        self.opt_shardings = jax.tree.map(to_dev, self.opt_shardings)
+        self.state = TrainState(
+            step=self.state.step,
+            master=jax.device_put(self.state.master, self.master_shardings),
+            opt_state=jax.device_put(self.state.opt_state, self.opt_shardings),
+            loss_scale=self.state.loss_scale,
+            skipped=self.state.skipped)
+        # drop every jit compiled against the host-placed shardings
+        self._train_step_fn = None
+        self._eval_step_fn = None
+        if hasattr(self, "_compute_params_fn"):
+            del self._compute_params_fn
 
     def shard_batch(self, batch, accumulate: bool = True):
         """Device-put host batch with [B] → sharded over data axes; with
