@@ -1,0 +1,87 @@
+"""Environment report CLI — the ``ds_report`` analog
+(reference: ``deepspeed/env_report.py``; ``bin/ds_report``).
+
+Usage: ``python -m deepspeed_tpu.env_report``
+"""
+
+from __future__ import annotations
+
+import platform as _platform
+import shutil
+import sys
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[93m[NO]\033[0m"
+
+
+def _row(name: str, status: str, extra: str = "") -> str:
+    return f"{name:.<30} {status} {extra}"
+
+
+def main() -> int:
+    lines = ["-" * 60, "DeepSpeed-TPU environment report", "-" * 60]
+
+    import numpy
+    lines.append(_row("python", GREEN_OK, sys.version.split()[0]))
+    lines.append(_row("platform", GREEN_OK, _platform.platform()))
+    lines.append(_row("numpy", GREEN_OK, numpy.__version__))
+
+    try:
+        import jax
+        import jaxlib
+        lines.append(_row("jax", GREEN_OK, jax.__version__))
+        lines.append(_row("jaxlib", GREEN_OK, jaxlib.__version__))
+        devs = jax.devices()
+        lines.append(_row("devices", GREEN_OK,
+                          f"{len(devs)} x {devs[0].platform} "
+                          f"({devs[0].device_kind})"))
+        try:
+            stats = devs[0].memory_stats() or {}
+            lim = stats.get("bytes_limit")
+            if lim:
+                lines.append(_row("device memory", GREEN_OK,
+                                  f"{lim / 2**30:.1f} GiB"))
+        except Exception:
+            pass
+        try:
+            devs[0].memory("pinned_host")
+            lines.append(_row("pinned_host memory", GREEN_OK,
+                              "(ZeRO-Offload capable)"))
+        except Exception:
+            lines.append(_row("pinned_host memory", RED_NO))
+    except Exception as e:
+        lines.append(_row("jax", RED_NO, str(e)))
+
+    for mod in ("flax", "optax", "orbax.checkpoint", "chex", "einops",
+                "transformers", "torch"):
+        try:
+            m = __import__(mod)
+            ver = getattr(m, "__version__", "?")
+            lines.append(_row(mod, GREEN_OK, ver))
+        except Exception:
+            lines.append(_row(mod, RED_NO))
+
+    # native op builders (reference: op compatibility table in ds_report)
+    lines.append("-" * 60)
+    lines.append("native ops:")
+    gxx = shutil.which("g++")
+    lines.append(_row("g++ toolchain", GREEN_OK if gxx else RED_NO,
+                      gxx or ""))
+    try:
+        from .ops.builder import AsyncIOBuilder
+        b = AsyncIOBuilder()
+        ok = b.is_compatible()
+        lines.append(_row("async_io", GREEN_OK if ok else RED_NO))
+        if ok:
+            b.load()
+            lines.append(_row("async_io build", GREEN_OK))
+    except Exception as e:
+        lines.append(_row("async_io build", RED_NO, str(e)[:60]))
+
+    lines.append("-" * 60)
+    print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

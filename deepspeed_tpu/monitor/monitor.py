@@ -1,0 +1,155 @@
+"""Experiment monitoring: fan-out scalar/event writers.
+
+TPU-native equivalent of the reference monitor subsystem
+(``monitor/monitor.py:30`` ``MonitorMaster`` fanning out to
+TensorBoard/WandB/Comet/CSV writers in ``monitor/{tensorboard,wandb,
+comet,csv_monitor}.py``; engine scalar events ``runtime/engine.py:2317``).
+
+Only the process with ``jax.process_index() == 0`` writes (the reference
+gates on rank, monitor/monitor.py) — under multi-host SPMD every process
+sees identical replicated metrics, so one writer suffices.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from ..utils.logging import logger
+
+# (name, value, step) triples — the reference's event tuple shape
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    """Writer interface (reference: monitor/monitor.py Monitor ABC)."""
+
+    def __init__(self, config):
+        self.enabled = bool(getattr(config, "enabled", False))
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        raise NotImplementedError
+
+    def write_scalars(self, step: int, scalars: Dict[str, float]) -> None:
+        self.write_events([(k, float(v), step) for k, v in scalars.items()])
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class CSVMonitor(Monitor):
+    """One CSV file per metric name (reference: monitor/csv_monitor.py)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        base = config.output_path or "csv_monitor"
+        self.dir = os.path.join(base, config.job_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self._files: Dict[str, Any] = {}
+
+    def _writer(self, name: str):
+        if name not in self._files:
+            safe = name.replace("/", "_")
+            f = open(os.path.join(self.dir, f"{safe}.csv"), "a", newline="")
+            self._files[name] = (f, csv.writer(f))
+        return self._files[name]
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        for name, value, step in events:
+            f, w = self._writer(name)
+            w.writerow([step, value])
+            f.flush()          # rows visible immediately (tail -f etc.)
+
+    def flush(self) -> None:
+        for f, _ in self._files.values():
+            f.flush()
+
+    def close(self) -> None:
+        for f, _ in self._files.values():
+            f.close()
+        self._files = {}
+
+
+class TensorBoardMonitor(Monitor):
+    """(reference: monitor/tensorboard.py — SummaryWriter wrapper)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        from torch.utils.tensorboard import SummaryWriter  # torch is baked in
+
+        path = os.path.join(config.output_path or "runs", config.job_name)
+        self.writer = SummaryWriter(log_dir=path)
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        for name, value, step in events:
+            self.writer.add_scalar(name, value, step)
+
+    def flush(self) -> None:
+        self.writer.flush()
+
+    def close(self) -> None:
+        self.writer.close()
+
+
+class WandbMonitor(Monitor):
+    """(reference: monitor/wandb.py)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        import wandb  # optional; gated by caller
+
+        self.wandb = wandb
+        wandb.init(project=config.project, group=config.group,
+                   entity=config.team)
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        for name, value, step in events:
+            self.wandb.log({name: value}, step=step)
+
+    def close(self) -> None:
+        self.wandb.finish()
+
+
+class MonitorMaster(Monitor):
+    """Builds every enabled writer and fans events out
+    (reference: monitor/monitor.py:30)."""
+
+    def __init__(self, config):
+        # `config` is the top-level framework Config (or anything with
+        # .tensorboard/.csv_monitor/.wandb sub-configs)
+        self.writers: List[Monitor] = []
+        self.enabled = False
+        if jax.process_index() != 0:
+            return
+        specs = [
+            (getattr(config, "csv_monitor", None), CSVMonitor),
+            (getattr(config, "tensorboard", None), TensorBoardMonitor),
+            (getattr(config, "wandb", None), WandbMonitor),
+        ]
+        for sub, cls in specs:
+            if sub is None or not sub.enabled:
+                continue
+            try:
+                self.writers.append(cls(sub))
+            except Exception as e:  # missing optional dep — warn, continue
+                logger.warning("monitor writer %s disabled (%s)",
+                               cls.__name__, e)
+        self.enabled = bool(self.writers)
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        for w in self.writers:
+            w.write_events(events)
+
+    def flush(self) -> None:
+        for w in self.writers:
+            w.flush()
+
+    def close(self) -> None:
+        for w in self.writers:
+            w.close()

@@ -1,0 +1,183 @@
+"""Group-wise quantization + quantized collectives (ZeRO++ primitives).
+
+TPU-native equivalents of the reference quantization kernels
+(``csrc/quantization/`` — ``pt_binding.cpp:270-297`` exports ``quantize``/
+``dequantize`` grouped sym/asym with configurable bits, ``swizzle_quant``,
+``quantized_reduction`` the qgZ dequant-reduce-requant primitive,
+``quantize_intX.cu`` int4/int8; and the ZeRO++ comm paths
+``runtime/zero/partition_parameters.py:753`` CUDAQuantizer int8 weight
+all-gather, ``runtime/comm/coalesced_collectives.py`` all_to_all_quant_reduce).
+
+Everything is jnp — XLA fuses quantize into the surrounding collectives'
+pack/unpack.  The collectives are written for use **inside shard_map**
+(manual axes) so the wire format really is int8/int4:
+
+* ``quantized_all_gather``  — qwZ: 2x less all-gather traffic than bf16.
+* ``quantized_psum_scatter`` — qgZ: all-to-all int8 chunks, dequant,
+  local reduce (the single-hop formulation of qgZ's
+  all-to-all-based gradient reduction).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class QuantizedTensor(NamedTuple):
+    """Grouped quantized representation: int data + per-group scale/zero."""
+    data: jax.Array          # int8 (packed nibbles when bits=4)
+    scale: jax.Array         # f32 [groups, 1]
+    zero: Optional[jax.Array]  # f32 [groups, 1] (None when symmetric)
+    bits: int
+    shape: Tuple[int, ...]   # original shape
+    dtype: jnp.dtype         # original dtype
+
+
+def _group(x: jax.Array, num_groups: int) -> jax.Array:
+    flat = x.reshape(-1)
+    assert flat.size % num_groups == 0, \
+        f"size {flat.size} not divisible into {num_groups} groups"
+    return flat.reshape(num_groups, -1)
+
+
+def _pack_int4(q: jax.Array) -> jax.Array:
+    """Two int4 values per int8 byte (reference: quantize_int4 layout)."""
+    q = q.reshape(q.shape[0], -1, 2)
+    lo = (q[..., 0] & 0x0F).astype(jnp.uint8)
+    hi = (q[..., 1] & 0x0F).astype(jnp.uint8)
+    return (lo | (hi << 4)).astype(jnp.int8)
+
+
+def _unpack_int4(p: jax.Array) -> jax.Array:
+    u = p.astype(jnp.uint8)
+    lo = (u & 0x0F).astype(jnp.int8)
+    hi = ((u >> 4) & 0x0F).astype(jnp.int8)
+    # sign-extend 4-bit two's complement
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    return jnp.stack([lo, hi], axis=-1).reshape(p.shape[0], -1)
+
+
+def quantize(x: jax.Array, bits: int = 8, num_groups: Optional[int] = None,
+             symmetric: bool = True,
+             stochastic: bool = False,
+             rng: Optional[jax.Array] = None) -> QuantizedTensor:
+    """Group-wise quantization (reference: ds_quantize_* /
+    ds_sr_quantize_* sym/asym families)."""
+    assert bits in (4, 8), bits
+    orig_shape, orig_dtype = tuple(x.shape), x.dtype
+    if num_groups is None:
+        num_groups = max(1, x.size // 2048)
+        while x.size % num_groups:
+            num_groups -= 1
+    g = _group(x.astype(jnp.float32), num_groups)
+    qmax = float(2 ** (bits - 1) - 1)          # 127 / 7
+    qmin = -qmax - 1
+    if symmetric:
+        scale = jnp.max(jnp.abs(g), axis=1, keepdims=True) / qmax
+        scale = jnp.where(scale == 0, 1.0, scale)
+        zero = None
+        t = g / scale
+    else:
+        gmin = jnp.min(g, axis=1, keepdims=True)
+        gmax = jnp.max(g, axis=1, keepdims=True)
+        scale = (gmax - gmin) / (qmax - qmin)
+        scale = jnp.where(scale == 0, 1.0, scale)
+        zero = gmin - qmin * scale
+        t = (g - zero) / scale
+    if stochastic:
+        # stochastic rounding (reference: ds_sr_quantize_*)
+        assert rng is not None, "stochastic quantization needs rng"
+        t = jnp.floor(t + jax.random.uniform(rng, t.shape))
+    else:
+        t = jnp.round(t)
+    q = jnp.clip(t, qmin, qmax).astype(jnp.int8)
+    if bits == 4:
+        q = _pack_int4(q)
+    return QuantizedTensor(q, scale, zero, bits, orig_shape, orig_dtype)
+
+
+def dequantize(qt: QuantizedTensor, dtype=None) -> jax.Array:
+    """(reference: dequantize / dequantize_int4_to_half_experimental)."""
+    q = _unpack_int4(qt.data) if qt.bits == 4 else qt.data
+    g = q.astype(jnp.float32) * qt.scale
+    if qt.zero is not None:
+        g = g + qt.zero
+    return g.reshape(qt.shape).astype(dtype or qt.dtype)
+
+
+def quantized_reduction(qts, dtype=jnp.float32) -> jax.Array:
+    """Dequantize-and-mean over a sequence of quantized tensors — the qgZ
+    core primitive (reference: quant_reduce.cu ``quantized_reduction``)."""
+    acc = dequantize(qts[0], jnp.float32)
+    for qt in qts[1:]:
+        acc = acc + dequantize(qt, jnp.float32)
+    return (acc / len(qts)).astype(dtype)
+
+
+# --------------------------------------------------------------------------
+# Quantized collectives — call INSIDE shard_map (manual mesh axes)
+# --------------------------------------------------------------------------
+
+def quantized_all_gather(x: jax.Array, axis_name: str, bits: int = 8,
+                         num_groups: Optional[int] = None,
+                         gather_dim: int = 0) -> jax.Array:
+    """qwZ: quantize the local shard, all-gather int data + scales,
+    dequantize (reference: CUDAQuantizer gather path
+    partition_parameters.py:753 + AllGatherCoalescedHandle.wait dequant
+    partition_parameters.py:675).  Wire bytes: 1/2 (int8) or 1/4 (int4)
+    of bf16."""
+    qt = quantize(x, bits=bits, num_groups=num_groups)
+    data = jax.lax.all_gather(qt.data, axis_name)          # [n, ...]
+    scale = jax.lax.all_gather(qt.scale, axis_name)
+    n = data.shape[0]
+    parts = [dequantize(QuantizedTensor(data[i], scale[i], None, bits,
+                                        qt.shape, qt.dtype))
+             for i in range(n)]
+    return jnp.concatenate(parts, axis=gather_dim)
+
+
+def quantized_psum_scatter(x: jax.Array, axis_name: str, bits: int = 8,
+                           num_groups: Optional[int] = None,
+                           mean: bool = False) -> jax.Array:
+    """qgZ single-hop: split the local (unreduced) tensor into one chunk
+    per rank along dim 0, quantize each, all-to-all, dequantize and reduce
+    locally (reference: all_to_all_quant_reduce
+    runtime/comm/coalesced_collectives.py + quant_reduce.cu).  Wire bytes:
+    int8/int4 instead of fp32 — 4-8x less reduce traffic."""
+    n = jax.lax.axis_size(axis_name)
+    assert x.shape[0] % n == 0, (x.shape, n)
+    chunks = x.reshape(n, x.shape[0] // n, *x.shape[1:])
+    qt = quantize(chunks, bits=bits,
+                  num_groups=(num_groups or 1) * n)
+    # regroup so each destination's scales travel with its data
+    data = qt.data.reshape(n, -1)
+    scale = qt.scale.reshape(n, -1)
+    data = jax.lax.all_to_all(data, axis_name, split_axis=0, concat_axis=0,
+                              tiled=False)
+    scale = jax.lax.all_to_all(scale, axis_name, split_axis=0,
+                               concat_axis=0, tiled=False)
+    per_rank_shape = chunks.shape[1:]
+    acc = jnp.zeros(per_rank_shape, jnp.float32)
+    groups_per_rank = qt.scale.shape[0] // n
+    for i in range(n):
+        q_i = QuantizedTensor(
+            data[i].reshape(groups_per_rank, -1),
+            scale[i].reshape(groups_per_rank, 1), None, bits,
+            per_rank_shape, jnp.float32)
+        acc = acc + dequantize(q_i)
+    if mean:
+        acc = acc / n
+    return acc.astype(x.dtype)
+
+
+def swizzle_quant(x: jax.Array, bits: int = 8,
+                  num_groups: Optional[int] = None) -> QuantizedTensor:
+    """Layout-compat shim (reference: swizzle_quant — an interleaved
+    layout for hierarchical all-to-all on NVLink+IB topologies).  XLA owns
+    collective layouts on TPU, so this is plain grouped quantization."""
+    return quantize(x, bits=bits, num_groups=num_groups)

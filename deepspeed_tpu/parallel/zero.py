@@ -56,13 +56,20 @@ class ZeroPolicy:
     # (stage_1_and_2.py:646): minimises host DRAM per rank and keeps every
     # leaf partitioned, which XLA host-memory placement requires.
     offload: bool = False
+    # hpZ (ZeRO++ secondary partition, zero_hpz_partition_size): compute
+    # params shard over the small intra-slice fsdp axis (cheap ICI
+    # gathers) while master/opt/grads shard over the full data x fsdp
+    # world — the engine shrinks the fsdp axis to the hpz size and folds
+    # the rest into data (reference: ds_secondary_tensor, groups.py:529).
+    hpz: bool = False
 
     @classmethod
     def from_config(cls, zcfg: ZeroConfig, topology: MeshTopology,
                     rules: Optional[Dict[str, Sequence[str]]] = None) -> "ZeroPolicy":
         return cls(stage=zcfg.stage, topology=topology, rules=rules,
                    param_persistence_threshold=zcfg.param_persistence_threshold,
-                   offload=zcfg.offload_optimizer.device == "cpu")
+                   offload=zcfg.offload_optimizer.device == "cpu",
+                   hpz=zcfg.zero_hpz_partition_size > 1)
 
     # ---- spec builders ---------------------------------------------------
     def _tp_spec(self, axes, shape) -> P:
@@ -81,7 +88,7 @@ class ZeroPolicy:
         spec = self._tp_spec(axes, shape)
         if self.stage >= 1:
             spec = shd.add_fsdp_to_spec(spec, shape, self.topology, min_size=0)
-        if self.offload:
+        if self.offload or self.hpz:
             spec = shd.add_fsdp_to_spec(spec, shape, self.topology, min_size=0,
                                         axis=shd.DATA_AXIS)
         return spec

@@ -1,0 +1,8 @@
+from .flops_profiler import (FlopsProfiler, analyze_fn, duration_to_string,
+                             flops_to_string, get_model_profile,
+                             macs_to_string, number_to_string,
+                             params_to_string, time_fn)
+
+__all__ = ["FlopsProfiler", "analyze_fn", "time_fn", "get_model_profile",
+           "flops_to_string", "macs_to_string", "params_to_string",
+           "number_to_string", "duration_to_string"]
