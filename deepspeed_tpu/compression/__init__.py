@@ -1,0 +1,9 @@
+from .compress import (CompressionScheduler, TechniqueSpec,
+                       activation_quantization, head_pruning,
+                       init_compression, redundancy_clean, row_pruning,
+                       sparse_pruning, weight_quantization)
+
+__all__ = ["CompressionScheduler", "TechniqueSpec", "init_compression",
+           "redundancy_clean", "weight_quantization",
+           "activation_quantization", "sparse_pruning", "row_pruning",
+           "head_pruning"]

@@ -1,0 +1,129 @@
+"""Elastic training: device-count-compatible batch size planning.
+
+TPU-native equivalent of the reference elasticity module
+(``elasticity/elasticity.py`` — candidate batch composition :83, valid
+device counts :126, ``compute_elastic_config`` :233, config-immutability
+enforcement :208).  The torchelastic agent (``elastic_agent.py:32``) has
+no analog here: membership changes restart the job and resume from the
+fragment checkpoint store (deepspeed_tpu/checkpoint — shape-shifting
+resume is the default), so elasticity reduces to *planning*: pick a
+train batch size divisible under every admissible device count.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, List, Sequence, Tuple
+
+from ..utils.logging import logger
+
+LATEST_ELASTICITY_VERSION = 0.2
+
+
+class ElasticityError(ValueError):
+    pass
+
+
+def get_candidate_batch_sizes(base_list: Sequence[int],
+                              max_acc_step: int) -> List[int]:
+    """All micro_batch * gas products under the cap
+    (reference: elasticity.py:83 get_candidate_batch_sizes)."""
+    out = set()
+    for base in base_list:
+        for acc in range(1, max_acc_step + 1):
+            out.add(base * acc)
+    return sorted(out)
+
+
+def get_valid_devices(batch_size: int, micro_batches: Sequence[int],
+                      min_devices: int, max_devices: int) -> List[int]:
+    """Device counts that evenly tile ``batch_size`` with some micro batch
+    (reference: elasticity.py:126 get_valid_gpus)."""
+    valid = set()
+    for mb in micro_batches:
+        if batch_size % mb:
+            continue
+        replicas = batch_size // mb
+        for n in range(min_devices, max_devices + 1):
+            if replicas % n == 0:
+                valid.add(n)
+    return sorted(valid)
+
+
+def _best_candidate(candidates: Sequence[int], micro_batches: Sequence[int],
+                    min_devices: int, max_devices: int,
+                    prefer_larger: bool) -> Tuple[int, List[int]]:
+    best_batch, best_valid = -1, []
+    for b in sorted(candidates, reverse=prefer_larger):
+        valid = get_valid_devices(b, micro_batches, min_devices, max_devices)
+        if len(valid) > len(best_valid) or (
+                len(valid) == len(best_valid) and best_batch < 0):
+            best_batch, best_valid = b, valid
+    if best_batch < 0 or not best_valid:
+        raise ElasticityError(
+            f"no compatible batch size for micro_batches={micro_batches} "
+            f"devices [{min_devices}, {max_devices}]")
+    return best_batch, best_valid
+
+
+def compute_elastic_config(ds_config: Dict, target_deviation: float = 0.0,
+                           world_size: int = 0):
+    """(reference: elasticity.py:233 compute_elastic_config).
+
+    Returns ``(final_batch_size, valid_device_counts, micro_batch)`` —
+    micro batch only when ``world_size`` is given.
+    """
+    ecfg = ds_config.get("elasticity", {})
+    if not ecfg.get("enabled", False):
+        raise ElasticityError("elasticity block missing or disabled")
+    version = float(ecfg.get("version", LATEST_ELASTICITY_VERSION))
+    micro_batches = list(ecfg.get("micro_batch_sizes", [2, 4, 6]))
+    max_batch = int(ecfg.get("max_train_batch_size", 2000))
+    min_dev = int(ecfg.get("min_devices", ecfg.get("min_gpus", 1)))
+    max_dev = int(ecfg.get("max_devices", ecfg.get("max_gpus", 10000)))
+    prefer_larger = bool(ecfg.get("prefer_larger_batch", True))
+    if version not in (0.1, 0.2):
+        raise ElasticityError(f"unknown elasticity version {version}")
+    if any(mb <= 0 for mb in micro_batches):
+        raise ElasticityError(f"bad micro_batch_sizes {micro_batches}")
+
+    max_acc = max_batch // min(micro_batches)
+    candidates = [b for b in get_candidate_batch_sizes(micro_batches, max_acc)
+                  if b <= max_batch]
+    if version >= 0.2:
+        # v0.2 restriction: device count must also satisfy the
+        # min/max window exactly (reference: _get_compatible_gpus_v02)
+        candidates = [b for b in candidates
+                      if get_valid_devices(b, micro_batches, min_dev,
+                                           max_dev)]
+    final_batch, valid = _best_candidate(candidates, micro_batches,
+                                         min_dev, max_dev, prefer_larger)
+
+    if world_size > 0:
+        if world_size not in valid:
+            raise ElasticityError(
+                f"world size {world_size} incompatible with elastic batch "
+                f"{final_batch} (valid: {valid})")
+        for mb in sorted(micro_batches, reverse=True):
+            if final_batch % (mb * world_size) == 0:
+                return final_batch, valid, mb
+        raise ElasticityError(
+            f"no micro batch fits batch={final_batch} world={world_size}")
+    return final_batch, valid
+
+
+def elasticity_fingerprint(ds_config: Dict) -> str:
+    """Hash of the elasticity block — runs must not silently change it
+    (reference: elasticity.py:208 enforced immutability)."""
+    blob = json.dumps(ds_config.get("elasticity", {}), sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def ensure_immutable(ds_config: Dict, recorded_fingerprint: str) -> None:
+    fp = elasticity_fingerprint(ds_config)
+    if fp != recorded_fingerprint:
+        raise ElasticityError(
+            "elasticity config changed across runs "
+            f"({recorded_fingerprint} -> {fp}); elastic jobs must keep it "
+            "fixed so every restart computes the same batch plan")

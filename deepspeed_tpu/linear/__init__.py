@@ -1,0 +1,7 @@
+from .optimized_linear import (LoRAConfig, QuantizationConfig,
+                               apply_optimized_linear,
+                               init_optimized_linear, merge_lora,
+                               trainable_filter)
+
+__all__ = ["LoRAConfig", "QuantizationConfig", "init_optimized_linear",
+           "apply_optimized_linear", "merge_lora", "trainable_filter"]

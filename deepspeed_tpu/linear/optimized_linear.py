@@ -1,0 +1,110 @@
+"""OptimizedLinear: LoRA adapters over quantized frozen base weights.
+
+TPU-native equivalent of the reference ``deepspeed/linear``
+(``linear/optimized_linear.py`` — LoRAOptimizedLinear with
+``LoRAConfig(lora_r, lora_alpha, base_weight_sharding)``;
+``linear/quantization.py`` QuantizedParameter via the fp_quantizer op
+``csrc/fp_quantizer/fp_quantize.cpp``; config classes ``linear/config.py``).
+
+Functional formulation: the base weight is stored quantized (int8 groups
+or fp8) and dequantized on use — XLA fuses the dequant into the matmul
+epilogue; only the LoRA factors train.  ``y = x @ W_q + (alpha/r) x A B``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.quant import QuantizedTensor, dequantize, fp_quantize, quantize
+
+
+@dataclass
+class LoRAConfig:
+    """(reference: linear/config.py LoRAConfig)."""
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    # reference shards the frozen base over this many ranks; here the
+    # base follows normal logical-axis sharding, kept for config parity
+    base_weight_sharding: int = 1
+
+
+@dataclass
+class QuantizationConfig:
+    """(reference: linear/config.py QuantizationConfig — q_bits 6/8/12
+    via fp_quantizer).  TPU formats: grouped int8/int4, or fp8."""
+    q_bits: int = 8
+    group_size: int = 512
+    format: str = "int"            # int | fp8_e4m3 | fp8_e5m2
+
+
+def quantize_base(w: jax.Array,
+                  qcfg: Optional[QuantizationConfig]) -> Any:
+    if qcfg is None:
+        return w
+    if qcfg.format.startswith("fp8"):
+        return fp_quantize(w, fmt=qcfg.format)
+    from ..ops.quant import default_groups
+    return quantize(w, bits=qcfg.q_bits,
+                    num_groups=default_groups(w.size, qcfg.group_size))
+
+
+def base_matmul(x: jax.Array, base: Any) -> jax.Array:
+    w = dequantize(base, x.dtype) if isinstance(
+        base, QuantizedTensor) else base.astype(x.dtype)
+    return x @ w
+
+
+def init_optimized_linear(rng: jax.Array, in_dim: int, out_dim: int,
+                          lora: Optional[LoRAConfig] = None,
+                          quant: Optional[QuantizationConfig] = None,
+                          dtype=jnp.float32,
+                          base_weight: Optional[jax.Array] = None
+                          ) -> Dict[str, Any]:
+    """Build the parameter dict.  ``base`` is frozen (and quantized when
+    requested); ``lora_a``/``lora_b`` are the trainable factors."""
+    k_base, k_a = jax.random.split(rng)
+    if base_weight is None:
+        base_weight = (jax.random.normal(k_base, (in_dim, out_dim)) *
+                       (1.0 / np.sqrt(in_dim))).astype(dtype)
+    params: Dict[str, Any] = {"base": quantize_base(base_weight, quant)}
+    if lora is not None:
+        params["lora_a"] = (jax.random.normal(k_a, (in_dim, lora.lora_r)) *
+                            (1.0 / np.sqrt(in_dim))).astype(dtype)
+        params["lora_b"] = jnp.zeros((lora.lora_r, out_dim), dtype)
+    return params
+
+
+def apply_optimized_linear(params: Dict[str, Any], x: jax.Array,
+                           lora: Optional[LoRAConfig] = None) -> jax.Array:
+    y = base_matmul(x, params["base"])
+    if lora is not None and "lora_a" in params:
+        scale = lora.lora_alpha / lora.lora_r
+        y = y + scale * ((x @ params["lora_a"]) @ params["lora_b"])
+    return y
+
+
+def trainable_filter(params: Any) -> Any:
+    """True for leaves that should receive gradients (LoRA factors);
+    the frozen quantized base is excluded (reference: LoRAOptimizedLinear
+    freezes the base weight)."""
+    def mark(path, leaf):
+        keys = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        return not any(k == "base" for k in keys)
+    return jax.tree_util.tree_map_with_path(mark, params)
+
+
+def merge_lora(params: Dict[str, Any],
+               lora: LoRAConfig) -> jax.Array:
+    """Fuse adapters into a dense weight (reference:
+    hybrid_engine.py:141 lora fuse used for inference)."""
+    w = dequantize(params["base"]) if isinstance(
+        params["base"], QuantizedTensor) else params["base"]
+    if "lora_a" in params:
+        scale = lora.lora_alpha / lora.lora_r
+        w = w + scale * (params["lora_a"] @ params["lora_b"])
+    return w

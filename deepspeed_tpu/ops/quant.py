@@ -44,6 +44,15 @@ def _group(x: jax.Array, num_groups: int) -> jax.Array:
     return flat.reshape(num_groups, -1)
 
 
+def default_groups(size: int, target_group_size: int = 2048) -> int:
+    """Largest group count dividing ``size`` with groups >= the target
+    group size (shared by every grouped-quant entry point)."""
+    groups = max(1, size // target_group_size)
+    while size % groups:
+        groups -= 1
+    return groups
+
+
 def _pack_int4(q: jax.Array) -> jax.Array:
     """Two int4 values per int8 byte (reference: quantize_int4 layout)."""
     q = q.reshape(q.shape[0], -1, 2)
@@ -71,9 +80,7 @@ def quantize(x: jax.Array, bits: int = 8, num_groups: Optional[int] = None,
     assert bits in (4, 8), bits
     orig_shape, orig_dtype = tuple(x.shape), x.dtype
     if num_groups is None:
-        num_groups = max(1, x.size // 2048)
-        while x.size % num_groups:
-            num_groups -= 1
+        num_groups = default_groups(x.size)
     g = _group(x.astype(jnp.float32), num_groups)
     qmax = float(2 ** (bits - 1) - 1)          # 127 / 7
     qmin = -qmax - 1
@@ -173,6 +180,33 @@ def quantized_psum_scatter(x: jax.Array, axis_name: str, bits: int = 8,
     if mean:
         acc = acc / n
     return acc.astype(x.dtype)
+
+
+_FP8_FORMATS = {
+    "fp8_e4m3": (jnp.float8_e4m3fn, 448.0),
+    "fp8_e5m2": (jnp.float8_e5m2, 57344.0),
+}
+
+
+def fp_quantize(x: jax.Array, fmt: str = "fp8_e4m3",
+                num_groups: Optional[int] = None) -> QuantizedTensor:
+    """Float-to-float quantization (reference: csrc/fp_quantizer/
+    fp_quantize.cpp — FP6/FP8/FP12 ``quantize``/``get_scales``).  TPU has
+    native fp8 dtypes; per-group scales stretch each group onto the
+    format's dynamic range.  FP6/FP12 have no hardware type here — use
+    grouped int quantization (``quantize``) for sub-byte widths."""
+    if fmt not in _FP8_FORMATS:
+        raise ValueError(f"unknown fp format {fmt!r}; "
+                         f"known: {sorted(_FP8_FORMATS)}")
+    dtype, fmax = _FP8_FORMATS[fmt]
+    orig_shape, orig_dtype = tuple(x.shape), x.dtype
+    if num_groups is None:
+        num_groups = default_groups(x.size)
+    g = _group(x.astype(jnp.float32), num_groups)
+    scale = jnp.max(jnp.abs(g), axis=1, keepdims=True) / fmax
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = (g / scale).astype(dtype)
+    return QuantizedTensor(q, scale, None, 8, orig_shape, orig_dtype)
 
 
 def swizzle_quant(x: jax.Array, bits: int = 8,

@@ -1,0 +1,181 @@
+"""Data efficiency: curriculum learning, difficulty sampling, random-LTD.
+
+TPU-native equivalents of the reference data-efficiency suite
+(``runtime/data_pipeline/`` — ``curriculum_scheduler.py``
+CurriculumScheduler with fixed_linear/fixed_root/fixed_discrete/custom
+schedules; ``data_sampling/data_sampler.py`` DeepSpeedDataSampler
+difficulty-indexed batches; ``data_routing/basic_layer.py:113`` RandomLTD
+layerwise token dropping + its scheduler; ``csrc/random_ltd/`` gather/
+scatter kernels — jnp.take_along_axis subsumes them, SURVEY §2.2).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import logger
+
+
+# --------------------------------------------------------------------------
+# Curriculum scheduler (reference: curriculum_scheduler.py)
+# --------------------------------------------------------------------------
+
+class CurriculumScheduler:
+    """difficulty(step): min_difficulty -> max_difficulty.
+
+    schedule_type: fixed_linear | fixed_root | fixed_discrete | custom
+    (reference: CurriculumScheduler.__init__ legal types).
+    """
+
+    def __init__(self, config: Dict):
+        self.min = int(config["min_difficulty"])
+        self.max = int(config["max_difficulty"])
+        self.type = config["schedule_type"]
+        cfg = config.get("schedule_config", {})
+        self.cfg = cfg
+        self.custom_fn: Optional[Callable[[int], int]] = config.get(
+            "custom_fn")
+        if self.type in ("fixed_linear", "fixed_root"):
+            self.total_step = int(cfg["total_curriculum_step"])
+            self.diff_step = int(cfg.get("difficulty_step", 1))
+            self.root = float(cfg.get("root_degree",
+                                      1 if self.type == "fixed_linear"
+                                      else 2))
+        elif self.type == "fixed_discrete":
+            self.difficulties: List[int] = list(cfg["difficulty"])
+            self.max_steps: List[int] = list(cfg["max_step"])
+            assert len(self.difficulties) == len(self.max_steps) + 1
+        elif self.type == "custom":
+            assert self.custom_fn is not None, "custom schedule needs fn"
+        else:
+            raise ValueError(f"unknown schedule_type {self.type!r}")
+
+    def get_difficulty(self, step: int) -> int:
+        if self.type == "custom":
+            return int(self.custom_fn(step))
+        if self.type == "fixed_discrete":
+            for d, s in zip(self.difficulties, self.max_steps):
+                if step <= s:
+                    return d
+            return self.difficulties[-1]
+        frac = min(1.0, max(step, 1) / self.total_step) ** (1.0 / self.root)
+        diff = self.min + (self.max - self.min) * frac
+        diff = int(diff // self.diff_step) * self.diff_step
+        return int(min(self.max, max(self.min, diff)))
+
+    # reference parity
+    update_difficulty = get_difficulty
+
+
+def truncate_to_difficulty(batch: Dict[str, Any], difficulty: int,
+                           seq_keys: Sequence[str] = ("input_ids", "labels",
+                                                      "attention_mask"),
+                           pad_to: Optional[int] = None) -> Dict[str, Any]:
+    """Seqlen-based curriculum: truncate sequence keys to the current
+    difficulty (reference: seqlen metric path in data_sampler;
+    pad_to keeps shapes static across steps when given)."""
+    out = dict(batch)
+    for k in seq_keys:
+        if k in out and np.ndim(out[k]) >= 2:
+            v = out[k][:, :difficulty]
+            if pad_to and pad_to > difficulty:
+                pad = [(0, 0), (0, pad_to - difficulty)] + \
+                    [(0, 0)] * (np.ndim(v) - 2)
+                v = np.pad(np.asarray(v), pad)
+            out[k] = v
+    return out
+
+
+# --------------------------------------------------------------------------
+# Difficulty-indexed sampler (reference: data_sampler.py DeepSpeedDataSampler)
+# --------------------------------------------------------------------------
+
+class CurriculumDataSampler:
+    """Yields sample indices whose difficulty metric is within the
+    scheduler's current bound (reference: DeepSpeedDataSampler — the
+    cluster-index machinery reduces to a sorted-metric cursor)."""
+
+    def __init__(self, metric_values: Sequence[float],
+                 scheduler: CurriculumScheduler,
+                 batch_size: int, seed: int = 0):
+        self.metric = np.asarray(metric_values)
+        self.order = np.argsort(self.metric, kind="stable")
+        self.sched = scheduler
+        self.batch_size = batch_size
+        self.rng = np.random.RandomState(seed)
+
+    def batch_indices(self, step: int) -> np.ndarray:
+        difficulty = self.sched.get_difficulty(step)
+        eligible_n = int(np.searchsorted(
+            self.metric[self.order], difficulty, side="right"))
+        eligible = self.order[:max(eligible_n, self.batch_size)]
+        return self.rng.choice(eligible, size=self.batch_size,
+                               replace=len(eligible) < self.batch_size)
+
+
+class DataAnalyzer:
+    """Offline difficulty-metric computation (reference:
+    data_sampling/data_analyzer.py — map a metric fn over the dataset and
+    persist the index)."""
+
+    def __init__(self, metric_fn: Callable[[Any], float]):
+        self.metric_fn = metric_fn
+
+    def run(self, samples: Sequence[Any],
+            save_path: Optional[str] = None) -> np.ndarray:
+        vals = np.asarray([self.metric_fn(s) for s in samples], np.float32)
+        if save_path:
+            np.save(save_path, vals)
+        return vals
+
+
+# --------------------------------------------------------------------------
+# Random-LTD (reference: data_routing/basic_layer.py + csrc/random_ltd)
+# --------------------------------------------------------------------------
+
+class RandomLTDScheduler:
+    """Kept-token count schedule (reference: data_routing/scheduler.py —
+    linear increase from min to full seqlen)."""
+
+    def __init__(self, total_layers: int, start_tokens: int,
+                 max_tokens: int, schedule_steps: int,
+                 step_size: int = 16):
+        self.total_layers = total_layers
+        self.start = start_tokens
+        self.max = max_tokens
+        self.steps = schedule_steps
+        self.step_size = step_size
+
+    def kept_tokens(self, step: int) -> int:
+        frac = min(1.0, step / max(1, self.steps))
+        k = self.start + (self.max - self.start) * frac
+        k = int(k // self.step_size) * self.step_size
+        return int(min(self.max, max(self.start, k)))
+
+
+def random_ltd_select(x: jax.Array, keep: int, rng: jax.Array
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Sample ``keep`` token positions per batch row (sorted, so causal
+    order survives) and gather them (reference: token_sort_ +
+    gather_tokens in csrc/random_ltd/pt_binding.cpp)."""
+    B, S = x.shape[0], x.shape[1]
+    noise = jax.random.uniform(rng, (B, S))
+    idx = jnp.argsort(noise, axis=1)[:, :keep]
+    idx = jnp.sort(idx, axis=1)
+    gathered = jnp.take_along_axis(
+        x, idx.reshape(B, keep, *(1,) * (x.ndim - 2)), axis=1)
+    return gathered, idx
+
+
+def random_ltd_scatter(full: jax.Array, processed: jax.Array,
+                       idx: jax.Array) -> jax.Array:
+    """Scatter processed tokens back into the full sequence; dropped
+    positions keep their input value (reference: ScatterTokens — the
+    residual bypass for dropped tokens)."""
+    B, keep = idx.shape
+    return full.at[jnp.arange(B)[:, None], idx].set(processed)

@@ -1,0 +1,61 @@
+"""Hessian eigenvalue estimation by power iteration.
+
+TPU-native equivalent of ``runtime/eigenvalue.py`` (power iteration over
+autograd Hessian-vector products, used to schedule MoQ quantization
+periods).  jax gives exact HVPs via forward-over-reverse
+(``jvp(grad(f))``) — no double-backward graph bookkeeping.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .runtime_utils import global_norm
+
+
+class Eigenvalue:
+    """(reference: Eigenvalue.__init__ — verbose, max_iter, tol,
+    stability, gas_boundary_resolution)."""
+
+    def __init__(self, verbose: bool = False, max_iter: int = 100,
+                 tol: float = 1e-2, stability: float = 1e-6,
+                 gas_boundary_resolution: int = 1):
+        self.verbose = verbose
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.gas_boundary_resolution = gas_boundary_resolution
+
+    def compute_eigenvalue(self, loss_fn: Callable[[Any], jax.Array],
+                           params: Any,
+                           rng: jax.Array) -> Tuple[float, Any]:
+        """Dominant Hessian eigenvalue of ``loss_fn`` at ``params``.
+
+        Returns (eigenvalue, eigenvector-pytree).
+        """
+        grad_fn = jax.grad(loss_fn)
+
+        def hvp(v):
+            return jax.jvp(grad_fn, (params,), (v,))[1]
+
+        # random unit start vector
+        leaves, treedef = jax.tree.flatten(params)
+        keys = jax.random.split(rng, len(leaves))
+        v = jax.tree.unflatten(treedef, [
+            jax.random.normal(k, jnp.shape(l)) for k, l in zip(keys, leaves)])
+        eig_prev = 0.0
+        for i in range(self.max_iter):
+            n = global_norm(v) + self.stability
+            v = jax.tree.map(lambda x: x / n, v)
+            hv = hvp(v)
+            eig = float(sum(jnp.vdot(a, b) for a, b in zip(
+                jax.tree.leaves(v), jax.tree.leaves(hv))))
+            if i > 0 and abs(eig) > 0 and \
+                    abs(eig - eig_prev) / abs(eig) < self.tol:
+                break
+            eig_prev = eig
+            v = hv
+        return eig, v

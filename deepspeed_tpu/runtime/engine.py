@@ -175,7 +175,7 @@ class Engine:
         self.offload_active = False
         self._offload_validated = False
         if self.config.zero_optimization.offload_optimizer.device == "cpu":
-            if self.config.optimizer.type.lower() == "lamb":
+            if "lamb" in self.config.optimizer.type.lower():
                 # LAMB trust ratios need whole-tensor norms; the offload
                 # update runs per-shard inside shard_map, which would
                 # silently compute per-shard ratios.

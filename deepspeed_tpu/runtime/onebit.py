@@ -1,0 +1,197 @@
+"""1-bit optimizers: error-compensated compressed-momentum Adam/LAMB.
+
+TPU-native equivalents of the reference 1-bit family
+(``runtime/fp16/onebit/adam.py`` OnebitAdam, ``zoadam.py`` ZeroOneAdam,
+``lamb.py`` OnebitLamb; compressed-allreduce backends ``runtime/comm/
+nccl.py:16`` cupy bit-packing).
+
+Algorithm (1-bit Adam paper, faithfully reproduced):
+* warmup (``freeze_step`` steps): exact Adam, variance v accumulates.
+* after freeze: v is FROZEN; only momentum moves, and the momentum
+  update is compressed to sign(x)*||x||_1/n with a persistent error
+  buffer e — the worker+server error feedback that keeps the compressed
+  trajectory unbiased.
+
+Comm mapping: the reference compresses the momentum allreduce between
+DP ranks.  Under XLA SPMD, gradients are already mean-reduced when the
+optimizer runs on the (sharded) momentum, so compression here reproduces
+the reference's *numerics* (compression noise + error feedback on every
+momentum update).  Driving the wire-level volume down additionally rides
+the qgZ quantized reduce-scatter (ops/quant.quantized_psum_scatter).
+
+ZeroOneAdam (``zoadam.py``): variance update policy — v refreshes on an
+interval schedule (``var_update_scaler``) instead of freezing once, and
+1-bit compression applies between refreshes ("0/1 Adam").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .optimizers import Optimizer, _tree_unzip, _tzeros
+
+
+def _compress_1bit(x: jax.Array, err: jax.Array
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """sign * mean|.| compression with error feedback
+    (reference: compressed_allreduce cupy packing, nccl.py:16)."""
+    c = x + err
+    scale = jnp.mean(jnp.abs(c))
+    q = jnp.where(c >= 0, scale, -scale)
+    return q, c - q
+
+
+class OnebitAdamState(NamedTuple):
+    m: Any
+    v: Any
+    err: Any           # error-feedback buffers (worker+server combined)
+
+
+def onebit_adam(lr, betas=(0.9, 0.999), eps: float = 1e-8,
+                weight_decay: float = 0.0,
+                freeze_step: int = 100) -> Optimizer:
+    """(reference: runtime/fp16/onebit/adam.py OnebitAdam)."""
+    b1, b2 = betas
+    lr_fn = lr if callable(lr) else (lambda _: jnp.asarray(lr, jnp.float32))
+
+    def init(params):
+        return OnebitAdamState(m=_tzeros(params, jnp.float32),
+                               v=_tzeros(params, jnp.float32),
+                               err=_tzeros(params, jnp.float32))
+
+    def update(grads, state: OnebitAdamState, params, step):
+        step_f = step.astype(jnp.float32)
+        lr_t = lr_fn(step_f)
+        frozen = step > freeze_step
+
+        def upd(g, m, v, e, p):
+            g32 = g.astype(jnp.float32)
+            m_exact = b1 * m + (1 - b1) * g32
+            # compressed path: compress the new momentum w/ error feedback
+            m_comp, e_new = _compress_1bit(m_exact, e)
+            m_ = jnp.where(frozen, m_comp, m_exact)
+            e_ = jnp.where(frozen, e_new, e)
+            v_ = jnp.where(frozen, v, b2 * v + (1 - b2) * (g32 * g32))
+            c1 = 1 - b1 ** step_f
+            c2 = 1 - b2 ** step_f
+            delta = -lr_t * (m_ / c1) / (jnp.sqrt(v_ / c2) + eps)
+            if weight_decay:
+                delta = delta - lr_t * weight_decay * p.astype(jnp.float32)
+            return delta, m_, v_, e_
+
+        out = jax.tree.map(upd, grads, state.m, state.v, state.err, params)
+        updates, m, v, err = _tree_unzip(out, grads, 4)
+        return updates, OnebitAdamState(m=m, v=v, err=err)
+
+    return Optimizer(init, update)
+
+
+class ZeroOneAdamState(NamedTuple):
+    m: Any
+    v: Any
+    err: Any
+
+
+def zero_one_adam(lr, betas=(0.9, 0.999), eps: float = 1e-8,
+                  weight_decay: float = 0.0,
+                  var_freeze_step: int = 100,
+                  var_update_scaler: int = 16,
+                  local_step_scaler: int = 32768,
+                  local_step_clipper: int = 16) -> Optimizer:
+    """0/1 Adam (reference: runtime/fp16/onebit/zoadam.py ZeroOneAdam):
+    variance refreshes on an exponentially-spaced interval — the k-th
+    refresh happens at step ``var_update_scaler * 2^k`` with the exponent
+    capped at ``local_step_clipper`` (and never past
+    min(var_freeze_step, local_step_scaler)); compressed momentum in
+    between."""
+    b1, b2 = betas
+    lr_fn = lr if callable(lr) else (lambda _: jnp.asarray(lr, jnp.float32))
+    freeze = min(var_freeze_step, local_step_scaler)
+
+    def init(params):
+        return ZeroOneAdamState(m=_tzeros(params, jnp.float32),
+                                v=_tzeros(params, jnp.float32),
+                                err=_tzeros(params, jnp.float32))
+
+    def update(grads, state: ZeroOneAdamState, params, step):
+        step_f = step.astype(jnp.float32)
+        lr_t = lr_fn(step_f)
+        # v refreshes every step through the first interval (warm start),
+        # then at exponentially-spaced steps scaler*2^k (k capped at
+        # local_step_clipper) until the freeze point
+        q = jnp.maximum(step // var_update_scaler, 1)
+        is_pow2 = (q & (q - 1)) == 0
+        capped = q <= (1 << local_step_clipper)
+        on_schedule = jnp.logical_and(step % var_update_scaler == 0,
+                                      jnp.logical_and(is_pow2, capped))
+        refresh = jnp.logical_or(
+            step <= var_update_scaler,
+            jnp.logical_and(on_schedule, step <= freeze))
+
+        def upd(g, m, v, e, p):
+            g32 = g.astype(jnp.float32)
+            m_exact = b1 * m + (1 - b1) * g32
+            m_comp, e_new = _compress_1bit(m_exact, e)
+            m_ = jnp.where(refresh, m_exact, m_comp)
+            e_ = jnp.where(refresh, e, e_new)
+            v_ = jnp.where(refresh, b2 * v + (1 - b2) * (g32 * g32), v)
+            c1 = 1 - b1 ** step_f
+            c2 = 1 - b2 ** step_f
+            delta = -lr_t * (m_ / c1) / (jnp.sqrt(v_ / c2) + eps)
+            if weight_decay:
+                delta = delta - lr_t * weight_decay * p.astype(jnp.float32)
+            return delta, m_, v_, e_
+
+        out = jax.tree.map(upd, grads, state.m, state.v, state.err, params)
+        updates, m, v, err = _tree_unzip(out, grads, 4)
+        return updates, ZeroOneAdamState(m=m, v=v, err=err)
+
+    return Optimizer(init, update)
+
+
+def onebit_lamb(lr, betas=(0.9, 0.999), eps: float = 1e-6,
+                weight_decay: float = 0.0, freeze_step: int = 100,
+                min_trust: float = 0.01, max_trust: float = 10.0) -> Optimizer:
+    """(reference: runtime/fp16/onebit/lamb.py OnebitLamb — compressed
+    momentum + per-tensor trust ratio after freeze)."""
+    b1, b2 = betas
+    lr_fn = lr if callable(lr) else (lambda _: jnp.asarray(lr, jnp.float32))
+
+    def init(params):
+        return OnebitAdamState(m=_tzeros(params, jnp.float32),
+                               v=_tzeros(params, jnp.float32),
+                               err=_tzeros(params, jnp.float32))
+
+    def update(grads, state: OnebitAdamState, params, step):
+        step_f = step.astype(jnp.float32)
+        lr_t = lr_fn(step_f)
+        frozen = step > freeze_step
+
+        def upd(g, m, v, e, p):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            m_exact = b1 * m + (1 - b1) * g32
+            m_comp, e_new = _compress_1bit(m_exact, e)
+            m_ = jnp.where(frozen, m_comp, m_exact)
+            e_ = jnp.where(frozen, e_new, e)
+            v_ = jnp.where(frozen, v, b2 * v + (1 - b2) * (g32 * g32))
+            c1 = 1 - b1 ** step_f
+            c2 = 1 - b2 ** step_f
+            u = (m_ / c1) / (jnp.sqrt(v_ / c2) + eps)
+            if weight_decay:
+                u = u + weight_decay * p32
+            w_norm = jnp.linalg.norm(p32.ravel())
+            u_norm = jnp.linalg.norm(u.ravel())
+            trust = jnp.where((w_norm > 0) & (u_norm > 0),
+                              jnp.clip(w_norm / u_norm, min_trust,
+                                       max_trust), 1.0)
+            return -lr_t * trust * u, m_, v_, e_
+
+        out = jax.tree.map(upd, grads, state.m, state.v, state.err, params)
+        updates, m, v, err = _tree_unzip(out, grads, 4)
+        return updates, OnebitAdamState(m=m, v=v, err=err)
+
+    return Optimizer(init, update)

@@ -252,6 +252,13 @@ def sgd(lr, momentum: float = 0.0, weight_decay: float = 0.0,
 # Registry (reference: engine._configure_basic_optimizer engine.py:1322)
 # --------------------------------------------------------------------------
 
+def _onebit(name):
+    def build(lr, **kw):
+        from . import onebit
+        return getattr(onebit, name)(lr, **kw)
+    return build
+
+
 OPTIMIZERS: Dict[str, Callable[..., Optimizer]] = {
     "adam": adam,
     "adamw": adamw,
@@ -259,6 +266,11 @@ OPTIMIZERS: Dict[str, Callable[..., Optimizer]] = {
     "lamb": lamb,
     "adagrad": adagrad,
     "sgd": sgd,
+    # 1-bit family (reference: OnebitAdam/ZeroOneAdam/OnebitLamb,
+    # engine.py:1322 name keys onebitadam/zerooneadam/onebitlamb)
+    "onebitadam": _onebit("onebit_adam"),
+    "zerooneadam": _onebit("zero_one_adam"),
+    "onebitlamb": _onebit("onebit_lamb"),
 }
 
 

@@ -1,0 +1,34 @@
+"""Progressive layer drop (reference: ``runtime/progressive_layer_drop.py``
+— PLD theta schedule theta(t) = (1-theta)·exp(-gamma·t)+theta; consumed
+by the transformer's per-layer keep probability)."""
+
+from __future__ import annotations
+
+import math
+
+
+class ProgressiveLayerDrop:
+    """(reference: ProgressiveLayerDrop.__init__/update_state)."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = ((1.0 - self.theta) *
+                              math.exp(-self.gamma * global_step) +
+                              self.theta)
+        return self.current_theta
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def layer_keep_prob(self, layer_idx: int, num_layers: int) -> float:
+        """Per-layer keep probability: deeper layers drop more
+        (reference PLD paper schedule: 1 - (i/L)(1-theta))."""
+        return 1.0 - (layer_idx / max(1, num_layers)) * \
+            (1.0 - self.current_theta)

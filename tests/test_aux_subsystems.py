@@ -1,0 +1,210 @@
+"""Launcher / elasticity / OptimizedLinear / compression tests
+(reference analogs: tests/unit/launcher/, tests/unit/elasticity/,
+tests/unit/linear/, tests/unit/compression/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+
+
+class TestLauncher:
+    def test_parse_hostfile(self):
+        from deepspeed_tpu.launcher import parse_hostfile
+
+        hosts = parse_hostfile("""
+        # comment
+        worker-0 slots=4
+        worker-1 slots=4
+        worker-2
+        """)
+        assert list(hosts) == ["worker-0", "worker-1", "worker-2"]
+        assert hosts["worker-0"] == 4 and hosts["worker-2"] == 1
+
+    def test_include_exclude(self):
+        from deepspeed_tpu.launcher import (parse_hostfile,
+                                            parse_inclusion_exclusion)
+
+        hosts = parse_hostfile("\n".join(
+            f"worker-{i} slots=4" for i in range(4)))
+        inc = parse_inclusion_exclusion(hosts, include="worker-[0-1]")
+        assert list(inc) == ["worker-0", "worker-1"]
+        exc = parse_inclusion_exclusion(hosts, exclude="worker-3")
+        assert list(exc) == ["worker-0", "worker-1", "worker-2"]
+        slot = parse_inclusion_exclusion(hosts, include="worker-0:0,1")
+        assert slot == {"worker-0": 2}
+        with pytest.raises(ValueError):
+            parse_inclusion_exclusion(hosts, include="a", exclude="b")
+
+    def test_runner_commands(self, tmp_path):
+        from deepspeed_tpu.launcher.runner import (SSHRunner, build_parser,
+                                                   parse_hostfile)
+
+        args = build_parser().parse_args(
+            ["--master_port", "12345", "train.py", "--lr", "0.1"])
+        hosts = parse_hostfile("h0 slots=1\nh1 slots=1")
+        r = SSHRunner(args, hosts)
+        cmds = r.launch_cmds()
+        assert len(cmds) == 2
+        host, cmd = cmds[1]
+        joined = " ".join(cmd)
+        assert cmd[0] == "ssh" and host == "h1"
+        assert "DSPD_PROCESS_ID=1" in joined
+        assert "DSPD_NUM_PROCESSES=2" in joined
+        assert "h0:12345" in joined          # coordinator = first host
+        assert "train.py --lr 0.1" in joined
+
+    def test_local_launch_executes(self, tmp_path):
+        import subprocess, sys
+
+        script = tmp_path / "job.py"
+        script.write_text("print('JOB_RAN', flush=True)")
+        out = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+             str(script)], capture_output=True, text=True, timeout=120,
+            cwd="/root/repo")
+        assert "JOB_RAN" in out.stdout, out.stderr
+
+
+class TestElasticity:
+    def test_compute_elastic_config(self):
+        from deepspeed_tpu.elasticity import compute_elastic_config
+
+        cfg = {"elasticity": {
+            "enabled": True, "max_train_batch_size": 100,
+            "micro_batch_sizes": [2, 4], "min_devices": 1,
+            "max_devices": 8, "version": 0.2}}
+        batch, valid = compute_elastic_config(cfg)
+        assert batch <= 100
+        # every valid device count divides the batch with some micro batch
+        for n in valid:
+            assert any(batch % (mb * n) == 0 for mb in (2, 4))
+        b2, v2, micro = compute_elastic_config(cfg, world_size=valid[0])
+        assert b2 == batch and micro in (2, 4)
+
+    def test_incompatible_world_size(self):
+        from deepspeed_tpu.elasticity import (ElasticityError,
+                                              compute_elastic_config)
+
+        cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 8,
+                              "micro_batch_sizes": [8],
+                              "min_devices": 1, "max_devices": 1}}
+        with pytest.raises(ElasticityError):
+            compute_elastic_config(cfg, world_size=7)
+
+    def test_fingerprint_immutability(self):
+        from deepspeed_tpu.elasticity import (ElasticityError,
+                                              elasticity_fingerprint,
+                                              ensure_immutable)
+
+        c1 = {"elasticity": {"enabled": True, "max_train_batch_size": 64}}
+        fp = elasticity_fingerprint(c1)
+        ensure_immutable(c1, fp)
+        c2 = {"elasticity": {"enabled": True, "max_train_batch_size": 32}}
+        with pytest.raises(ElasticityError):
+            ensure_immutable(c2, fp)
+
+
+class TestOptimizedLinear:
+    def test_lora_quantized_forward(self):
+        from deepspeed_tpu.linear import (LoRAConfig, QuantizationConfig,
+                                          apply_optimized_linear,
+                                          init_optimized_linear)
+
+        lora = LoRAConfig(lora_r=8, lora_alpha=16)
+        p = init_optimized_linear(jax.random.PRNGKey(0), 32, 64, lora=lora,
+                                  quant=QuantizationConfig(q_bits=8))
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 32))
+        y = apply_optimized_linear(p, x, lora=lora)
+        assert y.shape == (4, 64)
+        # lora_b starts at zero => output equals quantized base matmul
+        from deepspeed_tpu.ops.quant import dequantize
+        np.testing.assert_allclose(y, x @ dequantize(p["base"]), atol=1e-5)
+
+    def test_trainable_filter_freezes_base(self):
+        from deepspeed_tpu.linear import (LoRAConfig, init_optimized_linear,
+                                          trainable_filter)
+
+        p = init_optimized_linear(jax.random.PRNGKey(0), 16, 16,
+                                  lora=LoRAConfig(lora_r=4))
+        f = trainable_filter(p)
+        assert f["lora_a"] and f["lora_b"] and not f["base"]
+
+    def test_fp8_quantize_roundtrip(self):
+        from deepspeed_tpu.ops.quant import dequantize, fp_quantize
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (256,)) * 3
+        qt = fp_quantize(x, fmt="fp8_e4m3", num_groups=4)
+        assert qt.data.dtype == jnp.float8_e4m3fn
+        y = dequantize(qt)
+        np.testing.assert_allclose(y, x, rtol=0.1, atol=0.05)
+
+    def test_merge_lora(self):
+        from deepspeed_tpu.linear import (LoRAConfig, init_optimized_linear,
+                                          merge_lora)
+
+        lora = LoRAConfig(lora_r=4, lora_alpha=4)
+        p = init_optimized_linear(jax.random.PRNGKey(0), 16, 16, lora=lora)
+        p["lora_b"] = jnp.ones_like(p["lora_b"])
+        w = merge_lora(p, lora)
+        want = p["base"] + (p["lora_a"] @ p["lora_b"])
+        np.testing.assert_allclose(w, want, atol=1e-6)
+
+
+class TestCompression:
+    def test_sparse_pruning_ratio(self):
+        from deepspeed_tpu.compression import sparse_pruning
+
+        w = jax.random.normal(jax.random.PRNGKey(0), (64, 64))
+        pruned = sparse_pruning(w, 0.5)
+        assert float((pruned == 0).mean()) == pytest.approx(0.5, abs=0.02)
+
+    def test_row_and_head_pruning(self):
+        from deepspeed_tpu.compression import head_pruning, row_pruning
+
+        w = jax.random.normal(jax.random.PRNGKey(0), (16, 8))
+        rp = row_pruning(w, 0.25)
+        zero_rows = int((np.abs(np.asarray(rp)).sum(1) == 0).sum())
+        assert zero_rows == 4
+        hp = head_pruning(w, num_heads=4, ratio=0.5)
+        blocks = np.asarray(hp).reshape(4, 4, 8)
+        assert int((np.abs(blocks).sum((1, 2)) == 0).sum()) == 2
+
+    def test_scheduler_from_reference_config(self):
+        from deepspeed_tpu.compression import CompressionScheduler
+
+        cc = {"weight_quantization": {
+                  "shared_parameters": {"enabled": True,
+                                        "schedule_offset": 5},
+                  "different_groups": {"wq1": {
+                      "params": {"start_bits": 8, "target_bits": 8,
+                                 "quantization_groups": 4},
+                      "modules": ["w.*"]}}},
+              "sparse_pruning": {
+                  "shared_parameters": {"enabled": True,
+                                        "schedule_offset": 0},
+                  "different_groups": {"sp1": {
+                      "params": {"ratio": 0.5}, "modules": ["w2"]}}}}
+        sched = CompressionScheduler.from_config(cc)
+        params = {"w1": jax.random.normal(jax.random.PRNGKey(0), (32, 32)),
+                  "w2": jax.random.normal(jax.random.PRNGKey(1), (32, 32)),
+                  "bias": jnp.ones((32,))}
+        early = sched.apply(params, step=0)       # only pruning active
+        assert float((np.asarray(early["w2"]) == 0).mean()) >= 0.45
+        np.testing.assert_array_equal(early["w1"], params["w1"])
+        late = sched.apply(params, step=10)       # + quantization
+        assert not np.array_equal(np.asarray(late["w1"]),
+                                  np.asarray(params["w1"]))
+
+    def test_redundancy_clean(self):
+        from deepspeed_tpu.compression import redundancy_clean
+
+        cfg = {"compression_training": {"sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 100},
+            "different_groups": {"sp1": {"params": {"ratio": 0.9},
+                                         "modules": ["*"]}}}}}
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0), (64,))}
+        out = redundancy_clean(params, cfg)
+        assert float((np.asarray(out["w"]) == 0).mean()) >= 0.85

@@ -1,0 +1,138 @@
+"""Data efficiency + PLD + eigenvalue + MoQ tests (reference analogs:
+tests/unit/runtime/test_data_efficiency.py, test_pld.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumDataSampler,
+                                                 CurriculumScheduler,
+                                                 DataAnalyzer,
+                                                 RandomLTDScheduler,
+                                                 random_ltd_scatter,
+                                                 random_ltd_select,
+                                                 truncate_to_difficulty)
+
+
+class TestCurriculum:
+    def test_fixed_linear(self):
+        s = CurriculumScheduler({
+            "schedule_type": "fixed_linear", "min_difficulty": 8,
+            "max_difficulty": 128,
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 8}})
+        assert s.get_difficulty(1) == 8
+        assert s.get_difficulty(50) == 64
+        assert s.get_difficulty(100) == 128
+        assert s.get_difficulty(1000) == 128
+        # difficulty_step granularity
+        assert s.get_difficulty(51) % 8 == 0
+
+    def test_fixed_root(self):
+        s = CurriculumScheduler({
+            "schedule_type": "fixed_root", "min_difficulty": 10,
+            "max_difficulty": 100,
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 1, "root_degree": 2}})
+        # sqrt pacing: at 25% of steps, half the range is unlocked
+        assert abs(s.get_difficulty(25) - 55) <= 2
+
+    def test_fixed_discrete(self):
+        s = CurriculumScheduler({
+            "schedule_type": "fixed_discrete", "min_difficulty": 2,
+            "max_difficulty": 10,
+            "schedule_config": {"difficulty": [2, 5, 10],
+                                "max_step": [10, 20]}})
+        assert s.get_difficulty(5) == 2
+        assert s.get_difficulty(15) == 5
+        assert s.get_difficulty(25) == 10
+
+    def test_sampler_respects_difficulty(self):
+        metric = np.arange(100)          # sample i has difficulty i
+        s = CurriculumScheduler({
+            "schedule_type": "fixed_linear", "min_difficulty": 10,
+            "max_difficulty": 100,
+            "schedule_config": {"total_curriculum_step": 100}})
+        sampler = CurriculumDataSampler(metric, s, batch_size=8)
+        idx = sampler.batch_indices(step=1)
+        assert idx.max() < 12            # only easy samples early
+        idx = sampler.batch_indices(step=100)
+        assert len(idx) == 8
+
+    def test_truncate_and_analyzer(self):
+        batch = {"input_ids": np.ones((4, 64), np.int32),
+                 "labels": np.ones((4, 64), np.int32)}
+        out = truncate_to_difficulty(batch, 16)
+        assert out["input_ids"].shape == (4, 16)
+        padded = truncate_to_difficulty(batch, 16, pad_to=64)
+        assert padded["input_ids"].shape == (4, 64)
+        assert padded["input_ids"][:, 16:].sum() == 0
+        vals = DataAnalyzer(lambda s: len(s)).run(["ab", "a", "abc"])
+        np.testing.assert_array_equal(vals, [2, 1, 3])
+
+
+class TestRandomLTD:
+    def test_schedule(self):
+        s = RandomLTDScheduler(total_layers=12, start_tokens=128,
+                               max_tokens=512, schedule_steps=100,
+                               step_size=16)
+        assert s.kept_tokens(0) == 128
+        assert s.kept_tokens(100) == 512
+        assert s.kept_tokens(50) % 16 == 0
+
+    def test_select_scatter_roundtrip(self):
+        x = jnp.arange(2 * 16 * 4, dtype=jnp.float32).reshape(2, 16, 4)
+        kept, idx = random_ltd_select(x, keep=8, rng=jax.random.PRNGKey(0))
+        assert kept.shape == (2, 8, 4)
+        # sorted indices preserve causal order
+        assert (np.diff(np.asarray(idx), axis=1) > 0).all()
+        # scatter back: kept positions updated, dropped untouched
+        out = random_ltd_scatter(x, kept * 2, idx)
+        got = np.asarray(out)
+        for b in range(2):
+            for j, pos in enumerate(np.asarray(idx)[b]):
+                np.testing.assert_array_equal(got[b, pos],
+                                              np.asarray(kept)[b, j] * 2)
+
+
+class TestPLD:
+    def test_theta_schedule(self):
+        from deepspeed_tpu.runtime.progressive_layer_drop import \
+            ProgressiveLayerDrop
+
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+        assert pld.get_theta() == 1.0
+        pld.update_state(0)
+        assert pld.get_theta() == 1.0
+        pld.update_state(10**6)
+        assert abs(pld.get_theta() - 0.5) < 1e-6
+        assert pld.layer_keep_prob(0, 12) >= pld.layer_keep_prob(11, 12)
+        assert pld.get_state()["progressive_layer_drop"]
+
+
+class TestEigenvalue:
+    def test_quadratic_eigenvalue(self):
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+        # f(x) = 0.5 x^T diag(d) x -> dominant eigenvalue = max(d)
+        d = jnp.array([1.0, 5.0, 2.0])
+        loss = lambda p: 0.5 * jnp.sum(d * p["x"] ** 2)
+        ev = Eigenvalue(max_iter=200, tol=1e-4)
+        eig, vec = ev.compute_eigenvalue(
+            loss, {"x": jnp.ones(3)}, jax.random.PRNGKey(0))
+        assert abs(eig - 5.0) < 0.05
+
+
+class TestMoQ:
+    def test_progressive_bits(self):
+        from deepspeed_tpu.runtime.quantize import Quantizer
+
+        q = Quantizer(q_start_bits=16, q_target_bits=8, q_period=10)
+        assert q.current_bits(5) == 16
+        assert q.current_bits(15) == 8
+        assert q.current_bits(1000) == 8
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0), (32, 32))}
+        out = q.quantize(params, step=50)
+        assert not np.array_equal(np.asarray(out["w"]),
+                                  np.asarray(params["w"]))

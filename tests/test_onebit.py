@@ -1,0 +1,77 @@
+"""1-bit optimizer tests (reference analogs: tests/onebit/,
+tests/unit/runtime/half_precision/onebit/test_onebit.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.runtime.onebit import (onebit_adam, onebit_lamb,
+                                          zero_one_adam)
+from tests.simple_model import make_batch, make_mlp
+
+
+def _run(opt, steps=60, lr=0.1):
+    """Minimize a quadratic; return final loss."""
+    target = jnp.linspace(-1, 1, 32)
+    params = {"x": jnp.zeros(32)}
+    state = opt.init(params)
+    for i in range(1, steps + 1):
+        grads = {"x": 2 * (params["x"] - target)}
+        updates, state = opt.update(grads, state, params, jnp.int32(i))
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+    return float(jnp.mean((params["x"] - target) ** 2)), params
+
+
+class TestOnebitOptimizers:
+    def test_onebit_adam_converges(self):
+        loss, _ = _run(onebit_adam(0.05, freeze_step=20), steps=200)
+        assert loss < 1e-2
+
+    def test_zero_one_adam_converges(self):
+        loss, _ = _run(zero_one_adam(0.05, var_freeze_step=50,
+                                     var_update_scaler=8), steps=200)
+        assert loss < 1e-2
+
+    def test_onebit_lamb_converges(self):
+        # trust-ratio clamping from a zero init makes LAMB deliberate on
+        # toy quadratics; assert a solid monotone decrease instead
+        initial = float(jnp.mean(jnp.linspace(-1, 1, 32) ** 2))
+        loss, _ = _run(onebit_lamb(0.05, freeze_step=20), steps=200)
+        assert loss < 0.5 * initial
+
+    def test_variance_freezes_after_threshold(self):
+        opt = onebit_adam(0.05, freeze_step=5)
+        params = {"x": jnp.zeros(8)}
+        state = opt.init(params)
+        for i in range(1, 8):
+            grads = {"x": jnp.full(8, float(i))}
+            _, state = opt.update(grads, state, params, jnp.int32(i))
+            if i == 6:
+                v_frozen = np.asarray(state.v["x"]).copy()
+        np.testing.assert_array_equal(np.asarray(state.v["x"]), v_frozen)
+
+    def test_compression_error_feedback_accumulates(self):
+        opt = onebit_adam(0.05, freeze_step=1)
+        params = {"x": jnp.zeros(8)}
+        state = opt.init(params)
+        g = jnp.array([1.0, -2.0, 0.5, -0.25, 3.0, -1.5, 0.75, -0.1])
+        _, state = opt.update({"x": g}, state, params, jnp.int32(2))
+        # after a compressed step, the error buffer is nonzero and the
+        # momentum is sign*scale-shaped (two magnitudes only)
+        assert float(jnp.abs(state.err["x"]).sum()) > 0
+        mags = np.unique(np.round(np.abs(np.asarray(state.m["x"])), 6))
+        assert len(mags) == 1
+
+    def test_engine_integration(self):
+        p, ax, loss_fn = make_mlp()
+        eng = ds.initialize(loss_fn=loss_fn, params=p, param_axes=ax, config={
+            "train_micro_batch_size_per_device": 4,
+            "optimizer": {"type": "OnebitAdam",
+                          "params": {"lr": 1e-2, "freeze_step": 2}},
+            "mesh": {"data": 8}, "steps_per_print": 1000})
+        losses = [float(eng.train_batch(
+            make_batch(eng.train_batch_size, seed=i))["loss"])
+            for i in range(6)]
+        assert losses[-1] < losses[0]
