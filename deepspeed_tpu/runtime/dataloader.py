@@ -68,6 +68,44 @@ class DataLoader:
             yield batch
 
 
+class PrefetchingLoader:
+    """Wrap any batch iterator with device prefetch: batch N+1 uploads
+    (``engine.shard_batch``) while step N computes, hiding host->device
+    latency — the pinned-buffer async copy of the reference's loaders,
+    with XLA's async transfer doing the pipelining.
+
+    Usage::
+
+        for dev_batch in PrefetchingLoader(loader, engine):
+            engine.train_batch(dev_batch)
+    """
+
+    def __init__(self, loader, engine, depth: int = 2):
+        self.loader = loader
+        self.engine = engine
+        self.depth = max(1, depth)
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __iter__(self):
+        import collections
+        q = collections.deque()
+        it = iter(self.loader)
+        try:
+            for _ in range(self.depth):
+                q.append(self.engine.shard_batch(next(it)))
+        except StopIteration:
+            pass
+        while q:
+            out = q.popleft()
+            try:
+                q.append(self.engine.shard_batch(next(it)))
+            except StopIteration:
+                pass
+            yield out
+
+
 def synthetic_lm_data(vocab_size: int, n_samples: int, seq_len: int,
                       seed: int = 0) -> Dict[str, np.ndarray]:
     """Random-token corpus for tests/benches (reference: the random-data

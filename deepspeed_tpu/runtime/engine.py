@@ -57,6 +57,19 @@ class TrainState(NamedTuple):
     skipped: jnp.ndarray       # i32 count of overflow-skipped steps
 
 
+class _StagedBatch(dict):
+    """Marker: this batch is already device-placed (and, when staged with
+    accumulate=True and gas>1, reshaped to [gas, micro, ...])."""
+
+    accumulate: bool = True
+
+
+jax.tree_util.register_pytree_node(
+    _StagedBatch,
+    lambda d: (tuple(d[k] for k in sorted(d)), tuple(sorted(d))),
+    lambda keys, vals: _StagedBatch(zip(keys, vals)))
+
+
 class Engine:
     """TPU-native training engine (reference: DeepSpeedEngine engine.py:182)."""
 
@@ -578,23 +591,34 @@ class Engine:
         self._offload_validated = True
         self.global_steps += 1
         self.global_samples += self.train_batch_size
-        metrics = {k: np.asarray(v) for k, v in metrics.items()}
-        self._last_grad_norm = float(metrics["grad_norm"])
+        # metrics stay on device — a host fetch every step would stall the
+        # async dispatch pipeline (and on tunneled TPUs pay a round trip
+        # per value); fetch once, and only when someone actually looks
+        self._last_metrics = metrics
+        self._last_metrics_host = None
         self.tput.stop()
         fp_cfg = self.config.flops_profiler
         if fp_cfg.enabled and self.global_steps == fp_cfg.profile_step:
             self._write_flops_profile(batch, rng)
-        if self.global_steps % self.config.steps_per_print == 0:
-            log_dist(f"step={self.global_steps} loss={metrics['loss']:.4f} "
-                     f"lr={metrics['lr']:.3e} gnorm={metrics['grad_norm']:.3f} "
-                     f"tput={self.tput.avg_samples_per_sec():.1f} samples/s")
-        if self.monitor is not None:
-            self.monitor.write_scalars(self.global_steps, {
-                "Train/loss": float(metrics["loss"]),
-                "Train/lr": float(metrics["lr"]),
-                "Train/grad_norm": float(metrics["grad_norm"]),
-                "Train/loss_scale": float(metrics["loss_scale"]),
-            })
+        need_host = (self.global_steps % self.config.steps_per_print == 0
+                     or self.monitor is not None)
+        if need_host:
+            fetched = jax.device_get(metrics)        # ONE transfer
+            self._last_metrics_host = fetched
+            if self.global_steps % self.config.steps_per_print == 0:
+                log_dist(
+                    f"step={self.global_steps} loss={fetched['loss']:.4f} "
+                    f"lr={fetched['lr']:.3e} "
+                    f"gnorm={fetched['grad_norm']:.3f} "
+                    f"tput={self.tput.avg_samples_per_sec():.1f} samples/s")
+            if self.monitor is not None:
+                self.monitor.write_scalars(self.global_steps, {
+                    "Train/loss": float(fetched["loss"]),
+                    "Train/lr": float(fetched["lr"]),
+                    "Train/grad_norm": float(fetched["grad_norm"]),
+                    "Train/loss_scale": float(fetched["loss_scale"]),
+                })
+            metrics = fetched
         return metrics
 
     def eval_batch(self, batch, rng: Optional[jax.Array] = None):
@@ -672,7 +696,20 @@ class Engine:
 
     def shard_batch(self, batch, accumulate: bool = True):
         """Device-put host batch with [B] → sharded over data axes; with
-        gas>1 reshape leaves to [gas, micro_global, ...]."""
+        gas>1 reshape leaves to [gas, micro_global, ...].
+
+        Idempotent: an already-staged batch (e.g. from
+        ``PrefetchingLoader``, which uploads batch N+1 during step N)
+        passes through untouched — but only for the staging mode it was
+        built with (train batches are gas-reshaped; eval ones are not)."""
+        if isinstance(batch, _StagedBatch):
+            if batch.accumulate != (accumulate and self.gas > 1):
+                raise ValueError(
+                    "batch was staged for "
+                    f"{'training' if batch.accumulate else 'eval'} "
+                    "(gas reshape mismatch); re-stage the host batch "
+                    "instead of reusing the staged one")
+            return batch
         gas = self.gas if accumulate else 1
         sp = self.topology.sp_size
         from ..comm.mesh import SEQ_AXIS
@@ -688,7 +725,11 @@ class Engine:
                 spec = P((DATA_AXIS, FSDP_AXIS), *seq_entry)
             return jax.device_put(x, NamedSharding(self.topology.mesh, spec))
 
-        return jax.tree.map(put, batch)
+        out = jax.tree.map(put, batch)
+        if isinstance(out, dict):
+            out = _StagedBatch(out)
+            out.accumulate = gas > 1
+        return out
 
     # ------------------------------------------------------------------
     # introspection / params access
@@ -708,7 +749,12 @@ class Engine:
             np.asarray(self.state.step).astype(np.float32)))
 
     def get_global_grad_norm(self) -> Optional[float]:
-        return getattr(self, "_last_grad_norm", None)
+        if getattr(self, "_last_metrics", None) is None:
+            return None
+        if self._last_metrics_host is None:
+            # one transfer, cached until the next step overwrites it
+            self._last_metrics_host = jax.device_get(self._last_metrics)
+        return float(self._last_metrics_host["grad_norm"])
 
     # ------------------------------------------------------------------
     # checkpointing (delegates to deepspeed_tpu.checkpoint)
