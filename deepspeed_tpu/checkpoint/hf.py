@@ -1,0 +1,252 @@
+"""HuggingFace checkpoint import: state dict → scan-layout param tree.
+
+TPU-native equivalent of the reference's checkpoint-loading machinery
+(``module_inject/load_checkpoint.py`` + ``inference/v2/checkpoint/
+huggingface_engine.py`` + the per-model parameter-mapping containers
+``inference/v2/model_implementations/common_parameters/`` — qkv fusion,
+transpose conventions, MP resharding).  The converter maps family-specific
+HF names onto the single transformer core's tree (models/transformer.py
+``init_params``): per-layer tensors stack on a leading ``layers`` dim
+(scan layout), attention projections reshape to heads-major
+``[dm, H, D]`` / ``[H, D, dm]``.
+
+Zero-egress friendly: takes an in-memory ``state_dict`` (torch tensors or
+numpy) — load it from local files with ``torch.load`` / safetensors
+however you like.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ..models.transformer import TransformerConfig
+from ..utils.logging import logger
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t)
+
+
+def _stack(sd: Dict[str, Any], fmt: str, n: int, transform=None) -> np.ndarray:
+    outs = []
+    for i in range(n):
+        x = _np(sd[fmt.format(i)])
+        outs.append(transform(x) if transform else x)
+    return np.stack(outs)
+
+
+def _qkv_heads(w: np.ndarray, H: int, D: int, transpose: bool) -> np.ndarray:
+    """HF linear weight → [dm, H, D].  ``transpose``: HF stores
+    [out, in] (torch Linear) vs GPT-2's Conv1D [in, out]."""
+    if transpose:
+        w = w.T                       # → [in(dm), out]
+    dm = w.shape[0]
+    return w.reshape(dm, H, D)
+
+
+def _o_heads(w: np.ndarray, H: int, D: int, transpose: bool) -> np.ndarray:
+    """HF out-proj weight → [H, D, dm]."""
+    if transpose:
+        w = w.T                       # → [in(H*D), dm]
+    dm = w.shape[1]
+    return w.reshape(H, D, dm)
+
+
+# --------------------------------------------------------------------------
+# GPT-2 (Conv1D layout: weights already [in, out]; fused c_attn)
+# --------------------------------------------------------------------------
+
+def _convert_gpt2(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
+    H, D, dm, nl = cfg.num_heads, cfg.head_dim, cfg.d_model, cfg.num_layers
+    pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+
+    def attn(i):
+        w = _np(sd[f"{pre}h.{i}.attn.c_attn.weight"])       # [dm, 3dm]
+        b = _np(sd[f"{pre}h.{i}.attn.c_attn.bias"])
+        wq, wk, wv = np.split(w, 3, axis=1)
+        bq, bk, bv = np.split(b, 3)
+        return dict(
+            wq=wq.reshape(dm, H, D), wk=wk.reshape(dm, H, D),
+            wv=wv.reshape(dm, H, D),
+            bq=bq.reshape(H, D), bk=bk.reshape(H, D), bv=bv.reshape(H, D),
+            wo=_np(sd[f"{pre}h.{i}.attn.c_proj.weight"]).reshape(H, D, dm),
+            bo=_np(sd[f"{pre}h.{i}.attn.c_proj.bias"]))
+
+    def mlp(i):
+        return dict(
+            wi=_np(sd[f"{pre}h.{i}.mlp.c_fc.weight"]),
+            bi=_np(sd[f"{pre}h.{i}.mlp.c_fc.bias"]),
+            wo=_np(sd[f"{pre}h.{i}.mlp.c_proj.weight"]),
+            bo=_np(sd[f"{pre}h.{i}.mlp.c_proj.bias"]))
+
+    def ln(i, which):
+        return dict(scale=_np(sd[f"{pre}h.{i}.{which}.weight"]),
+                    bias=_np(sd[f"{pre}h.{i}.{which}.bias"]))
+
+    def stacked(fn):
+        outs = [fn(i) for i in range(nl)]
+        return {k: np.stack([o[k] for o in outs]) for k in outs[0]}
+
+    params = {
+        "embed": {"table": _np(sd[f"{pre}wte.weight"])},
+        "pos_embed": {"table": _np(sd[f"{pre}wpe.weight"])},
+        "blocks": {
+            "attn": stacked(attn),
+            "mlp": stacked(mlp),
+            "ln1": stacked(lambda i: ln(i, "ln_1")),
+            "ln2": stacked(lambda i: ln(i, "ln_2")),
+        },
+        "ln_f": {"scale": _np(sd[f"{pre}ln_f.weight"]),
+                 "bias": _np(sd[f"{pre}ln_f.bias"])},
+    }
+    return params
+
+
+# --------------------------------------------------------------------------
+# Llama / Mistral (torch Linear layout [out, in]; separate q/k/v; RMSNorm)
+# --------------------------------------------------------------------------
+
+def _convert_llama(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
+    H, D, Hkv = cfg.num_heads, cfg.head_dim, cfg.num_kv_heads
+    dm, nl = cfg.d_model, cfg.num_layers
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    L = pre + "layers.{}."
+
+    params = {
+        "embed": {"table": _np(sd[f"{pre}embed_tokens.weight"])},
+        "blocks": {
+            "attn": {
+                "wq": _stack(sd, L + "self_attn.q_proj.weight", nl,
+                             lambda w: _qkv_heads(w, H, D, True)),
+                "wk": _stack(sd, L + "self_attn.k_proj.weight", nl,
+                             lambda w: _qkv_heads(w, Hkv, D, True)),
+                "wv": _stack(sd, L + "self_attn.v_proj.weight", nl,
+                             lambda w: _qkv_heads(w, Hkv, D, True)),
+                "wo": _stack(sd, L + "self_attn.o_proj.weight", nl,
+                             lambda w: _o_heads(w, H, D, True)),
+            },
+            "mlp": {
+                "wg": _stack(sd, L + "mlp.gate_proj.weight", nl,
+                             lambda w: w.T),
+                "wi": _stack(sd, L + "mlp.up_proj.weight", nl,
+                             lambda w: w.T),
+                "wo": _stack(sd, L + "mlp.down_proj.weight", nl,
+                             lambda w: w.T),
+            },
+            "ln1": {"scale": _stack(sd, L + "input_layernorm.weight", nl)},
+            "ln2": {"scale": _stack(
+                sd, L + "post_attention_layernorm.weight", nl)},
+        },
+        "ln_f": {"scale": _np(sd[f"{pre}norm.weight"])},
+    }
+    head_key = "lm_head.weight"
+    if head_key in sd and not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": _np(sd[head_key]).T}
+    return params
+
+
+# --------------------------------------------------------------------------
+# OPT (learned positions w/ offset, LayerNorm, fused decoder naming)
+# --------------------------------------------------------------------------
+
+def _convert_opt(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
+    H, D, dm, nl = cfg.num_heads, cfg.head_dim, cfg.d_model, cfg.num_layers
+    pre = next((p for p in ("model.decoder.", "decoder.", "")
+                if f"{p}embed_tokens.weight" in sd), "")
+    L = pre + "layers.{}."
+
+    def lin(fmt, out_heads=False, kv=False):
+        hh = H
+        if out_heads:
+            return _stack(sd, fmt, nl, lambda w: _o_heads(w, H, D, True))
+        return _stack(sd, fmt, nl, lambda w: _qkv_heads(w, hh, D, True))
+
+    # OPT's learned positional table has a +2 offset (HF quirk)
+    pos = _np(sd[f"{pre}embed_positions.weight"])[2:]
+    params = {
+        "embed": {"table": _np(sd[f"{pre}embed_tokens.weight"])},
+        "pos_embed": {"table": pos},
+        "blocks": {
+            "attn": {
+                "wq": lin(L + "self_attn.q_proj.weight"),
+                "wk": lin(L + "self_attn.k_proj.weight"),
+                "wv": lin(L + "self_attn.v_proj.weight"),
+                "wo": lin(L + "self_attn.out_proj.weight", out_heads=True),
+                "bq": _stack(sd, L + "self_attn.q_proj.bias", nl,
+                             lambda b: b.reshape(H, D)),
+                "bk": _stack(sd, L + "self_attn.k_proj.bias", nl,
+                             lambda b: b.reshape(H, D)),
+                "bv": _stack(sd, L + "self_attn.v_proj.bias", nl,
+                             lambda b: b.reshape(H, D)),
+                "bo": _stack(sd, L + "self_attn.out_proj.bias", nl),
+            },
+            "mlp": {
+                "wi": _stack(sd, L + "fc1.weight", nl, lambda w: w.T),
+                "bi": _stack(sd, L + "fc1.bias", nl),
+                "wo": _stack(sd, L + "fc2.weight", nl, lambda w: w.T),
+                "bo": _stack(sd, L + "fc2.bias", nl),
+            },
+            "ln1": {"scale": _stack(sd, L + "self_attn_layer_norm.weight", nl),
+                    "bias": _stack(sd, L + "self_attn_layer_norm.bias", nl)},
+            "ln2": {"scale": _stack(sd, L + "final_layer_norm.weight", nl),
+                    "bias": _stack(sd, L + "final_layer_norm.bias", nl)},
+        },
+        "ln_f": {"scale": _np(sd[f"{pre}final_layer_norm.weight"]),
+                 "bias": _np(sd[f"{pre}final_layer_norm.bias"])},
+    }
+    return params
+
+
+CONVERTERS: Dict[str, Callable] = {
+    "gpt2": _convert_gpt2,
+    "llama": _convert_llama,
+    "mistral": _convert_llama,     # same tensor layout
+    "qwen2": _convert_llama,
+    "opt": _convert_opt,
+}
+
+
+def family_of(name_or_type: str) -> str:
+    s = name_or_type.lower()
+    for fam in ("llama", "mistral", "qwen2", "gpt2", "opt"):
+        if fam in s:
+            return fam
+    raise ValueError(f"no HF converter for {name_or_type!r}; "
+                     f"known families: {sorted(CONVERTERS)}")
+
+
+def load_hf_state_dict(cfg: TransformerConfig, state_dict: Dict[str, Any],
+                       family: str, dtype=None,
+                       reference_params: Optional[Dict] = None) -> Dict:
+    """Convert an HF ``state_dict`` to this framework's param tree.
+
+    ``reference_params`` (e.g. ``model.params``) enables a structural
+    check: every leaf converted must match the target shape."""
+    params = CONVERTERS[family_of(family)](cfg, state_dict)
+    if dtype is not None:
+        import jax
+        params = jax.tree.map(lambda x: np.asarray(x, dtype), params)
+    if reference_params is not None:
+        import jax
+        ref_flat = dict(jax.tree_util.tree_flatten_with_path(
+            reference_params)[0])
+        got_flat = dict(jax.tree_util.tree_flatten_with_path(params)[0])
+        missing = set(map(str, ref_flat)) - set(map(str, got_flat))
+        extra = set(map(str, got_flat)) - set(map(str, ref_flat))
+        if missing or extra:
+            raise ValueError(
+                f"HF conversion tree mismatch: missing={sorted(missing)} "
+                f"extra={sorted(extra)}")
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+            want = ref_flat[path].shape
+            if tuple(leaf.shape) != tuple(want):
+                raise ValueError(
+                    f"shape mismatch at {jax.tree_util.keystr(path)}: "
+                    f"got {leaf.shape}, model expects {want}")
+    logger.info("converted %d HF tensors (%s family)",
+                len(state_dict), family_of(family))
+    return params
