@@ -299,11 +299,15 @@ def _flash(q, k, v, scale, causal, block_q, block_k):
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
     o, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
-    return o, (q, k, v, o, lse)
+    # residual slimmed to [B,H,S,1]: the kernel emits a 128-lane broadcast
+    # (Mosaic tiling), but keeping it as a VJP residual would cost 128x the
+    # needed memory (hundreds of MB at GPT-2-scale batches)
+    return o, (q, k, v, o, lse[..., :1])
 
 
 def _flash_bwd(scale, causal, block_q, block_k, res, do):
-    q, k, v, o, lse = res
+    q, k, v, o, lse1 = res
+    lse = jnp.broadcast_to(lse1, (*lse1.shape[:-1], 128))
     dq, dk, dv = _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
