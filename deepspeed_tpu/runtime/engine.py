@@ -125,6 +125,7 @@ class Engine:
         self.zero = ZeroPolicy.from_config(
             config.zero_optimization, self.topology, rules=sharding_rules)
         self._build_shardings(params)
+        self._qgz_axes = self._qgz_manual_axes()
 
         # optimizer + schedule (reference: _configure_basic_optimizer :1322)
         opt_cfg = config.optimizer
@@ -376,6 +377,134 @@ class Engine:
                              in_specs=mspec, out_specs=pspec,
                              check_vma=False)(p)
 
+    # ------------------------------------------------------------------
+    # qgZ: quantized gradient reduction (ZeRO++ third leg)
+    # ------------------------------------------------------------------
+    def _qgz_manual_axes(self) -> Tuple[str, ...]:
+        """Mesh axes whose gradient reduction runs through the explicit
+        int8 collectives instead of XLA's implicit fp32 reduce.
+
+        data always; fsdp only through stage 2 — at stage 3 the compute
+        params are fsdp-sharded and must stay under XLA auto-sharding for
+        the per-use gathers, so fsdp-axis reductions of the few replicated
+        (persistent) leaves remain full-precision."""
+        if not self.config.zero_optimization.zero_quantized_gradients:
+            return ()
+        sizes = self.topology.axis_sizes
+        if sizes.get("pipe", 1) > 1 or sizes.get("seq", 1) > 1:
+            # both wrap the loss in their own shard_map (pipeline stages /
+            # Ulysses all_to_all), which cannot nest inside the qgZ manual
+            # region
+            logger.warning("zero_quantized_gradients is not composable "
+                           "with pipeline or sequence parallelism yet; "
+                           "ignoring")
+            return ()
+        axes = []
+        if sizes.get(DATA_AXIS, 1) > 1:
+            axes.append(DATA_AXIS)
+        if self.zero.stage <= 2 and sizes.get(FSDP_AXIS, 1) > 1:
+            axes.append(FSDP_AXIS)
+        if not axes:
+            logger.warning("zero_quantized_gradients: no multi-device "
+                           "reduction axis on this mesh; ignoring")
+        return tuple(axes)
+
+    @staticmethod
+    def _restrict_spec(spec: P, manual: Tuple[str, ...]) -> P:
+        """PartitionSpec with only the ``manual`` axes kept (the rest of
+        the sharding stays with the auto axes of the partial shard_map)."""
+        out = []
+        for e in spec:
+            if e is None:
+                out.append(None)
+                continue
+            ax = (e,) if isinstance(e, str) else tuple(e)
+            kept = tuple(a for a in ax if a in manual)
+            out.append(kept[0] if len(kept) == 1 else (kept or None))
+        while out and out[-1] is None:
+            out.pop()
+        return P(*out)
+
+    def _build_qgz_grads(self, gas: int):
+        """Per-microbatch gradient function with explicit quantized
+        reduction (reference: qgZ — all_to_all_quant_reduce,
+        runtime/comm/coalesced_collectives.py + quant_reduce.cu;
+        docs/_tutorials/zeropp.md:12-17 4x comm-volume claim).
+
+        shard_map is *manual* over the reduce axes and auto elsewhere
+        (TP/SP collectives stay compiler-placed).  Per grad leaf: axes
+        appearing in its grad spec get an int8 reduce-scatter onto the
+        owner shard (dequant-reduce on arrival); axes the leaf replicates
+        over get an int8 reduce-scatter + all-gather."""
+        from ..ops.quant import (quantized_all_reduce,
+                                 quantized_psum_scatter_dim)
+
+        manual = self._qgz_axes
+        mesh = self.topology.mesh
+        sizes = self.topology.axis_sizes
+        nred = int(np.prod([sizes[a] for a in manual]))
+
+        def reduce_leaf(g, spec):
+            ents = list(spec) + [None] * (g.ndim - len(list(spec)))
+            seen = set()
+            for d, e in enumerate(ents):
+                if e is None:
+                    continue
+                ax = (e,) if isinstance(e, str) else tuple(e)
+                # major -> minor: scatter in entry order lands each
+                # (outer, inner) coordinate on its owner shard
+                for a in ax:
+                    if a in manual:
+                        g = quantized_psum_scatter_dim(g, a, dim=d)
+                        seen.add(a)
+            for a in manual:
+                if a not in seen:
+                    g = quantized_all_reduce(g, a)
+            return g
+
+        grad_specs = self.grad_specs
+        p_in = jax.tree.map(lambda s: self._restrict_spec(s, manual),
+                            self.param_specs,
+                            is_leaf=lambda x: isinstance(x, P))
+        g_out = jax.tree.map(lambda s: self._restrict_spec(s, manual),
+                             grad_specs, is_leaf=lambda x: isinstance(x, P))
+        batch_spec = P(self._restrict_spec(
+            P((DATA_AXIS, FSDP_AXIS)), manual)[0])
+
+        def local(cparams, batch, rng, scale):
+            idx = jnp.int32(0)
+            for a in manual:
+                idx = idx * sizes[a] + jax.lax.axis_index(a)
+            rng = jax.random.fold_in(rng, idx)
+
+            def scaled_loss(p):
+                loss, aux = self._micro_loss(p, batch, rng)
+                return loss * scale / gas, (loss, aux)
+
+            (_, (loss, aux)), grads = jax.value_and_grad(
+                scaled_loss, has_aux=True)(cparams)
+            grads = jax.tree.map(
+                reduce_leaf, grads, grad_specs,
+                is_leaf=lambda x: isinstance(x, P))
+            # local losses are means over the local batch shard; the
+            # global mean divides the reduced sums by the rank count
+            grads = jax.tree.map(lambda g: (g / nred).astype(g.dtype), grads)
+            loss = jax.lax.psum(loss, manual) / nred
+            aux = jax.tree.map(lambda a: jax.lax.psum(a, manual) / nred, aux)
+            return loss, aux, grads
+
+        def qgz_grads(cparams, batch, rng, scale):
+            mb_specs = jax.tree.map(lambda _: batch_spec, batch)
+            return jax.shard_map(
+                local, mesh=mesh,
+                in_specs=(p_in, mb_specs, P(), P()),
+                out_specs=(P(), P(), g_out),
+                axis_names=set(manual),     # auto everywhere else: TP/fsdp
+                check_vma=False,            # shardings stay compiler-placed
+            )(cparams, batch, rng, scale)
+
+        return qgz_grads
+
     def _offload_update(self, grads, opt_state, master, step, finite):
         """ZeRO-Offload optimizer step: fp32 master + moments live in host
         DRAM and the update executes as XLA host compute — the TPU analog
@@ -453,7 +582,12 @@ class Engine:
         predivide = self.config.gradient_predivide_factor
         offloaded = self.offload_active
 
+        qgz_grads = self._build_qgz_grads(gas) if self._qgz_axes else None
+
         def grads_of_microbatch(cparams, batch, rng, scale):
+            if qgz_grads is not None:
+                return qgz_grads(cparams, batch, rng, scale)
+
             def scaled_loss(p):
                 loss, aux = self._micro_loss(p, batch, rng)
                 return loss * scale / gas, (loss, aux)

@@ -107,6 +107,59 @@ class TestZeroPP:
         # but not bit-identical (the quantization must actually be in play)
         assert runs["qwz"] != runs["exact"]
 
+    @pytest.mark.parametrize("stage,mesh", [
+        (1, {"fsdp": 8}),
+        (2, {"data": 2, "fsdp": 4}),
+        (3, {"data": 2, "fsdp": 4}),
+        (2, {"data": 2, "fsdp": 2, "tensor": 2}),   # TP stays auto-sharded
+    ])
+    def test_qgz_trains_close_to_exact(self, stage, mesh):
+        """qgZ: the gradient reduction runs through the int8 reduce-scatter
+        collectives (reference: all_to_all_quant_reduce,
+        coalesced_collectives.py; test_zeropp.py qgZ cases) and training
+        tracks the exact run within quantization tolerance."""
+        p, ax, loss_fn = make_mlp()
+        base = {"train_micro_batch_size_per_device": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "mesh": mesh, "steps_per_print": 1000}
+        runs = {}
+        for name, z in (("exact", {"stage": stage}),
+                        ("qgz", {"stage": stage,
+                                 "zero_quantized_gradients": True})):
+            eng = ds.initialize(loss_fn=loss_fn, params=p, param_axes=ax,
+                                config={**base, "zero_optimization": z})
+            if name == "qgz":
+                assert eng._qgz_axes, "qgZ did not engage on this mesh"
+            losses = []
+            for i in range(5):
+                losses.append(float(eng.train_batch(
+                    make_batch(eng.train_batch_size, seed=i))["loss"]))
+            runs[name] = losses
+        np.testing.assert_allclose(runs["qgz"], runs["exact"], rtol=0.05)
+        # quantization must actually be in play
+        assert runs["qgz"] != runs["exact"]
+
+    def test_qgz_with_gas(self):
+        """qgZ under gradient accumulation: per-microbatch quantized
+        reduction accumulates in the reduced layout."""
+        p, ax, loss_fn = make_mlp()
+        base = {"train_micro_batch_size_per_device": 2,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "mesh": {"fsdp": 8}, "steps_per_print": 1000}
+        runs = {}
+        for name, z in (("exact", {"stage": 2}),
+                        ("qgz", {"stage": 2,
+                                 "zero_quantized_gradients": True})):
+            eng = ds.initialize(loss_fn=loss_fn, params=p, param_axes=ax,
+                                config={**base, "zero_optimization": z})
+            losses = []
+            for i in range(4):
+                losses.append(float(eng.train_batch(
+                    make_batch(eng.train_batch_size, seed=i))["loss"]))
+            runs[name] = losses
+        np.testing.assert_allclose(runs["qgz"], runs["exact"], rtol=0.05)
+
     def test_hpz_secondary_partition(self):
         """hpZ: compute params gather over the small fsdp axis only;
         masters shard over the full data x fsdp world; training matches
