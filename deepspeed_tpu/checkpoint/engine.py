@@ -225,6 +225,35 @@ def load_tree(template: Any, shardings: Any, ckpt_dir: str,
     return jax.tree_util.tree_unflatten(treedef, out_leaves), meta
 
 
+def load_tree_host(template: Any, ckpt_dir: str,
+                   strict: bool = True) -> Tuple[Any, Dict]:
+    """Like :func:`load_tree` but assembles plain numpy arrays on the host
+    (no device placement) — used by the ZeRO-Infinity path, whose fp32
+    state must land on NVMe rather than in HBM."""
+    with open(os.path.join(ckpt_dir, MANIFEST)) as f:
+        meta = json.load(f)
+    entries = meta["leaves"]
+    out_leaves = []
+    for key, leaf in _leaf_paths(template):
+        if key not in entries:
+            if strict:
+                raise KeyError(f"Checkpoint missing leaf {key}")
+            out_leaves.append(leaf)
+            continue
+        entry = entries[key]
+        shape = tuple(np.shape(leaf))
+        if tuple(entry["shape"]) != shape:
+            raise ValueError(
+                f"Shape mismatch for {key}: ckpt {entry['shape']} vs {shape}")
+        reader = _FragmentReader(ckpt_dir, entry)
+        full = tuple(slice(0, d) for d in reader.shape)
+        arr = reader.read(full)
+        tgt = getattr(leaf, "dtype", None)
+        out_leaves.append(arr.astype(tgt) if tgt is not None else arr)
+    treedef = jax.tree_util.tree_structure(template)
+    return jax.tree_util.tree_unflatten(treedef, out_leaves), meta
+
+
 # --------------------------------------------------------------------------
 # engine-level save/load (reference: engine.save_checkpoint :3109)
 # --------------------------------------------------------------------------

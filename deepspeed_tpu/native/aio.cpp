@@ -70,12 +70,19 @@ public:
   }
 
   void submit(const char *path, char *buf, long nbytes, long offset,
-              bool write) {
+              bool write, bool trunc = false) {
     int flags = write ? (O_WRONLY | O_CREAT) : O_RDONLY;
     int fd = open(path, flags, 0644);
     if (fd < 0) {
       errors_.fetch_add(1);
       return;
+    }
+    // opt-in for full-file rewrites: a smaller rewrite must not leave a
+    // stale tail from a previous, larger request (a reader trusting file
+    // size would see old data).  Never implicit — partial-write users of
+    // the public handle rely on surrounding bytes surviving.
+    if (write && trunc) {
+      if (ftruncate(fd, offset + nbytes) != 0) errors_.fetch_add(1);
     }
     auto req = std::make_shared<Request>();
     req->fd = fd;
@@ -165,6 +172,14 @@ void aio_pwrite(void *h, const char *path, const void *buf, long nbytes,
   static_cast<AioPool *>(h)->submit(
       path, const_cast<char *>(static_cast<const char *>(buf)), nbytes,
       offset, true);
+}
+
+// full-file rewrite: truncates to offset+nbytes before queueing the chunks
+void aio_pwrite_trunc(void *h, const char *path, const void *buf, long nbytes,
+                      long offset) {
+  static_cast<AioPool *>(h)->submit(
+      path, const_cast<char *>(static_cast<const char *>(buf)), nbytes,
+      offset, true, true);
 }
 
 int aio_wait(void *h) { return static_cast<AioPool *>(h)->wait(); }

@@ -33,7 +33,7 @@ class AsyncIOHandle:
         lib.aio_create.restype = ctypes.c_void_p
         lib.aio_create.argtypes = [ctypes.c_int, ctypes.c_long]
         lib.aio_destroy.argtypes = [ctypes.c_void_p]
-        for fn in ("aio_pread", "aio_pwrite"):
+        for fn in ("aio_pread", "aio_pwrite", "aio_pwrite_trunc"):
             getattr(lib, fn).argtypes = [
                 ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
                 ctypes.c_long, ctypes.c_long]
@@ -63,12 +63,19 @@ class AsyncIOHandle:
                             buffer.ctypes.data_as(ctypes.c_void_p),
                             buffer.nbytes, offset)
 
-    def async_pwrite(self, buffer: np.ndarray, path: str, offset: int = 0):
+    def async_pwrite(self, buffer: np.ndarray, path: str, offset: int = 0,
+                     truncate: bool = False):
+        """``truncate=True`` marks this a full-file rewrite: the file is
+        truncated to ``offset + nbytes`` first so a smaller rewrite can't
+        leave a stale tail behind.  Off by default — partial-write callers
+        rely on surrounding bytes surviving."""
         if not buffer.flags["C_CONTIGUOUS"]:
             raise ValueError("buffer must be C-contiguous")
-        self._lib.aio_pwrite(self._h, os.fspath(path).encode(),
-                             buffer.ctypes.data_as(ctypes.c_void_p),
-                             buffer.nbytes, offset)
+        fn = (self._lib.aio_pwrite_trunc if truncate
+              else self._lib.aio_pwrite)
+        fn(self._h, os.fspath(path).encode(),
+           buffer.ctypes.data_as(ctypes.c_void_p),
+           buffer.nbytes, offset)
 
     def wait(self) -> int:
         """Drain outstanding requests; returns number of failed chunks."""
@@ -82,6 +89,7 @@ class AsyncIOHandle:
         self.async_pread(buffer, path, offset)
         return self.wait()
 
-    def sync_pwrite(self, buffer: np.ndarray, path: str, offset: int = 0) -> int:
-        self.async_pwrite(buffer, path, offset)
+    def sync_pwrite(self, buffer: np.ndarray, path: str, offset: int = 0,
+                    truncate: bool = False) -> int:
+        self.async_pwrite(buffer, path, offset, truncate=truncate)
         return self.wait()

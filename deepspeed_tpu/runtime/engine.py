@@ -124,6 +124,15 @@ class Engine:
                            else shd.infer_logical_axes(params))
         self.zero = ZeroPolicy.from_config(
             config.zero_optimization, self.topology, rules=sharding_rules)
+        # ZeRO-Infinity: fp32 master + moments on NVMe, bf16 working copy
+        # on device (reference: stage3.py:614 _configure_tensor_swapping)
+        self._nvme = None
+        off_opt = config.zero_optimization.offload_optimizer
+        if off_opt.device == "nvme":
+            from .zero_infinity import NVMeOptimizer
+            self._nvme = NVMeOptimizer(
+                off_opt.nvme_path, config.optimizer.type,
+                config.optimizer.params, buffer_size=off_opt.buffer_size)
         self._build_shardings(params)
         self._qgz_axes = self._qgz_manual_axes()
 
@@ -159,6 +168,7 @@ class Engine:
         self.monitor = monitor
         self._train_step_fn = None
         self._eval_step_fn = None
+        self._nvme_step_fn = None
 
         log_dist(
             f"Engine: {param_count(params):,} params | precision={self.precision} "
@@ -188,7 +198,42 @@ class Engine:
         # around the same fused update.
         self.offload_active = False
         self._offload_validated = False
-        if self.config.zero_optimization.offload_optimizer.device == "cpu":
+        if self._nvme is not None:
+            # ZeRO-Infinity: the device-resident state is the bf16 working
+            # copy in the *compute* layout (fp32 master + moments live on
+            # NVMe, see runtime/zero_infinity.py); offload_param=cpu/nvme
+            # additionally pins the working copy to host DRAM so HBM only
+            # holds parameters transiently during the step.
+            self.master_specs = self.param_specs
+            self.master_shardings = self.param_shardings
+            offp = self.config.zero_optimization.offload_param.device
+            if offp in ("cpu", "nvme"):
+                if self._host_memory_supported():
+                    multi = self.topology.mesh.size > 1
+                    self.master_shardings = jax.tree.map(
+                        lambda sh: sh if (multi and sh.is_fully_replicated)
+                        else sh.with_memory_kind("pinned_host"),
+                        self.master_shardings)
+                    self.offload_active = True
+                    if offp == "nvme":
+                        logger.warning(
+                            "offload_param.device=nvme: the bf16 working "
+                            "copy stages in host DRAM (fp32 masters are on "
+                            "NVMe); per-layer NVMe param streaming is not "
+                            "implemented yet")
+                else:
+                    logger.warning(
+                        "offload_param requested but this backend has no "
+                        "pinned_host memory space; ignoring")
+            return
+        zcfg = self.config.zero_optimization
+        if (zcfg.offload_optimizer.device == "cpu"
+                or zcfg.offload_param.device == "cpu"):
+            # offload_param=cpu without NVMe state rides the same host-DRAM
+            # master placement: compute params are cast from the
+            # host-placed master each step, so the persistent fp32/param
+            # footprint leaves HBM either way (reference:
+            # offload_param/offload_optimizer offload_config.py)
             if "lamb" in self.config.optimizer.type.lower():
                 # LAMB trust ratios need whole-tensor norms; the offload
                 # update runs per-shard inside shard_map, which would
@@ -246,6 +291,9 @@ class Engine:
     # state init
     # ------------------------------------------------------------------
     def _init_state(self, params) -> TrainState:
+        if self._nvme is not None:
+            return self._init_state_nvme(params)
+
         def init_fn(p):
             master = jax.tree.map(lambda x: x.astype(jnp.float32), p)
             opt_state = self.optimizer.init(master)
@@ -286,6 +334,37 @@ class Engine:
             step=jnp.zeros((), jnp.int32),
             master=master,
             opt_state=opt_state,
+            loss_scale=self.scaler.init(),
+            skipped=jnp.zeros((), jnp.int32))
+
+    def _init_state_nvme(self, params) -> TrainState:
+        """ZeRO-Infinity init: fp32 master + zero moments written straight
+        to NVMe (never materialized in HBM); the device keeps only the
+        bf16 working copy in the compute layout."""
+        dev_sh = jax.tree.map(
+            lambda sh: NamedSharding(self.topology.mesh, sh.spec),
+            self.master_shardings)
+        cast = jax.jit(
+            lambda p: jax.tree.map(
+                lambda x: x.astype(self.compute_dtype), p),
+            out_shardings=dev_sh)
+        master = cast(params)
+        if self.offload_active:
+            try:
+                master = jax.device_put(master, self.master_shardings)
+            except Exception as e:
+                logger.warning(
+                    "param offload unsupported for this mesh/layout (%s); "
+                    "keeping the working copy in device memory",
+                    str(e).splitlines()[0][:120])
+                self.offload_active = False
+                self.master_shardings = dev_sh
+        self._nvme.initialize(params)
+        self.opt_shardings = ()
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            master=master,
+            opt_state=(),
             loss_scale=self.scaler.init(),
             skipped=jnp.zeros((), jnp.int32))
 
@@ -573,15 +652,11 @@ class Engine:
             loss, aux = out, {}
         return loss, aux
 
-    def _build_train_step(self):
-        gas = self.gas
-        scaler = self.scaler
-        use_scaling = self.precision == "fp16"
-        clip = self.config.gradient_clipping
-        prescale = self.config.prescale_gradients
-        predivide = self.config.gradient_predivide_factor
-        offloaded = self.offload_active
-
+    def _build_grad_pipeline(self, gas: int):
+        """(cparams, batch, rng, scale) -> (loss, aux, fp32 grads in the
+        ZeRO grad layout) — the shared front half of the device-resident
+        and NVMe-offloaded train steps (gas scan = the IPG/bucketing
+        analog, compiler-scheduled)."""
         qgz_grads = self._build_qgz_grads(gas) if self._qgz_axes else None
 
         def grads_of_microbatch(cparams, batch, rng, scale):
@@ -595,20 +670,17 @@ class Engine:
                 scaled_loss, has_aux=True)(cparams)
             return loss, aux, grads
 
-        def train_step(state: TrainState, batch, rng):
-            scale = state.loss_scale.scale if use_scaling else jnp.float32(1.0)
-            cparams = self._compute_params(state.master)
+        def shard_grads(g):
+            return jax.tree.map(
+                lambda t, spec: jax.lax.with_sharding_constraint(
+                    t, NamedSharding(self.topology.mesh, spec)),
+                g, self.grad_specs)
 
-            def shard_grads(g):
-                return jax.tree.map(
-                    lambda t, spec: jax.lax.with_sharding_constraint(
-                        t, NamedSharding(self.topology.mesh, spec)),
-                    g, self.grad_specs)
-
+        def pipeline(cparams, batch, rng, scale):
             if gas > 1:
                 # batch leaves have leading [gas, ...]; scan accumulates
                 # fp32 grads in the ZeRO grad layout (reduce-scattered for
-                # stage>=2) — the IPG/bucketing analog, compiler-scheduled.
+                # stage>=2)
                 def body(acc, xs):
                     mb, r = xs
                     loss, aux, g = grads_of_microbatch(cparams, mb, r, scale)
@@ -629,16 +701,43 @@ class Engine:
                 loss = loss_sum / gas
                 aux = jax.tree.map(lambda a: a[-1], aux)
             else:
-                loss, aux, grads = grads_of_microbatch(cparams, batch, rng, scale)
+                loss, aux, grads = grads_of_microbatch(cparams, batch, rng,
+                                                       scale)
                 grads = shard_grads(jax.tree.map(
                     lambda t: t.astype(jnp.float32), grads))
+            return loss, aux, grads
 
-            # unscale (+ predivide, reference: prescale_gradients)
+        return pipeline
+
+    def _build_grad_epilogue(self):
+        """Shared back half of both step builders: unscale (+ predivide,
+        reference: prescale_gradients), overflow check, clip."""
+        use_scaling = self.precision == "fp16"
+        clip = self.config.gradient_clipping
+        prescale = self.config.prescale_gradients
+        predivide = self.config.gradient_predivide_factor
+
+        def epilogue(grads, scale):
             denom = scale * (predivide if prescale else 1.0)
             grads = jax.tree.map(lambda g: g / denom, grads)
-
             finite = all_finite(grads) if use_scaling else jnp.asarray(True)
             grads, gnorm = clip_by_global_norm(grads, clip)
+            return grads, finite, gnorm
+        return epilogue
+
+    def _build_train_step(self):
+        gas = self.gas
+        scaler = self.scaler
+        use_scaling = self.precision == "fp16"
+        offloaded = self.offload_active
+        pipeline = self._build_grad_pipeline(gas)
+        epilogue = self._build_grad_epilogue()
+
+        def train_step(state: TrainState, batch, rng):
+            scale = state.loss_scale.scale if use_scaling else jnp.float32(1.0)
+            cparams = self._compute_params(state.master)
+            loss, aux, grads = pipeline(cparams, batch, rng, scale)
+            grads, finite, gnorm = epilogue(grads, scale)
 
             # overflow → skip update (jnp.where keeps shapes static)
             def sel(new, old):
@@ -697,6 +796,80 @@ class Engine:
             donate_argnums=() if offloaded else (0,))
 
     # ------------------------------------------------------------------
+    # ZeRO-Infinity step (NVMe-backed optimizer state)
+    # ------------------------------------------------------------------
+    def _build_nvme_step(self):
+        """Device half of the ZeRO-Infinity step: grads + overflow check +
+        clip, returning the gradients for the host-side NVMe update
+        (reference: stage3.py:2049 per-sub_group gather-step-swap loop;
+        here the group loop lives in runtime/zero_infinity.py)."""
+        gas = self.gas
+        scaler = self.scaler
+        use_scaling = self.precision == "fp16"
+        pipeline = self._build_grad_pipeline(gas)
+        epilogue = self._build_grad_epilogue()
+
+        def nvme_step(state: TrainState, batch, rng):
+            scale = state.loss_scale.scale if use_scaling else jnp.float32(1.0)
+            cparams = self._compute_params(state.master)
+            loss, aux, grads = pipeline(cparams, batch, rng, scale)
+            grads, finite, gnorm = epilogue(grads, scale)
+            new_scale_state = scaler.update(state.loss_scale, ~finite)
+            metrics = {
+                "loss": loss.astype(jnp.float32),
+                "grad_norm": gnorm,
+                "loss_scale": state.loss_scale.scale,
+                "overflow": (~finite).astype(jnp.int32),
+                **{f"aux/{k}": v for k, v in aux.items()},
+            }
+            return grads, finite, new_scale_state, metrics
+
+        state_sh = self.state_shardings
+        return jax.jit(nvme_step, in_shardings=(state_sh, None, None))
+
+    def _train_batch_nvme(self, batch, rng) -> Dict[str, Any]:
+        if self._nvme_step_fn is None:
+            self._nvme_step_fn = self._build_nvme_step()
+        batch = self.shard_batch(batch)
+        self.tput.start()
+        try:
+            grads, finite, new_scale_state, metrics = \
+                self._nvme_step_fn(self.state, batch, rng)
+            finite_b = bool(np.asarray(finite))
+        except jax.errors.JaxRuntimeError as e:
+            if not self.offload_active or self._offload_validated:
+                raise
+            self._disable_offload(e)
+            return self._train_batch_nvme(batch, rng)
+        self._offload_validated = True
+
+        step_next = int(np.asarray(self.state.step)) + 1
+        lr = float(np.asarray(self.lr_schedule(np.float32(step_next))))
+        if finite_b:
+            flat_grads = jax.tree_util.tree_leaves(grads)
+            new_master = self._nvme.step(flat_grads, lr, step_next)
+            flat_sh = jax.tree_util.tree_leaves(
+                self.master_shardings,
+                is_leaf=lambda x: isinstance(x, jax.sharding.Sharding))
+            dev_leaves = [
+                jax.device_put(m.astype(self.compute_dtype), sh)
+                for m, sh in zip(new_master, flat_sh)]
+            master = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(self.state.master), dev_leaves)
+            new_step = jnp.asarray(step_next, jnp.int32)
+            skipped = self.state.skipped
+        else:
+            master = self.state.master
+            new_step = self.state.step
+            skipped = self.state.skipped + 1
+        self.state = TrainState(
+            step=new_step, master=master, opt_state=(),
+            loss_scale=new_scale_state, skipped=skipped)
+        metrics = dict(metrics)
+        metrics["lr"] = jnp.float32(lr)
+        return self._finish_step(batch, rng, metrics)
+
+    # ------------------------------------------------------------------
     # public API (reference: engine.train_batch / forward+backward+step)
     # ------------------------------------------------------------------
     def train_batch(self, batch, rng: Optional[jax.Array] = None) -> Dict[str, Any]:
@@ -706,10 +879,12 @@ class Engine:
         local view is fine under multi-host; see ``shard_batch``); with
         gas>1, leaves are reshaped to [gas, micro, ...] for the scan.
         """
-        if self._train_step_fn is None:
-            self._train_step_fn = self._build_train_step()
         if rng is None:
             rng = jax.random.PRNGKey(self.config.seed + self.global_steps)
+        if self._nvme is not None:
+            return self._train_batch_nvme(batch, rng)
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
         batch = self.shard_batch(batch)
         self.tput.start()
         try:
@@ -723,6 +898,9 @@ class Engine:
             self._train_step_fn = self._build_train_step()
             self.state, metrics = self._train_step_fn(self.state, batch, rng)
         self._offload_validated = True
+        return self._finish_step(batch, rng, metrics)
+
+    def _finish_step(self, batch, rng, metrics) -> Dict[str, Any]:
         self.global_steps += 1
         self.global_samples += self.train_batch_size
         # metrics stay on device — a host fetch every step would stall the
@@ -785,7 +963,8 @@ class Engine:
         wall time already measured, no extra execution)."""
         from ..profiling import FlopsProfiler, analyze_fn
 
-        stats = analyze_fn(self._train_step_fn, self.state, batch, rng)
+        stats = analyze_fn(self._train_step_fn or self._nvme_step_fn,
+                           self.state, batch, rng)
         stats["params"] = float(param_count(self.state.master))
         # total_elapsed_time only counts steps after tput.start_step
         counted = self.tput.global_step_count - self.tput.start_step
@@ -825,6 +1004,7 @@ class Engine:
         # drop every jit compiled against the host-placed shardings
         self._train_step_fn = None
         self._eval_step_fn = None
+        self._nvme_step_fn = None
         if hasattr(self, "_compute_params_fn"):
             del self._compute_params_fn
 
@@ -896,11 +1076,81 @@ class Engine:
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict] = None):
         from ..checkpoint.engine import save_checkpoint as _save
-        return _save(self, save_dir, tag=tag, client_state=client_state or {})
+        if self._nvme is None:
+            return _save(self, save_dir, tag=tag,
+                         client_state=client_state or {})
+        # ZeRO-Infinity: checkpoint the *fp32* NVMe state, not the bf16
+        # working copy, so resume (on any config) is lossless — the same
+        # fragment format as every other run.
+        from .optimizers import AdamState
+        m, v = self._nvme.moment_trees()
+        saved = self.state
+        self.state = TrainState(
+            step=saved.step, master=self._nvme.master_tree(),
+            opt_state=AdamState(m=m, v=v),
+            loss_scale=saved.loss_scale, skipped=saved.skipped)
+        try:
+            return _save(self, save_dir, tag=tag,
+                         client_state=client_state or {})
+        finally:
+            self.state = saved
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):
         from ..checkpoint.engine import load_checkpoint as _load
-        return _load(self, load_dir, tag=tag)
+        if self._nvme is None:
+            return _load(self, load_dir, tag=tag)
+        return self._load_checkpoint_nvme(load_dir, tag)
+
+    def _load_checkpoint_nvme(self, load_dir: str, tag: Optional[str]):
+        """Load a fragment checkpoint into the NVMe state store: fp32
+        master + moments go to NVMe files, the device gets a fresh bf16
+        working copy.  Checkpoints from non-Infinity runs load too (same
+        master/AdamState key layout)."""
+        import os
+
+        from ..checkpoint.engine import LATEST, load_tree_host
+        from .optimizers import AdamState
+        if tag is None:
+            latest = os.path.join(load_dir, LATEST)
+            if not os.path.exists(latest):
+                raise FileNotFoundError(f"No {LATEST} file in {load_dir}")
+            with open(latest) as f:
+                tag = f.read().strip()
+        ckpt_dir = os.path.join(load_dir, tag)
+
+        f32 = lambda tree: jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), np.float32), tree)
+        scalar = lambda dt: jax.ShapeDtypeStruct((), dt)
+        template = TrainState(
+            step=scalar(np.int32),
+            master=f32(self.state.master),
+            opt_state=AdamState(m=f32(self.state.master),
+                                v=f32(self.state.master)),
+            loss_scale=LossScaleState(scalar(np.float32), scalar(np.int32),
+                                      scalar(np.int32)),
+            skipped=scalar(np.int32))
+        host, meta = load_tree_host(template, ckpt_dir)
+        self._nvme.restore(host.master, host.opt_state.m, host.opt_state.v)
+
+        flat = jax.tree_util.tree_leaves(host.master)
+        flat_sh = jax.tree_util.tree_leaves(
+            self.master_shardings,
+            is_leaf=lambda x: isinstance(x, jax.sharding.Sharding))
+        dev_leaves = [jax.device_put(m.astype(self.compute_dtype), sh)
+                      for m, sh in zip(flat, flat_sh)]
+        master = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(self.state.master), dev_leaves)
+        self.state = TrainState(
+            step=jnp.asarray(host.step, jnp.int32),
+            master=master, opt_state=(),
+            loss_scale=LossScaleState(
+                *[jnp.asarray(x) for x in host.loss_scale]),
+            skipped=jnp.asarray(host.skipped, jnp.int32))
+        self.global_steps = int(meta.get("global_steps", 0))
+        self.global_samples = int(meta.get("global_samples", 0))
+        log_dist(f"loaded checkpoint {ckpt_dir} into NVMe state "
+                 f"(step {self.global_steps})")
+        return ckpt_dir, meta.get("client_state", {})
 
 
 def initialize(loss_fn: Callable = None,

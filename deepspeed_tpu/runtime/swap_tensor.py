@@ -42,7 +42,8 @@ class TensorSwapper:
         self._meta[key] = (buf.shape, buf.dtype)
         self._hold = getattr(self, "_hold", {})
         self._hold[key] = buf                     # keep alive until wait()
-        self.aio.async_pwrite(buf, self._path(key))
+        # full-file rewrite: truncate so a shrunk leaf leaves no stale tail
+        self.aio.async_pwrite(buf, self._path(key), truncate=True)
         if not async_op:
             self.wait()
 

@@ -1,0 +1,190 @@
+"""ZeRO-Infinity: NVMe-backed optimizer state wired into the engine step.
+
+TPU-native re-design of the reference's NVMe offload orchestration
+(``runtime/swap_tensor/partitioned_param_swapper.py:37``,
+``pipelined_optimizer_swapper.py``, engine hookup ``stage3.py:614``
+``_configure_tensor_swapping``): fp32 master parameters and Adam moments
+live in aligned files on NVMe (written through the native aio thread
+pool, ``native/aio.cpp``), only the bf16 working copy of the parameters
+stays on device, and the optimizer update runs group-by-group on the host
+with double-buffered prefetch — group g+1's NVMe read is in flight while
+group g's update computes, the pipelined schedule of
+``pipelined_optimizer_swapper.py``.
+
+The host update itself is the ``cpu_adam`` analog (``csrc/adam/
+cpu_adam_impl.cpp`` AVX loops): numpy's vectorized kernels over fp32
+buffers, numerically identical to the in-graph fused AdamW
+(:mod:`.optimizers`), so an NVMe run tracks a no-offload run to float
+tolerance.
+
+Division of labor with the engine: the engine's jitted step produces
+unscaled, clipped, ZeRO-layout gradients (and the overflow flag); this
+module owns everything below — group partitioning, swap files, the host
+update, and handing back fresh bf16 leaves for the device working copy.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..config.config import ConfigError
+from ..utils.logging import log_dist, logger
+from .swap_tensor import OptimizerSwapper
+
+
+class HostAdam:
+    """Numpy AdamW/Adam mirroring :func:`optimizers.adamw` exactly
+    (bias correction, decoupled vs L2 decay) — the DeepSpeedCPUAdam
+    equivalent for NVMe-offloaded state."""
+
+    def __init__(self, opt_type: str, params: Dict[str, Any]):
+        t = opt_type.lower()
+        if t not in ("adam", "adamw"):
+            raise ConfigError(
+                f"offload_optimizer.device=nvme supports adam/adamw, got "
+                f"{opt_type!r} (use device=cpu for other optimizers)")
+        self.b1, self.b2 = params.get("betas", (0.9, 0.999))
+        self.eps = params.get("eps", 1e-8)
+        default_wd = 0.01 if t == "adamw" else 0.0
+        self.weight_decay = params.get("weight_decay", default_wd)
+        self.adam_w_mode = params.get("adam_w_mode", t == "adamw")
+        self.bias_correction = params.get("bias_correction", True)
+
+    def update(self, p: np.ndarray, m: np.ndarray, v: np.ndarray,
+               g: np.ndarray, lr: float, step: int) -> None:
+        """In-place fp32 update of (p, m, v) with gradient g."""
+        g = g.astype(np.float32, copy=False)
+        if not self.adam_w_mode and self.weight_decay:
+            g = g + self.weight_decay * p
+        np.multiply(m, self.b1, out=m)
+        m += (1.0 - self.b1) * g
+        np.multiply(v, self.b2, out=v)
+        v += (1.0 - self.b2) * np.square(g)
+        if self.bias_correction:
+            c1 = 1.0 - self.b1 ** step
+            c2 = 1.0 - self.b2 ** step
+        else:
+            c1 = c2 = 1.0
+        denom = np.sqrt(v / c2)
+        denom += self.eps
+        if self.adam_w_mode and self.weight_decay:
+            p *= 1.0 - lr * self.weight_decay
+        p -= lr * (m / c1) / denom
+
+
+class NVMeOptimizer:
+    """Group-partitioned NVMe state store + pipelined host update."""
+
+    def __init__(self, nvme_path: str, opt_type: str,
+                 opt_params: Dict[str, Any],
+                 buffer_size: int = 100_000_000):
+        if not nvme_path:
+            raise ConfigError(
+                "offload_optimizer.device=nvme requires nvme_path")
+        # namespace by process + a per-engine token so two runs (or two
+        # engines) sharing one NVMe mount never overwrite each other's
+        # state (the reference swapper namespaces by rank the same way)
+        token = f"r{jax.process_index()}_{os.getpid()}_{id(self):x}"
+        self.dir = os.path.join(nvme_path, "zero_infinity", token)
+        self.adam = HostAdam(opt_type, opt_params)
+        self.buffer_size = max(int(buffer_size), 1)
+        self.groups: List[List[int]] = []      # leaf indices per group
+        self.swapper: Optional[OptimizerSwapper] = None
+        self._treedef = None
+        self._leaf_meta: List[Tuple[tuple, Any]] = []
+
+    # ------------------------------------------------------------------
+    def initialize(self, params: Any) -> None:
+        """Partition leaves into ~buffer_size groups; write fp32 master +
+        zero moments to NVMe (the zero.Init-time partitioning analog)."""
+        leaves, self._treedef = jax.tree_util.tree_flatten(params)
+        self._leaf_meta = [(tuple(np.shape(x)), np.float32) for x in leaves]
+        self.groups = []
+        cur, cur_bytes = [], 0
+        for i, leaf in enumerate(leaves):
+            nbytes = int(np.prod(np.shape(leaf)) or 1) * 4
+            if cur and cur_bytes + nbytes > self.buffer_size:
+                self.groups.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += nbytes
+        if cur:
+            self.groups.append(cur)
+        self.swapper = OptimizerSwapper(self.dir, len(self.groups))
+        for g, idxs in enumerate(self.groups):
+            ps = [np.asarray(leaves[i], np.float32) for i in idxs]
+            ms = [np.zeros_like(p) for p in ps]
+            vs = [np.zeros_like(p) for p in ps]
+            self.swapper.write_group(g, (ps, ms, vs))
+        log_dist(f"ZeRO-Infinity: {len(leaves)} leaves in "
+                 f"{len(self.groups)} NVMe swap groups under {self.dir}")
+
+    def _template(self, g: int):
+        shapes = [self._leaf_meta[i] for i in self.groups[g]]
+        mk = lambda: [jax.ShapeDtypeStruct(s, d) for s, d in shapes]
+        return (mk(), mk(), mk())
+
+    # ------------------------------------------------------------------
+    def step(self, grad_leaves: Sequence[Any], lr: float,
+             step_num: int) -> List[np.ndarray]:
+        """One optimizer step over all groups with double-buffered
+        prefetch.  ``grad_leaves``: flat leaves (device arrays; fetched
+        lazily per group).  Returns flat fp32 master leaves."""
+        assert self.swapper is not None, "initialize() first"
+        new_leaves: List[Optional[np.ndarray]] = [None] * len(self._leaf_meta)
+        G = len(self.groups)
+        if G:
+            self.swapper.prefetch_group(0, self._template(0))
+        for g, idxs in enumerate(self.groups):
+            if g + 1 < G:       # overlap: next group's read behind update
+                self.swapper.prefetch_group(g + 1, self._template(g + 1))
+            ps, ms, vs = self.swapper.read_group(g, self._template(g))
+            for j, i in enumerate(idxs):
+                gnp = np.asarray(grad_leaves[i], np.float32)
+                self.adam.update(ps[j], ms[j], vs[j], gnp, lr, step_num)
+                new_leaves[i] = ps[j]
+            self.swapper.write_group(g, (ps, ms, vs))
+        return new_leaves  # type: ignore[return-value]
+
+    # ------------------------------------------------------------------
+    # checkpoint support: materialize / restore the full fp32 state
+    #
+    # Known limit: these paths hold the whole fp32 tree in host RAM at
+    # once (training itself only ever holds one group).  Group-streamed
+    # checkpoint fragments are the planned fix for state that exceeds
+    # host DRAM.
+    # ------------------------------------------------------------------
+    def master_tree(self) -> Any:
+        leaves = [None] * len(self._leaf_meta)
+        for g, idxs in enumerate(self.groups):
+            ps, _, _ = self.swapper.read_group(g, self._template(g))
+            for j, i in enumerate(idxs):
+                leaves[i] = ps[j]
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    def moment_trees(self) -> Tuple[Any, Any]:
+        m_leaves = [None] * len(self._leaf_meta)
+        v_leaves = [None] * len(self._leaf_meta)
+        for g, idxs in enumerate(self.groups):
+            _, ms, vs = self.swapper.read_group(g, self._template(g))
+            for j, i in enumerate(idxs):
+                m_leaves[i], v_leaves[i] = ms[j], vs[j]
+        return (jax.tree_util.tree_unflatten(self._treedef, m_leaves),
+                jax.tree_util.tree_unflatten(self._treedef, v_leaves))
+
+    def restore(self, master: Any, m: Any = None, v: Any = None) -> None:
+        """Overwrite NVMe state from full trees (checkpoint load)."""
+        p_leaves = jax.tree_util.tree_leaves(master)
+        m_leaves = jax.tree_util.tree_leaves(m) if m is not None else None
+        v_leaves = jax.tree_util.tree_leaves(v) if v is not None else None
+        for g, idxs in enumerate(self.groups):
+            ps = [np.asarray(p_leaves[i], np.float32) for i in idxs]
+            ms = ([np.asarray(m_leaves[i], np.float32) for i in idxs]
+                  if m_leaves else [np.zeros_like(p) for p in ps])
+            vs = ([np.asarray(v_leaves[i], np.float32) for i in idxs]
+                  if v_leaves else [np.zeros_like(p) for p in ps])
+            self.swapper.write_group(g, (ps, ms, vs))
