@@ -1083,10 +1083,10 @@ class Engine:
         # working copy, so resume (on any config) is lossless — the same
         # fragment format as every other run.
         from .optimizers import AdamState
-        m, v = self._nvme.moment_trees()
+        master, m, v = self._nvme.state_trees()
         saved = self.state
         self.state = TrainState(
-            step=saved.step, master=self._nvme.master_tree(),
+            step=saved.step, master=master,
             opt_state=AdamState(m=m, v=v),
             loss_scale=saved.loss_scale, skipped=saved.skipped)
         try:

@@ -85,11 +85,24 @@ class NVMeOptimizer:
         if not nvme_path:
             raise ConfigError(
                 "offload_optimizer.device=nvme requires nvme_path")
+        if jax.process_count() > 1:
+            # the host update consumes globally-assembled arrays
+            # (np.asarray of sharded grads), which a multi-controller run
+            # cannot fetch; per-host local-shard swapping is future work
+            raise ConfigError(
+                "offload_optimizer.device=nvme is single-controller only "
+                "for now (use device=cpu on multi-host runs)")
         # namespace by process + a per-engine token so two runs (or two
         # engines) sharing one NVMe mount never overwrite each other's
         # state (the reference swapper namespaces by rank the same way)
         token = f"r{jax.process_index()}_{os.getpid()}_{id(self):x}"
         self.dir = os.path.join(nvme_path, "zero_infinity", token)
+        import shutil
+        import weakref
+        # swap files are scratch state — reclaim the NVMe space when the
+        # engine goes away (weakref.finalize also fires at exit)
+        self._cleanup = weakref.finalize(
+            self, shutil.rmtree, self.dir, True)
         self.adam = HostAdam(opt_type, opt_params)
         self.buffer_size = max(int(buffer_size), 1)
         self.groups: List[List[int]] = []      # leaf indices per group
@@ -158,23 +171,19 @@ class NVMeOptimizer:
     # checkpoint fragments are the planned fix for state that exceeds
     # host DRAM.
     # ------------------------------------------------------------------
-    def master_tree(self) -> Any:
-        leaves = [None] * len(self._leaf_meta)
+    def state_trees(self) -> Tuple[Any, Any, Any]:
+        """(master, m, v) full trees in one pass over the swap groups."""
+        cols = [[None] * len(self._leaf_meta) for _ in range(3)]
         for g, idxs in enumerate(self.groups):
-            ps, _, _ = self.swapper.read_group(g, self._template(g))
-            for j, i in enumerate(idxs):
-                leaves[i] = ps[j]
-        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+            parts = self.swapper.read_group(g, self._template(g))
+            for col, vals in zip(cols, parts):
+                for j, i in enumerate(idxs):
+                    col[i] = vals[j]
+        return tuple(jax.tree_util.tree_unflatten(self._treedef, col)
+                     for col in cols)
 
-    def moment_trees(self) -> Tuple[Any, Any]:
-        m_leaves = [None] * len(self._leaf_meta)
-        v_leaves = [None] * len(self._leaf_meta)
-        for g, idxs in enumerate(self.groups):
-            _, ms, vs = self.swapper.read_group(g, self._template(g))
-            for j, i in enumerate(idxs):
-                m_leaves[i], v_leaves[i] = ms[j], vs[j]
-        return (jax.tree_util.tree_unflatten(self._treedef, m_leaves),
-                jax.tree_util.tree_unflatten(self._treedef, v_leaves))
+    def master_tree(self) -> Any:
+        return self.state_trees()[0]
 
     def restore(self, master: Any, m: Any = None, v: Any = None) -> None:
         """Overwrite NVMe state from full trees (checkpoint load)."""

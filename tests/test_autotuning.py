@@ -1,0 +1,139 @@
+"""Autotuner tests (reference analog: tests/unit/autotuning/test_autotuning.py
+— experiment generation + tuner selection; here the search actually runs
+on the virtual mesh)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import (Experiment, autotune, build_space,
+                                      estimate_state_bytes,
+                                      mesh_factorizations, prune_by_memory)
+from deepspeed_tpu.autotuning.tuner import (GridTuner, ModelBasedTuner,
+                                            RandomTuner)
+
+
+class TestSpace:
+    def test_mesh_factorizations_cover_device_count(self):
+        for n in (1, 4, 8):
+            for m in mesh_factorizations(n):
+                assert m["data"] * m["fsdp"] * m["tensor"] == n
+        assert {"data": 2, "fsdp": 2, "tensor": 2} in mesh_factorizations(8)
+
+    def test_max_tensor_cap(self):
+        assert all(m["tensor"] <= 2 for m in
+                   mesh_factorizations(8, max_tensor=2))
+
+    def test_build_space_product(self):
+        space = build_space(8, stages=(0, 2), micro_batches=(2,),
+                            remat_policies=("nothing",),
+                            meshes=[{"data": 8, "fsdp": 1, "tensor": 1},
+                                    {"data": 1, "fsdp": 8, "tensor": 1}])
+        # stage>=1 with data=fsdp=1 never occurs in the given meshes
+        assert len(space) == 4
+        labels = {e.label() for e in space}
+        assert len(labels) == 4
+
+    def test_memory_pruning(self):
+        space = build_space(8, stages=(0, 3), micro_batches=(1,),
+                            remat_policies=("nothing",),
+                            meshes=[{"data": 1, "fsdp": 8, "tensor": 1}])
+        n_params = 1_000_000_000        # 1B params: 16 GB fp32 state
+        alive = prune_by_memory(space, n_params, hbm_bytes=4 << 30)
+        # stage 0 keeps everything replicated -> pruned; stage 3 shards
+        stages_alive = {e.overrides["zero_stage"] for e in alive}
+        assert 0 not in stages_alive and 3 in stages_alive
+        pruned = [e for e in space if e.pruned]
+        assert pruned and all("GB" in e.pruned for e in pruned)
+
+    def test_estimate_monotonic_in_stage(self):
+        mesh = {"data": 1, "fsdp": 8, "tensor": 1}
+        ests = [estimate_state_bytes(10_000_000, s, mesh) for s in (0, 1, 3)]
+        assert ests[0] > ests[1] > ests[2]
+
+
+def _fake_run(times):
+    """Run fn that assigns a deterministic step time per label."""
+    def run(e):
+        t = times.get(e.label())
+        if t is None:
+            e.error = "boom"
+        else:
+            e.step_time_s = t
+        return e
+    return run
+
+
+class TestTuners:
+    def space(self):
+        return build_space(8, stages=(0, 1), micro_batches=(1, 2),
+                           remat_policies=("nothing",),
+                           meshes=[{"data": 8, "fsdp": 1, "tensor": 1},
+                                   {"data": 1, "fsdp": 8, "tensor": 1}])
+
+    def test_grid_respects_budget(self):
+        space = self.space()
+        times = {e.label(): 1.0 for e in space}
+        out = GridTuner(space, _fake_run(times)).tune(3)
+        assert len(out) == 3
+
+    def test_random_is_seeded(self):
+        space = self.space()
+        times = {e.label(): 1.0 for e in space}
+        a = RandomTuner(self.space(), _fake_run(times), seed=1).tune(4)
+        b = RandomTuner(self.space(), _fake_run(times), seed=1).tune(4)
+        assert [e.label() for e in a] == [e.label() for e in b]
+
+    def test_model_based_finds_best(self):
+        """With a step time that strictly favors micro_batch=2, the cost
+        model must steer the remaining budget toward mb=2 candidates."""
+        space = build_space(8, stages=(0,), micro_batches=(1, 2, 4, 8),
+                            remat_policies=("nothing", "dots_no_batch"),
+                            meshes=[{"data": 8, "fsdp": 1, "tensor": 1}])
+        times = {e.label(): 10.0 / e.overrides["micro_batch"]
+                 for e in space}
+        out = ModelBasedTuner(space, _fake_run(times), seed=0).tune(6)
+        best = min((e for e in out if e.ok), key=lambda e: e.step_time_s)
+        assert best.overrides["micro_batch"] == 8
+
+    def test_failed_experiments_survive(self):
+        space = self.space()
+        times = {e.label(): 1.0 for e in space[:2]}   # rest error out
+        out = GridTuner(space, _fake_run(times)).tune(len(space))
+        assert any(e.error for e in out)
+
+
+class TestEndToEnd:
+    def test_autotune_on_virtual_mesh(self):
+        """Real search: tiny transformer, 3 candidates, real engines."""
+        from deepspeed_tpu.models import build_model
+        from deepspeed_tpu.runtime import param_count
+
+        def model_fn(remat_policy):
+            return build_model("gpt2", num_layers=2, d_model=64,
+                               num_heads=4, vocab_size=256, max_seq_len=32,
+                               remat=remat_policy != "nothing",
+                               remat_policy=remat_policy
+                               if remat_policy != "nothing" else "dots")
+
+        def batch_fn(bs):
+            return {"input_ids": np.random.RandomState(0).randint(
+                0, 256, (bs, 32))}
+
+        base = {"optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "steps_per_print": 10_000}
+        space = build_space(
+            8, stages=(0, 2), micro_batches=(2,),
+            remat_policies=("nothing",),
+            meshes=[{"data": 8, "fsdp": 1, "tensor": 1},
+                    {"data": 1, "fsdp": 8, "tensor": 1},
+                    {"data": 4, "fsdp": 1, "tensor": 2}])
+        model = model_fn("nothing")
+        ranked = autotune(model_fn, base, batch_fn,
+                          n_params=param_count(model.params),
+                          space=space, tuner="grid", budget=3, steps=2)
+        ok = [e for e in ranked if e.ok]
+        assert len(ok) >= 2, [e.error or e.pruned for e in ranked]
+        # ranked ascending by measured step time
+        ts = [e.step_time_s for e in ok]
+        assert ts == sorted(ts)
+        assert ok[0].compile_time_s is not None
