@@ -180,28 +180,38 @@ def evaluate(exp: Experiment, model_fn: Callable[[str], Any],
         model = model_fn(exp.overrides["remat_policy"])
         t0 = time.perf_counter()
         eng = ds.initialize(model=model, config=cfg)
-        batch = batch_fn(eng.train_batch_size)
-        m = eng.train_batch(batch)            # compile + step 1
+        # batch_fn receives the PER-PROCESS sample count: under
+        # multi-host, shard_batch treats its input as this process's
+        # slice of the global batch (engine.shard_batch contract)
+        batch = batch_fn(eng.train_batch_size // jax.process_count())
+        # stage once; reused for compile, analysis, and the timed loop
+        # (shard_batch is idempotent and the step doesn't donate it)
+        staged = eng.shard_batch(batch)
+        m = eng.train_batch(staged)           # compile + step 1
         float(np.asarray(m["loss"]))
         exp.compile_time_s = time.perf_counter() - t0
         # compile-time signals (HLO flops + compiler peak-memory estimate)
         # — the pre-execution tier the reference's launch-and-parse design
-        # cannot see
+        # cannot see.  Analyze against the STAGED batch — the avals the
+        # step was compiled with (gas-reshaped, sharded); the raw host
+        # dict would trigger a second full compile and fail under gas>1
         try:
             from ..profiling import analyze_fn
-            stats = analyze_fn(eng._train_step_fn, eng.state, batch,
+            stats = analyze_fn(eng._train_step_fn, eng.state, staged,
                                jax.random.PRNGKey(0))
             exp.flops_per_step = stats.get("flops")
             if stats.get("peak_bytes"):
                 exp.peak_bytes = int(stats["peak_bytes"])
         except Exception:
             pass
+        # timed region is device-only — host-side batch synthesis must
+        # not distort the ranking
         for _ in range(max(warmup - 1, 0)):
-            m = eng.train_batch(batch_fn(eng.train_batch_size))
+            m = eng.train_batch(staged)
         float(np.asarray(m["loss"]))
         t0 = time.perf_counter()
         for _ in range(steps):
-            m = eng.train_batch(batch_fn(eng.train_batch_size))
+            m = eng.train_batch(staged)
         float(np.asarray(m["loss"]))
         exp.step_time_s = (time.perf_counter() - t0) / steps
     except Exception as e:  # OOM / unsupported combo / compile failure
@@ -227,8 +237,9 @@ def autotune(model_fn: Callable[[str], Any],
     (fastest first), failed/pruned ones at the end.
 
     ``model_fn(remat_policy) -> model`` builds the model per candidate
-    (remat is a model-construction choice here); ``batch_fn(batch_size)``
-    synthesizes a batch.  ``budget`` caps the number of *measured*
+    (remat is a model-construction choice here); ``batch_fn(n)``
+    synthesizes ``n`` samples — ``n`` is the per-process share of the
+    candidate's global batch.  ``budget`` caps the number of *measured*
     experiments — the tuner decides which candidates get measured
     (reference: Autotuner.tune autotuner.py + tuner hierarchy)."""
     import jax

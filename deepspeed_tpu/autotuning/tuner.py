@@ -82,7 +82,7 @@ class ModelBasedTuner(BaseTuner):
 
     def tune(self, budget: int) -> List[Experiment]:
         budget = min(budget, len(self.space))
-        n_seed = max(2, int(budget * self.seed_fraction))
+        n_seed = min(budget, max(2, int(budget * self.seed_fraction)))
         todo = self.space[:]
         self.rng.shuffle(todo)
         measured = [self.run(e) for e in todo[:n_seed]]

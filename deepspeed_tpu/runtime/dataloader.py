@@ -37,11 +37,18 @@ class DataLoader:
         self.drop_last = drop_last
         self.batch_fn = batch_fn
         self.epoch = 0
-        if not drop_last and self.n % batch_size:
-            raise ValueError("drop_last=False requires n % batch_size == 0")
+        tail = self.n % batch_size
+        if not drop_last and tail and tail % jax.process_count():
+            # a tail that stripes unevenly across processes would hand
+            # shard_batch inconsistent local shapes — fail loudly here
+            raise ValueError(
+                f"drop_last=False: final batch of {tail} is not divisible "
+                f"by process_count {jax.process_count()}")
 
     def __len__(self) -> int:
-        return self.n // self.batch_size
+        if self.drop_last:
+            return self.n // self.batch_size
+        return -(-self.n // self.batch_size)
 
     def set_epoch(self, epoch: int) -> None:
         """(reference: DistributedSampler.set_epoch)."""
@@ -59,6 +66,9 @@ class DataLoader:
                 f"batch_size {self.batch_size} not divisible by "
                 f"process_count {pc}")
         for step in range(len(self)):
+            # torch convention: drop_last=False yields the short final
+            # batch.  SPMD training wants drop_last=True (the default) —
+            # shard_batch requires batch % mesh data axes == 0.
             sel = order[step * self.batch_size:(step + 1) * self.batch_size]
             if pc > 1:
                 sel = sel[pi::pc]

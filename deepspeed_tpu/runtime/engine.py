@@ -1028,16 +1028,41 @@ class Engine:
         sp = self.topology.sp_size
         from ..comm.mesh import SEQ_AXIS
 
+        pc = jax.process_count()
+        data_shards = (self.topology.mesh.shape[DATA_AXIS]
+                       * self.topology.mesh.shape[FSDP_AXIS])
+
         def put(x):
             x = np.asarray(x)
+            b = x.shape[0]
+            if b % gas or (b * pc) % (gas * data_shards):
+                raise ValueError(
+                    f"batch dim {b} (x {pc} processes) not divisible by "
+                    f"gas={gas} x data shards {data_shards}; for a "
+                    "partial tail batch use eval or drop_last=True")
             # dim after batch is the sequence: shard it over the seq axis
             seq_entry = (SEQ_AXIS,) if (sp > 1 and x.ndim >= 2) else ()
             if gas > 1:
                 x = x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
                 spec = P(None, (DATA_AXIS, FSDP_AXIS), *seq_entry)
+                batch_dim = 1
             else:
                 spec = P((DATA_AXIS, FSDP_AXIS), *seq_entry)
-            return jax.device_put(x, NamedSharding(self.topology.mesh, spec))
+                batch_dim = 0
+            sharding = NamedSharding(self.topology.mesh, spec)
+            if pc > 1:
+                # x is this process's host-local slice (DataLoader yields
+                # per-process batch shards; every other dim — notably the
+                # sequence — is fully present locally).  Assemble the
+                # global array with an explicit global_shape scaling ONLY
+                # the batch dim: inference would scale every sharded dim
+                # by its cross-process extent and silently double a
+                # process-spanning SEQ_AXIS.
+                gshape = list(x.shape)
+                gshape[batch_dim] *= pc
+                return jax.make_array_from_process_local_data(
+                    sharding, x, tuple(gshape))
+            return jax.device_put(x, sharding)
 
         out = jax.tree.map(put, batch)
         if isinstance(out, dict):

@@ -75,8 +75,11 @@ def onebit_adam(lr, betas=(0.9, 0.999), eps: float = 1e-8,
             m_ = jnp.where(frozen, m_comp, m_exact)
             e_ = jnp.where(frozen, e_new, e)
             v_ = jnp.where(frozen, v, b2 * v + (1 - b2) * (g32 * g32))
-            c1 = 1 - b1 ** step_f
-            c2 = 1 - b2 ** step_f
+            # bias correction only during warmup: the reference's frozen
+            # phase is uncorrected exp_avg/(sqrt(exp_avg_sq)+eps)
+            # (reference adam.py:198,230)
+            c1 = jnp.where(frozen, 1.0, 1 - b1 ** step_f)
+            c2 = jnp.where(frozen, 1.0, 1 - b2 ** step_f)
             delta = -lr_t * (m_ / c1) / (jnp.sqrt(v_ / c2) + eps)
             if weight_decay:
                 delta = delta - lr_t * weight_decay * p.astype(jnp.float32)
@@ -138,6 +141,11 @@ def zero_one_adam(lr, betas=(0.9, 0.999), eps: float = 1e-8,
             m_ = jnp.where(refresh, m_exact, m_comp)
             e_ = jnp.where(refresh, e, e_new)
             v_ = jnp.where(refresh, b2 * v + (1 - b2) * (g32 * g32), v)
+            # deliberate deviation from the uncorrected reference update:
+            # always-on bias correction decays smoothly to 1, avoiding
+            # both per-step LR flicker (gating on `refresh`) and a ~6x
+            # one-time cliff (gating on a warm-start window) while
+            # matching the uncorrected asymptotics
             c1 = 1 - b1 ** step_f
             c2 = 1 - b2 ** step_f
             delta = -lr_t * (m_ / c1) / (jnp.sqrt(v_ / c2) + eps)
@@ -178,8 +186,10 @@ def onebit_lamb(lr, betas=(0.9, 0.999), eps: float = 1e-6,
             m_ = jnp.where(frozen, m_comp, m_exact)
             e_ = jnp.where(frozen, e_new, e)
             v_ = jnp.where(frozen, v, b2 * v + (1 - b2) * (g32 * g32))
-            c1 = 1 - b1 ** step_f
-            c2 = 1 - b2 ** step_f
+            # uncorrected after freeze, matching the reference (see
+            # onebit_adam)
+            c1 = jnp.where(frozen, 1.0, 1 - b1 ** step_f)
+            c2 = jnp.where(frozen, 1.0, 1 - b2 ** step_f)
             u = (m_ / c1) / (jnp.sqrt(v_ / c2) + eps)
             if weight_decay:
                 u = u + weight_decay * p32

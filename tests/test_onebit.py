@@ -26,11 +26,13 @@ def _run(opt, steps=60, lr=0.1):
 
 class TestOnebitOptimizers:
     def test_onebit_adam_converges(self):
-        loss, _ = _run(onebit_adam(0.05, freeze_step=20), steps=200)
+        # lr modest: the frozen phase is uncorrected (reference numerics),
+        # so effective steps after freeze are larger than plain Adam's
+        loss, _ = _run(onebit_adam(0.01, freeze_step=20), steps=200)
         assert loss < 1e-2
 
     def test_zero_one_adam_converges(self):
-        loss, _ = _run(zero_one_adam(0.05, var_freeze_step=50,
+        loss, _ = _run(zero_one_adam(0.01, var_freeze_step=50,
                                      var_update_scaler=8), steps=200)
         assert loss < 1e-2
 
