@@ -51,7 +51,9 @@ class TransformerConfig:
     eps: float = 1e-5
     remat: bool = False                       # jax.checkpoint each layer
     remat_policy: str = "nothing"              # nothing|dots|dots_no_batch
-    attention_impl: str = "xla"                # xla | flash (Pallas kernel)
+    # xla (stock softmax autodiff) | xla_flash (flash-style custom VJP in
+    # pure XLA, ops/xla_attention.py) | flash (Pallas kernel)
+    attention_impl: str = "xla_flash"
     # --- MoE (reference: deepspeed/moe; presets: mixtral) ----------------
     num_experts: int = 1                      # >1 => every layer is MoE
     moe_top_k: int = 2
@@ -88,6 +90,13 @@ REMAT_POLICIES = {
     "flash": lambda: jax.checkpoint_policies.save_from_both_policies(
         jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
         jax.checkpoint_policies.save_only_these_names("flash_out")),
+    # save the xla_flash VJP residuals (attention output + per-row lse) so
+    # a checkpointed layer's backward re-enters the custom VJP instead of
+    # replaying the forward softmax
+    "xla_flash": lambda: jax.checkpoint_policies.save_from_both_policies(
+        jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+        jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "attn_lse")),
 }
 
 
@@ -356,6 +365,9 @@ class Model:
             if cfg.attention_impl == "flash":
                 from ..ops.flash_attention import flash_attention
                 attention_fn = flash_attention
+            elif cfg.attention_impl == "xla_flash":
+                from ..ops.xla_attention import fused_attention
+                attention_fn = fused_attention
             else:
                 attention_fn = L.causal_attention
         self.params, self.param_axes = init_params(cfg, jax.random.PRNGKey(seed))

@@ -1,0 +1,119 @@
+"""Flash-style XLA attention (ops/xla_attention.py) vs the stock path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.layers import causal_attention
+from deepspeed_tpu.ops.xla_attention import fused_attention
+
+
+def _rand(shape, key, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, dtype)
+
+
+class TestFusedAttention:
+    @pytest.mark.parametrize("Hkv", [8, 2, 1])
+    def test_forward_matches_reference(self, Hkv):
+        B, S, H, D = 2, 64, 8, 16
+        q = _rand((B, S, H, D), 0)
+        k = _rand((B, S, Hkv, D), 1)
+        v = _rand((B, S, Hkv, D), 2)
+        np.testing.assert_allclose(
+            np.asarray(fused_attention(q, k, v)),
+            np.asarray(causal_attention(q, k, v)), atol=1e-5, rtol=1e-5)
+
+    def test_padding_mask_matches_reference(self):
+        B, S, H, D = 2, 32, 4, 16
+        q, k, v = _rand((B, S, H, D), 0), _rand((B, S, H, D), 1), \
+            _rand((B, S, H, D), 2)
+        mask = (jnp.arange(S)[None, :] < jnp.array([[20], [32]])[..., 0, None]
+                ).astype(jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(fused_attention(q, k, v, mask=mask)),
+            np.asarray(causal_attention(q, k, v, mask=mask)),
+            atol=1e-5, rtol=1e-5)
+
+    def test_bidirectional(self):
+        B, S, H, D = 1, 16, 2, 8
+        q, k, v = _rand((B, S, H, D), 0), _rand((B, S, H, D), 1), \
+            _rand((B, S, H, D), 2)
+        np.testing.assert_allclose(
+            np.asarray(fused_attention(q, k, v, causal=False)),
+            np.asarray(causal_attention(q, k, v, causal=False)),
+            atol=1e-5, rtol=1e-5)
+
+    @pytest.mark.parametrize("Hkv", [4, 2])
+    def test_gradients_match_reference(self, Hkv):
+        B, S, H, D = 2, 48, 4, 16
+        q = _rand((B, S, H, D), 0)
+        k = _rand((B, S, Hkv, D), 1)
+        v = _rand((B, S, Hkv, D), 2)
+        w = _rand((B, S, H, D), 3)     # random cotangent direction
+
+        def loss(fn):
+            return lambda q, k, v: (fn(q, k, v).astype(jnp.float32)
+                                    * w.astype(jnp.float32)).sum()
+
+        ga = jax.grad(loss(causal_attention), argnums=(0, 1, 2))(q, k, v)
+        gb = jax.grad(loss(fused_attention), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(ga, gb):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-4)
+
+    def test_gradients_match_with_mask(self):
+        B, S, H, D = 2, 32, 4, 8
+        q, k, v = _rand((B, S, H, D), 0), _rand((B, S, H, D), 1), \
+            _rand((B, S, H, D), 2)
+        mask = (jnp.arange(S)[None, :] < jnp.array([[24], [32]])[..., 0, None]
+                ).astype(jnp.float32)
+
+        def loss(fn):
+            return lambda q, k, v: fn(q, k, v, mask=mask).astype(
+                jnp.float32).sum()
+
+        ga = jax.grad(loss(causal_attention), argnums=(0, 1, 2))(q, k, v)
+        gb = jax.grad(loss(fused_attention), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(ga, gb):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-4)
+
+    def test_under_remat_policy(self):
+        """Gradients survive jax.checkpoint with the xla_flash policy."""
+        from deepspeed_tpu.models.transformer import REMAT_POLICIES
+        B, S, H, D = 1, 32, 2, 8
+        q, k, v = _rand((B, S, H, D), 0), _rand((B, S, H, D), 1), \
+            _rand((B, S, H, D), 2)
+
+        def f(q, k, v):
+            body = jax.checkpoint(
+                lambda q, k, v: fused_attention(q, k, v),
+                policy=REMAT_POLICIES["xla_flash"]())
+            return body(q, k, v).astype(jnp.float32).sum()
+
+        def g(q, k, v):
+            return causal_attention(q, k, v).astype(jnp.float32).sum()
+
+        ga = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+        gb = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(ga, gb):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-4)
+
+    def test_model_trains_with_xla_flash(self):
+        """End-to-end: default attention_impl trains and loss decreases."""
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.models import build_model
+        from deepspeed_tpu.runtime.dataloader import synthetic_lm_data
+
+        model = build_model("gpt2", num_layers=2, d_model=64, num_heads=4,
+                            vocab_size=128, max_seq_len=32)
+        assert model.config.attention_impl == "xla_flash"
+        eng = ds.initialize(model=model, config={
+            "train_micro_batch_size_per_device": 4,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "mesh": {"data": -1}, "steps_per_print": 1000})
+        data = synthetic_lm_data(128, eng.train_batch_size, 32)
+        losses = [float(eng.train_batch(data)["loss"]) for _ in range(8)]
+        assert losses[-1] < losses[0]
