@@ -22,6 +22,7 @@ API:
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -47,6 +48,14 @@ class InferenceConfig:
     max_seq_len: Optional[int] = None   # default: model max
     kv_dtype: object = jnp.bfloat16
     param_dtype: object = jnp.bfloat16
+    # paged attention implementation: "auto" probes the Pallas streaming
+    # kernel against the XLA gather formulation on the first step's real
+    # shapes and keeps the faster one; "xla" / "pallas" force a path
+    attn_impl: str = "auto"
+
+
+# attn-impl probe results, memoized per (backend, shape signature)
+_PROBE_CACHE: Dict[tuple, str] = {}
 
 
 class InferenceEngine:
@@ -80,11 +89,75 @@ class InferenceEngine:
         cfg = self.cfg
         bs = self.icfg.kv_block_size
         mbs = self.max_blocks_per_seq
+        impl = self.icfg.attn_impl
+        if impl == "auto":
+            impl = self._probe_attn_impl()
 
         def step(params, kv, batch: RaggedBatch):
-            return ragged_forward(cfg, params, kv, batch, bs, mbs)
+            return ragged_forward(cfg, params, kv, batch, bs, mbs,
+                                  attn_impl=impl)
 
         return jax.jit(step, donate_argnums=(1,))
+
+    def _probe_attn_impl(self) -> str:
+        """Time one ragged forward per implementation on the real compiled
+        shapes and keep the winner (the Pallas streaming kernel wins on
+        bare-metal TPUs; the XLA gather path wins on CPU meshes and some
+        virtualized/tunneled chips where Mosaic underperforms).  Results
+        are memoized per (backend, shape signature) for the process."""
+        import time
+
+        cfg, bs, mbs = self.cfg, self.icfg.kv_block_size, \
+            self.max_blocks_per_seq
+        T, ms = self.icfg.token_budget, self.icfg.max_seqs
+        nb = self.icfg.num_kv_blocks
+        key = (jax.default_backend(), cfg.num_layers, cfg.d_model,
+               cfg.num_heads, cfg.num_kv_heads, T, ms, bs, nb, mbs)
+        cached = _PROBE_CACHE.get(key)
+        if cached is not None:
+            return cached
+        # synthetic batch on the compiled shapes — does NOT touch the
+        # state manager (no slot/block allocation).  Representative work:
+        # every slot at FULL context (tables fully populated, positions at
+        # the last context token) — a near-empty batch would let the
+        # Pallas kernel skip almost all of its blocks while the XLA
+        # gather path pays full cost regardless, biasing the probe.
+        tables = np.zeros((ms, nb), np.int32)
+        tables[:, :mbs] = np.arange(mbs, dtype=np.int32)[None, :] \
+            % max(1, nb - 1)
+        last_pos = mbs * bs - 1
+        batch = RaggedBatch(
+            token_ids=jnp.zeros(T, jnp.int32),
+            positions=jnp.full(T, last_pos, jnp.int32),
+            seq_slot=jnp.arange(T, dtype=jnp.int32) % ms,
+            token_valid=jnp.ones(T, bool),
+            block_tables=jnp.asarray(tables),
+            context_lens=jnp.full(ms, last_pos + 1, jnp.int32),
+            logits_idx=jnp.full(ms, -1, jnp.int32).at[0].set(0),
+            n_tokens=T, n_seqs=ms)
+        results = {}
+        for impl in ("xla", "pallas"):
+            try:
+                f = jax.jit(partial(ragged_forward, cfg, attn_impl=impl,
+                                    block_size=bs, max_blocks_per_seq=mbs))
+                logits, _ = f(self.params, self.state.kv, batch)
+                jax.block_until_ready(logits)
+                t0 = time.perf_counter()
+                for _ in range(3):
+                    logits, _ = f(self.params, self.state.kv, batch)
+                float(jnp.sum(logits))      # completion barrier
+                results[impl] = time.perf_counter() - t0
+            except Exception as e:          # Mosaic unavailable/failed
+                logger.warning(f"paged-attention probe: {impl} failed "
+                               f"({type(e).__name__}); skipping")
+        best = min(results, key=results.get) if results else "xla"
+        if results:
+            logger.info(
+                f"paged-attention probe: {best} "
+                f"({ {k: round(v * 1e3, 1) for k, v in results.items()} }"
+                " ms/3 steps)")
+        _PROBE_CACHE[key] = best
+        return best
 
     # ------------------------------------------------------------------
     # request API (reference: engine_v2.put :107)

@@ -47,6 +47,17 @@ def _write_kv(kv_layer, k, v, batch: RaggedBatch, block_size: int):
     return kv_layer
 
 
+def _paged_attention_pallas(kv_layer, q, batch: RaggedBatch,
+                            block_size: int, max_blocks_per_seq: int,
+                            scale: float):
+    """Pallas streaming kernel behind the same signature
+    (ops/paged_attention.py — reference: blocked_flash)."""
+    from ..ops.paged_attention import paged_attention
+    return paged_attention(kv_layer, q, batch.seq_slot, batch.positions,
+                           batch.block_tables, block_size,
+                           max_blocks_per_seq, scale)
+
+
 def _paged_attention(kv_layer, q, batch: RaggedBatch, block_size: int,
                      max_blocks_per_seq: int, scale: float):
     """Per-token attention over the owning sequence's context
@@ -54,7 +65,8 @@ def _paged_attention(kv_layer, q, batch: RaggedBatch, block_size: int,
 
     q: [T, H, D] → out [T, H, D].  XLA formulation: gather each token's
     block table (bounded by max_blocks_per_seq), mask by position.  The
-    Pallas double-buffered variant drops in behind the same signature.
+    Pallas streaming variant (``_paged_attention_pallas``) drops in
+    behind the same signature; ``InferenceEngine`` probes both.
     """
     T, H, D = q.shape
     Hkv = kv_layer.shape[3]
@@ -78,12 +90,14 @@ def _paged_attention(kv_layer, q, batch: RaggedBatch, block_size: int,
 
 def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
                    block_size: int, max_blocks_per_seq: int,
-                   rng: Optional[jax.Array] = None
+                   rng: Optional[jax.Array] = None,
+                   attn_impl: str = "xla"
                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """→ (last_token_logits [max_seqs, vocab], new_kv).
 
     ``kv``: [L, blocks, bs, 2, Hkv, D].  Rows of the logits output whose
     ``batch.logits_idx`` is -1 are garbage (callers mask by it).
+    ``attn_impl``: "xla" (gather) | "pallas" (streaming kernel).
     """
     dt = params["embed"]["table"].dtype
     norm = _norm(cfg)
@@ -114,8 +128,9 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
             q = L.apply_rope(q[None], cos, sin, positions=pos)[0]
             k = L.apply_rope(k[None], cos, sin, positions=pos)[0]
         kv_layer = _write_kv(kv_layer, k, v, batch, block_size)
-        o = _paged_attention(kv_layer, q, batch, block_size,
-                             max_blocks_per_seq, scale)
+        attn = (_paged_attention_pallas if attn_impl == "pallas"
+                else _paged_attention)
+        o = attn(kv_layer, q, batch, block_size, max_blocks_per_seq, scale)
         o = jnp.einsum("thk,hkd->td", o, ap["wo"].astype(dt))
         if cfg.attn_bias:
             o = o + ap["bo"].astype(dt)

@@ -1,0 +1,139 @@
+"""Paged (blocked) decode attention — Pallas TPU kernel.
+
+TPU-native analog of the reference FastGen kernel family
+(``inference/v2/kernels/ragged_ops/blocked_flash`` — flash attention over
+a block table, ``atom_builder`` splitting sequences into fixed KV atoms).
+
+Where the XLA formulation in ``inference/model.py:_paged_attention``
+gathers every scheduled token's *entire* padded context
+(``kv_layer[tables]`` → [T, max_blocks, bs, 2, Hkv, D]) through HBM and
+then re-reads it for the attention einsums, this kernel streams each
+token's KV blocks through VMEM once with an online softmax, keeping the
+(m, l, acc) running state on-chip:
+
+* grid (T, num_blocks): one step attends one token (all heads) to one KV
+  block — the block carries every kv head so the trailing block dims are
+  full-size (a Mosaic tiling requirement) and DMA count stays at T×nb;
+* the block table and positions ride scalar prefetch
+  (``PrefetchScalarGridSpec``) so the kv BlockSpec's index_map picks the
+  DMA'd block dynamically — paged indirection happens in the DMA engine,
+  not as a gather;
+* blocks past a token's position are skipped (``pl.when``) — budget
+  padding tokens and table padding (-1 → trash row) contribute nothing;
+* GQA: a static (unrolled) loop over kv heads, one [rep, D]×[D, bs] MXU
+  dot per kv head per block.
+
+CPU tests run the same kernel in interpret mode.  ``InferenceEngine``
+probes this kernel against the XLA formulations at build time and keeps
+whichever is fastest on the running backend (Mosaic through the axon
+tunnel is much slower than bare-metal, so the probe matters).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _kernel(tables_ref, pos_ref, q_ref, kv_ref, o_ref,
+            acc_ref, m_ref, l_ref, *, block_size: int, scale: float,
+            num_kv_heads: int, rep: int):
+    t = pl.program_id(0)
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+    pos = pos_ref[t]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # the whole block is past this token's position → nothing to add
+    @pl.when(j * block_size <= pos)
+    def _compute():
+        cols = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (rep, block_size), 1)
+        keep = cols <= pos
+        for h in range(num_kv_heads):          # static unroll (GQA groups)
+            q = q_ref[0, h * rep:(h + 1) * rep, :]         # [rep, D]
+            k = kv_ref[0, :, 0, h, :]                      # [bs, D]
+            v = kv_ref[0, :, 1, h, :]                      # [bs, D]
+            s = jax.lax.dot_general(
+                q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [rep, bs]
+            s = jnp.where(keep, s, NEG_INF)
+            sl = slice(h * rep, (h + 1) * rep)
+            m_prev, l_prev = m_ref[sl, :], l_ref[sl, :]
+            m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            m_ref[sl, :] = m_new
+            l_ref[sl, :] = l_prev * corr + p.sum(axis=1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)          # [rep, D]
+            acc_ref[sl, :] = acc_ref[sl, :] * corr + pv
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def paged_attention(kv_layer, q, seq_slot, positions, block_tables,
+                    block_size: int, max_blocks_per_seq: int, scale: float):
+    """kv_layer: [blocks+1, bs, 2, Hkv, D] (last row = trash);
+    q: [T, H, D]; seq_slot/positions: [T] i32;
+    block_tables: [max_seqs, max_blocks] i32 (-1 pad) → out [T, H, D]."""
+    T, H, D = q.shape
+    nblocks, bs, _, Hkv, _ = kv_layer.shape
+    rep = H // Hkv
+    nb = max_blocks_per_seq
+
+    tables = block_tables[seq_slot, :nb]                   # [T, nb]
+    tables = jnp.where(tables < 0, nblocks - 1, tables).astype(jnp.int32)
+    positions = positions.astype(jnp.int32)
+
+    def _kv_index(t, j, tbl, pos):
+        # clamp past-position block indices to the last needed block:
+        # consecutive grid steps then revisit the same block and Pallas
+        # skips the DMA entirely (the kernel skips the compute)
+        jj = jnp.minimum(j, pos[t] // bs)
+        return (tbl[t, jj], 0, 0, 0, 0)
+
+    grid = (T, nb)
+    out = pl.pallas_call(
+        functools.partial(_kernel, block_size=bs, scale=scale,
+                          num_kv_heads=Hkv, rep=rep),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, H, D),
+                             lambda t, j, tbl, pos: (t, 0, 0)),
+                pl.BlockSpec((1, bs, 2, Hkv, D), _kv_index),
+            ],
+            out_specs=pl.BlockSpec((1, H, D),
+                                   lambda t, j, tbl, pos: (t, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((H, D), jnp.float32),
+                pltpu.VMEM((H, 1), jnp.float32),
+                pltpu.VMEM((H, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((T, H, D), q.dtype),
+        interpret=_use_interpret(),
+    )(tables, positions, q, kv_layer)
+    return out

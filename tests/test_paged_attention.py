@@ -1,0 +1,107 @@
+"""Pallas paged-attention kernel vs the XLA gather formulation
+(reference analog: inference/v2/kernels/ragged_ops blocked_flash tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.model import (_paged_attention,
+                                           _paged_attention_pallas)
+from deepspeed_tpu.inference.ragged.state import RaggedBatch
+
+
+def _mixed_batch(T=16, max_seqs=4, nblocks=12, bs=8, Hkv=2, D=16, seed=0):
+    """Three live sequences at different positions + budget padding."""
+    r = np.random.RandomState(seed)
+    # seq 0: decode at pos 19 (3 blocks); seq 1: prefill chunk pos 4..11
+    # (2 blocks); seq 2: decode at pos 0 (1 block)
+    tables = np.full((max_seqs, nblocks), -1, np.int32)
+    tables[0, :3] = [5, 2, 9]
+    tables[1, :2] = [1, 7]
+    tables[2, :1] = [4]
+    tok_pos = [(0, 19)] + [(1, p) for p in range(4, 12)] + [(2, 0)]
+    T_used = len(tok_pos)
+    positions = np.zeros(T, np.int32)
+    seq_slot = np.zeros(T, np.int32)
+    valid = np.zeros(T, bool)
+    for i, (s, p) in enumerate(tok_pos):
+        seq_slot[i], positions[i], valid[i] = s, p, True
+    kv = jnp.asarray(r.randn(nblocks + 1, bs, 2, Hkv, D), jnp.float32)
+    batch = RaggedBatch(
+        token_ids=jnp.zeros(T, jnp.int32),
+        positions=jnp.asarray(positions),
+        seq_slot=jnp.asarray(seq_slot),
+        token_valid=jnp.asarray(valid),
+        block_tables=jnp.asarray(tables),
+        context_lens=jnp.zeros(max_seqs, jnp.int32),
+        logits_idx=jnp.full(max_seqs, -1, jnp.int32),
+        n_tokens=T_used, n_seqs=3)
+    return kv, batch, bs
+
+
+class TestPagedAttentionKernel:
+    @pytest.mark.parametrize("H", [4, 2])
+    def test_matches_xla_gather(self, H):
+        kv, batch, bs = _mixed_batch()
+        Hkv, D = kv.shape[3], kv.shape[4]
+        q = jnp.asarray(np.random.RandomState(1).randn(
+            batch.token_ids.shape[0], H, D), jnp.float32)
+        scale = 1.0 / np.sqrt(D)
+        ref = _paged_attention(kv, q, batch, bs, 4, scale)
+        out = _paged_attention_pallas(kv, q, batch, bs, 4, scale)
+        valid = np.asarray(batch.token_valid)
+        np.testing.assert_allclose(np.asarray(out)[valid],
+                                   np.asarray(ref)[valid],
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_under_jit_with_bf16(self):
+        kv, batch, bs = _mixed_batch()
+        kv = kv.astype(jnp.bfloat16)
+        D = kv.shape[4]
+        q = jnp.asarray(np.random.RandomState(2).randn(
+            batch.token_ids.shape[0], 4, D), jnp.bfloat16)
+        scale = 1.0 / np.sqrt(D)
+        f_ref = jax.jit(lambda kv, q: _paged_attention(kv, q, batch, bs, 4,
+                                                       scale))
+        f_pal = jax.jit(lambda kv, q: _paged_attention_pallas(
+            kv, q, batch, bs, 4, scale))
+        valid = np.asarray(batch.token_valid)
+        np.testing.assert_allclose(
+            np.asarray(f_pal(kv, q)).astype(np.float32)[valid],
+            np.asarray(f_ref(kv, q)).astype(np.float32)[valid],
+            atol=2e-2, rtol=2e-2)
+
+    def test_engine_forced_pallas_decode_parity(self):
+        """Full serving stack with attn_impl=pallas matches the dense
+        forward (the greedy-parity bar from test_inference.py)."""
+        import deepspeed_tpu  # noqa: F401  (registers presets)
+        from tests.test_inference import make_fp32_engine, tiny_model
+        from deepspeed_tpu.models import apply
+
+        m = tiny_model()
+        eng = make_fp32_engine(m, attn_impl="pallas")
+        prompt = list(np.random.RandomState(3).randint(1, 128, 12))
+        out = eng.generate({7: prompt}, SamplingParams_greedy())[7]
+        # dense reference: greedy continuation with full attention
+        ids = list(prompt)
+        for _ in range(len(out)):
+            logits = apply(m.config, m.params,
+                           jnp.asarray([ids], jnp.int32))
+            ids.append(int(jnp.argmax(logits[0, -1])))
+        assert out == ids[len(prompt):]
+
+    def test_engine_auto_probe_selects_and_serves(self):
+        import deepspeed_tpu  # noqa: F401
+        from tests.test_inference import make_fp32_engine, tiny_model
+
+        m = tiny_model()
+        eng = make_fp32_engine(m, attn_impl="auto")
+        prompt = [3, 5, 7, 11]
+        out = eng.generate({1: prompt}, SamplingParams_greedy())
+        assert len(out[1]) > 0
+
+
+def SamplingParams_greedy():
+    from deepspeed_tpu.inference import SamplingParams
+    return SamplingParams(temperature=0.0, max_new_tokens=6)
