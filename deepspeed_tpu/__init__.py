@@ -20,3 +20,11 @@ def initialize(*args, **kwargs):
     from .runtime.engine import initialize as _init
 
     return _init(*args, **kwargs)
+
+
+def HybridEngine(*args, **kwargs):
+    """Train + fast-generate on shared weights for RLHF (reference:
+    deepspeed.runtime.hybrid_engine.DeepSpeedHybridEngine)."""
+    from .runtime.hybrid_engine import HybridEngine as _HE
+
+    return _HE(*args, **kwargs)

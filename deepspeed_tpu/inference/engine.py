@@ -52,6 +52,14 @@ class InferenceConfig:
     # kernel against the XLA gather formulation on the first step's real
     # shapes and keeps the faster one; "xla" / "pallas" force a path
     attn_impl: str = "auto"
+    # --- ZeRO-Inference (reference: inference/quantization, README:35) --
+    # "int8" | "int4": group-quantized weights, one layer dequantized at
+    # a time inside the forward (2-4x smaller resident model)
+    weight_quant: Optional[str] = None
+    quantize_embeddings: bool = False
+    # keep the paged KV cache in host memory, streaming one layer per
+    # scan step through HBM (over-HBM contexts; needs pinned_host)
+    kv_offload: bool = False
 
 
 # attn-impl probe results, memoized per (backend, shape signature)
@@ -79,10 +87,59 @@ class InferenceEngine:
         self.params = jax.tree.map(
             lambda x: x.astype(self.icfg.param_dtype)
             if x.dtype == jnp.float32 else x, model.params)
+        self._quant = None
+        if self.icfg.weight_quant:
+            from .quantization import quantize_model_params
+            bits = {"int8": 8, "int4": 4}[self.icfg.weight_quant]
+            self.params, self._quant = quantize_model_params(
+                self.params, bits=bits,
+                quantize_embeddings=self.icfg.quantize_embeddings)
+        if self.icfg.kv_offload:
+            self._offload_kv()
         self._pending: Dict[int, List[int]] = {}   # uid -> unprocessed toks
         self._ctx_exhausted: set = set()
         self._rng = jax.random.PRNGKey(0)
         self._step_fn = None
+        self._steps_done = 0
+
+    def refresh_params(self, params) -> None:
+        """Swap the served weights (hybrid-engine policy refresh).
+
+        Re-applies the serving cast AND re-quantizes under weight_quant —
+        the step closure captures the quantized tree, so merely assigning
+        ``self.params`` would keep serving the old quantized weights."""
+        self.params = jax.tree.map(
+            lambda x: x.astype(self.icfg.param_dtype)
+            if x.dtype == jnp.float32 else x, params)
+        if self.icfg.weight_quant:
+            from .quantization import quantize_model_params
+            bits = {"int8": 8, "int4": 4}[self.icfg.weight_quant]
+            self.params, self._quant = quantize_model_params(
+                self.params, bits=bits,
+                quantize_embeddings=self.icfg.quantize_embeddings)
+            self._step_fn = None        # closure holds the old quant tree
+
+    def _offload_kv(self) -> None:
+        """Move the paged KV cache to host memory (ZeRO-Inference KV
+        offload); best-effort — backends without an addressable host
+        space keep it in HBM with a warning."""
+        try:
+            # probe the whole path: the backend must also EXECUTE
+            # in-program host<->device transfers, not just place arrays
+            # (the CPU backend accepts the placement but has no runtime
+            # implementation for the device_put custom call)
+            def roundtrip(x):
+                h = jax.device_put(x, jax.memory.Space.Host)
+                return jax.device_put(h * 2.0, jax.memory.Space.Device)
+            jax.block_until_ready(jax.jit(roundtrip)(jnp.ones(8)))
+            kv = jax.device_put(self.state.kv, jax.memory.Space.Host)
+            jax.block_until_ready(kv)
+            self.state.kv = kv
+            self._kv_on_host = True
+        except Exception as e:
+            logger.warning(f"kv_offload unavailable on this backend "
+                           f"({type(e).__name__}); KV stays in HBM")
+            self._kv_on_host = False
 
     # ------------------------------------------------------------------
     def _build_step(self):
@@ -93,10 +150,20 @@ class InferenceEngine:
         if impl == "auto":
             impl = self._probe_attn_impl()
 
+        quant = self._quant
+        kv_host = getattr(self, "_kv_on_host", False)
+
         def step(params, kv, batch: RaggedBatch):
             return ragged_forward(cfg, params, kv, batch, bs, mbs,
-                                  attn_impl=impl)
+                                  attn_impl=impl, quant=quant,
+                                  kv_host=kv_host)
 
+        if kv_host:
+            # pin the cache output to host memory so the persistent
+            # state never round-trips through HBM between steps
+            out_sh = (None, self.state.kv.sharding)
+            return jax.jit(step, donate_argnums=(1,),
+                           out_shardings=out_sh)
         return jax.jit(step, donate_argnums=(1,))
 
     def _probe_attn_impl(self) -> str:
@@ -136,20 +203,33 @@ class InferenceEngine:
             logits_idx=jnp.full(ms, -1, jnp.int32).at[0].set(0),
             n_tokens=T, n_seqs=ms)
         results = {}
+        # probe on the real (pre-serving, all-zeros) cache with donation,
+        # threading the cache through — never two full KV pools live at
+        # once, matching the real step's memory profile
+        kv = self.state.kv
         for impl in ("xla", "pallas"):
             try:
                 f = jax.jit(partial(ragged_forward, cfg, attn_impl=impl,
-                                    block_size=bs, max_blocks_per_seq=mbs))
-                logits, _ = f(self.params, self.state.kv, batch)
+                                    block_size=bs, max_blocks_per_seq=mbs,
+                                    quant=self._quant,
+                                    kv_host=getattr(self, "_kv_on_host",
+                                                    False)),
+                            donate_argnums=(1,))
+                logits, kv = f(self.params, kv, batch)
                 jax.block_until_ready(logits)
                 t0 = time.perf_counter()
                 for _ in range(3):
-                    logits, _ = f(self.params, self.state.kv, batch)
+                    logits, kv = f(self.params, kv, batch)
                 float(jnp.sum(logits))      # completion barrier
                 results[impl] = time.perf_counter() - t0
             except Exception as e:          # Mosaic unavailable/failed
                 logger.warning(f"paged-attention probe: {impl} failed "
                                f"({type(e).__name__}); skipping")
+        # restore a pristine zero cache (the probe wrote its fake token)
+        self.state.kv = jnp.zeros(kv.shape, kv.dtype)
+        if getattr(self, "_kv_on_host", False):
+            self.state.kv = jax.device_put(self.state.kv,
+                                           jax.memory.Space.Host)
         best = min(results, key=results.get) if results else "xla"
         if results:
             logger.info(
@@ -241,8 +321,28 @@ class InferenceEngine:
         if self._step_fn is None:
             self._step_fn = self._build_step()
         batch = self.state.build_batch(sched, self.icfg.token_budget)
-        logits, self.state.kv = self._step_fn(self.params, self.state.kv,
-                                              batch)
+        try:
+            logits, self.state.kv = self._step_fn(
+                self.params, self.state.kv, batch)
+        except jax.errors.JaxRuntimeError:
+            # degrade to an HBM cache ONLY on the first-ever step (the
+            # backend compiled but cannot execute in-program host
+            # transfers); a later-step error must propagate — zeroing a
+            # live cache would silently corrupt every open sequence
+            if not getattr(self, "_kv_on_host", False) \
+                    or self._steps_done > 0:
+                raise
+            logger.warning("kv_offload: backend cannot execute host "
+                           "transfers; falling back to HBM KV")
+            self._kv_on_host = False
+            # the failed call donated the cache; at step 0 it is all
+            # zeros — recreate it
+            self.state.kv = jnp.zeros(self.state.kv.shape,
+                                      self.state.kv.dtype)
+            self._step_fn = self._build_step()
+            logits, self.state.kv = self._step_fn(
+                self.params, self.state.kv, batch)
+        self._steps_done += 1
         if rng is None and sampling.temperature > 0.0:
             self._rng, rng = jax.random.split(self._rng)
         toks = sample(logits, sampling, rng)

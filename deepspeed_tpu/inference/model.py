@@ -91,20 +91,35 @@ def _paged_attention(kv_layer, q, batch: RaggedBatch, block_size: int,
 def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
                    block_size: int, max_blocks_per_seq: int,
                    rng: Optional[jax.Array] = None,
-                   attn_impl: str = "xla"
+                   attn_impl: str = "xla",
+                   quant=None,
+                   kv_host: bool = False
                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """→ (last_token_logits [max_seqs, vocab], new_kv).
 
     ``kv``: [L, blocks, bs, 2, Hkv, D].  Rows of the logits output whose
     ``batch.logits_idx`` is -1 are garbage (callers mask by it).
     ``attn_impl``: "xla" (gather) | "pallas" (streaming kernel).
+    ``quant``: ZeRO-Inference weight-quant tree (inference/quantization
+    ``quantize_model_params``) — one layer is dequantized at a time
+    inside the scan body, so dense weights never all coexist in HBM.
+    ``kv_host``: the cache lives in host memory; each scan step streams
+    one layer through HBM and writes it back (ZeRO-Inference KV offload)
+    so device memory holds a single layer's KV at a time.
     """
-    dt = params["embed"]["table"].dtype
+    if quant is not None:
+        from .quantization import dequantize, merge_layer
+    if quant is not None and "embed" in quant:
+        embed_tab = {"table": dequantize(quant["embed"]["table"])}
+        dt = embed_tab["table"].dtype
+    else:
+        embed_tab = params["embed"]
+        dt = embed_tab["table"].dtype
     norm = _norm(cfg)
     act = L.ACTIVATIONS[cfg.activation]
     scale = 1.0 / (cfg.head_dim ** 0.5)
 
-    x = L.embed(params["embed"], batch.token_ids).astype(dt)       # [T, dm]
+    x = L.embed(embed_tab, batch.token_ids).astype(dt)             # [T, dm]
     if cfg.position == "learned":
         x = x + params["pos_embed"]["table"][batch.positions].astype(dt)
         cos = sin = None
@@ -112,7 +127,11 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
         cos, sin = L.rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
 
     def block(x, xs):
-        lp, kv_layer = xs
+        lp, kv_layer, li = xs
+        if kv_host:
+            kv_layer = jax.device_put(kv_layer, jax.memory.Space.Device)
+        if quant is not None:
+            lp = merge_layer(lp, quant["blocks"], li, dt)
         ap = lp["attn"]
         h = norm(lp["ln1"], x)
         q = jnp.einsum("td,dhk->thk", h, ap["wq"].astype(dt))
@@ -158,9 +177,13 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
             d = u @ mp["wo"].astype(dt)
             if cfg.mlp_bias:
                 d = d + mp["bo"].astype(dt)
+        if kv_host:
+            kv_layer = jax.device_put(kv_layer, jax.memory.Space.Host)
         return x + d, kv_layer
 
-    x, new_kv = jax.lax.scan(block, x, (params["blocks"], kv))
+    x, new_kv = jax.lax.scan(
+        block, x, (params["blocks"], kv,
+                   jnp.arange(cfg.num_layers, dtype=jnp.int32)))
 
     # logits only at each sequence's last scheduled token
     # (reference kernel: gather_for_logits / logits_gather)
@@ -168,7 +191,7 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
     last = x[idx]                                                  # [S, dm]
     last = norm(params["ln_f"], last)
     if cfg.tie_embeddings:
-        logits = last @ params["embed"]["table"].astype(dt).T
+        logits = last @ embed_tab["table"].astype(dt).T
     else:
         logits = last @ params["lm_head"]["kernel"].astype(dt)
     return logits.astype(jnp.float32), new_kv

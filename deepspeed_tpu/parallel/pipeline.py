@@ -7,19 +7,38 @@ TPU-native re-design of the reference pipeline stack
 send/recv ``pipe/p2p.py:46``).
 
 The reference interprets instruction lists per rank with explicit
-send/recv.  Under SPMD there is no per-rank program: the pipeline is a
-single ``lax.scan`` over ``T = M + S - 1`` ticks inside a ``shard_map``
-over the ``pipe`` axis (GPipe schedule).  Each tick every stage applies
-its layer slice and hands its activation to the next stage via
-``lax.ppermute`` — the instruction schedule *is* the scan, the p2p layer
-*is* ppermute riding ICI neighbor links, and the bubble is the standard
-(S-1)/T fraction.
+send/recv.  Under SPMD there is no per-rank program: a pipeline schedule
+is a single ``lax.scan`` over ticks inside one ``shard_map`` over the
+``pipe`` axis.  Each tick every stage applies its layer slice and hands
+its activation to the next stage via ``lax.ppermute`` — the instruction
+schedule *is* the scan and the p2p layer *is* ppermute riding ICI
+neighbor links.
+
+Two schedules:
+
+* **gpipe** — forward scan over ``M + S - 1`` ticks, backward by
+  autodiff through the scan.  Simple, but reverse-mode saves every
+  tick's boundary activation: live activation memory grows with M.
+* **1f1b** — the reference TrainSchedule's memory behaviour
+  (schedule.py:189: ``num_pipe_buffers = min(S - stage, M)`` :313),
+  implemented as an *eager-gradient* custom VJP: the forward runs the
+  interleaved fwd/bwd schedule itself (fwd of microbatch m at stage s on
+  tick ``m + s``; its backward on tick ``m + 2(S-1) - s + 1``, i.e.
+  immediately after the forward on the last stage), stashing only a ring
+  of ``min(M, 2S - 1)`` boundary activations per stage and accumulating
+  parameter gradients tick by tick.  ``jax.grad`` then merely scales the
+  precomputed gradients — activation memory is O(S), independent of M.
+
+Sequence parallelism composes: with ``seq > 1`` the sequence dim is
+sharded across the same shard_map and attention runs the per-shard
+Ulysses all-to-all (``parallel/sequence.make_ulysses_local``).
 
 Layer placement: the model's stacked ``blocks`` (leading ``layers`` dim)
 are sharded over ``pipe`` — contiguous equal slices, the 'uniform'
 partition method of module.py:391.  Embedding/unembedding stay replicated
-across stages (the reference's tied-layer broadcast, module.py:77, without
-the tie-grad allreduce since SPMD psums automatically).
+across stages (the reference's tied-layer broadcast, module.py:77; the
+tied-weight gradient allreduce is the explicit PIPE psum of the shared
+grads below / XLA's psum transpose under gpipe).
 """
 
 from __future__ import annotations
@@ -34,7 +53,7 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ..comm.mesh import BATCH_AXES, MeshTopology, PIPE_AXIS
+from ..comm.mesh import BATCH_AXES, MeshTopology, PIPE_AXIS, SEQ_AXIS
 from ..models import layers as L
 from ..models.transformer import (TransformerConfig, block_apply,
                                   rolled_lm_targets, _norm)
@@ -44,29 +63,121 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
                            num_microbatches: int,
                            attention_fn: Callable = L.causal_attention,
                            schedule: str = "gpipe"):
-    """Build ``loss_fn(params, batch, rng)`` running the GPipe schedule.
+    """Build ``loss_fn(params, batch, rng)`` running a pipeline schedule.
 
     Requirements: ``num_layers % pipe == 0``; the global micro-batch (the
-    engine's per-step batch) divisible by ``num_microbatches``.
+    engine's per-step batch) divisible by ``num_microbatches``; with
+    seq > 1, heads divisible by the seq axis (Ulysses constraint).
     """
     mesh = topology.mesh
     S = topology.pp_size
     M = num_microbatches
+    sp = topology.sp_size
     if cfg.num_layers % S:
         raise ValueError(f"num_layers {cfg.num_layers} not divisible by "
                          f"pipe stages {S}")
     if cfg.num_experts > 1:
         raise NotImplementedError("pipeline + MoE not yet supported")
     if schedule not in ("gpipe", "1f1b"):
-        raise NotImplementedError(f"pipeline schedule {schedule!r}; "
-                                  "'gpipe' is implemented ('1f1b' runs as "
-                                  "gpipe — same math, more live memory)")
+        raise ValueError(f"unknown pipeline schedule {schedule!r} "
+                         "(gpipe | 1f1b)")
+    if sp > 1:
+        if cfg.num_heads % sp or cfg.num_kv_heads % sp:
+            raise ValueError(
+                f"pipeline x seq needs heads divisible by seq axis: "
+                f"H={cfg.num_heads}, Hkv={cfg.num_kv_heads}, seq={sp}")
+        from .sequence import make_ulysses_local
+        attention_fn = make_ulysses_local(attention_fn)
 
     norm = _norm(cfg)
-
     dp = topology.dp_world_size
+    reduce_axes = (PIPE_AXIS,) + tuple(BATCH_AXES) + \
+        ((SEQ_AXIS,) if sp > 1 else ())
+    batch_reduce_axes = tuple(BATCH_AXES) + ((SEQ_AXIS,) if sp > 1 else ())
+    data_spec = P(BATCH_AXES, SEQ_AXIS) if sp > 1 else P(BATCH_AXES)
 
-    def loss_fn(params, batch, rng):
+    # ---------------------------------------------------------------- util
+    def stage_fwd(blocks_local, x, attn_mask, cos, sin):
+        def body(h, lp):
+            h, _ = block_apply(cfg, lp, h, cos, sin, mask=attn_mask,
+                               attention_fn=attention_fn)
+            return h, None
+        body_fn = jax.checkpoint(body) if cfg.remat else body
+        x, _ = lax.scan(body_fn, x, blocks_local)
+        return x
+
+    def head_nll(shared, y, labels, msk):
+        """Unembed + lse - target_logit loss sum (no fp32 [mb,S,V]
+        buffer — same rationale as cross_entropy_loss)."""
+        dt = shared["embed"]["table"].dtype
+        h = norm(shared["ln_f"], y)
+        if cfg.tie_embeddings:
+            logits = h @ shared["embed"]["table"].astype(dt).T
+        else:
+            logits = h @ shared["lm_head"]["kernel"].astype(dt)
+        lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+        tgt = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+        nll = lse - tgt.astype(jnp.float32)
+        return (nll * msk).sum()
+
+    def embed_in(shared, ids, pos0, seq_local):
+        dt = shared["embed"]["table"].dtype
+        x0 = L.embed(shared["embed"], ids).astype(dt)
+        if cfg.position == "learned":
+            tab = lax.dynamic_slice_in_dim(shared["pos_embed"]["table"],
+                                           pos0, seq_local)
+            x0 = x0 + tab.astype(dt)
+        return x0
+
+    def rope_tables(pos0, seq_local):
+        if cfg.position != "rope":
+            return None, None
+        cos, sin = L.rope_freqs(cfg.head_dim, cfg.max_seq_len,
+                                cfg.rope_theta)
+        return (lax.dynamic_slice_in_dim(cos, pos0, seq_local),
+                lax.dynamic_slice_in_dim(sin, pos0, seq_local))
+
+    def pos_offset(seq_local):
+        if sp > 1:
+            return lax.axis_index(SEQ_AXIS) * seq_local
+        return 0
+
+    def stage_ext(blocks_local, shared, x_in, ids, labels, msk, amask,
+                  cos, sin, pos0, seq_local):
+        """One stage's whole per-microbatch compute: (embed |
+        passthrough) -> layer slice -> (loss head on the last stage).
+        Differentiable in (blocks_local, shared, x_in)."""
+        stage = lax.axis_index(PIPE_AXIS)
+        first, last = stage == 0, stage == S - 1
+        x0 = embed_in(shared, ids, pos0, seq_local)
+        x = jnp.where(first, x0, x_in)
+        y = stage_fwd(blocks_local, x, amask, cos, sin)
+        contrib = jnp.where(last, head_nll(shared, y, labels, msk), 0.0)
+        return y, contrib
+
+    # ------------------------------------------------------------- shared
+    def split_params(params):
+        blocks = params["blocks"]
+        shared = {k: v for k, v in params.items() if k != "blocks"}
+        return blocks, shared
+
+    def batch_views(ids, labels, tgt_mask, amask):
+        B, seq_local = ids.shape
+        mb = B // M
+        return (ids.reshape(M, mb, seq_local),
+                labels.reshape(M, mb, seq_local),
+                tgt_mask.reshape(M, mb, seq_local),
+                amask.reshape(M, mb, seq_local), mb, seq_local)
+
+    def mb_slice(arrs, m):
+        return tuple(lax.dynamic_index_in_dim(a, m, 0, keepdims=False)
+                     for a in arrs)
+
+    perm_down = [(i, i + 1) for i in range(S - 1)]
+    perm_up = [(i + 1, i) for i in range(S - 1)]
+
+    # ===================================================== gpipe schedule
+    def gpipe_loss(params, batch, rng):
         ids = batch["input_ids"]
         B, seq = ids.shape
         if (B // dp) % M:
@@ -78,103 +189,195 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
         if amask is None:
             amask = jnp.ones_like(ids, jnp.float32)
 
-        if cfg.position == "rope":
-            cos, sin = L.rope_freqs(cfg.head_dim, cfg.max_seq_len,
-                                    cfg.rope_theta)
-        else:
-            cos = sin = None
-
-        def stage_fwd(blocks_local, x, attn_mask):
-            def body(h, lp):
-                h, _ = block_apply(cfg, lp, h, cos, sin, mask=attn_mask,
-                                   attention_fn=attention_fn)
-                return h, None
-            body_fn = jax.checkpoint(body) if cfg.remat else body
-            x, _ = lax.scan(body_fn, x, blocks_local)
-            return x
-
         def local(blocks, shared, ids, labels, tgt_mask, amask):
-            """Runs per pipe shard.  blocks: [L/S, ...] local slice;
-            shared (embed/pos/ln_f/head): replicated."""
             stage = lax.axis_index(PIPE_AXIS)
-            first, last = stage == 0, stage == S - 1
+            last = stage == S - 1
             dt = shared["embed"]["table"].dtype
-
-            # ids here is the per-(data,fsdp)-shard slice
-            mb = ids.shape[0] // M
-            ids_mb = ids.reshape(M, mb, seq)
-            labels_mb = labels.reshape(M, mb, seq)
-            mask_mb = tgt_mask.reshape(M, mb, seq)
-            amask_mb = amask.reshape(M, mb, seq)
+            views = batch_views(ids, labels, tgt_mask, amask)
+            ids_mb, labels_mb, mask_mb, amask_mb, mb, seq_local = views
+            pos0 = pos_offset(seq_local)
+            cos, sin = rope_tables(pos0, seq_local)
 
             T = M + S - 1
-            perm = [(i, i + 1) for i in range(S - 1)]
 
             def tick(carry, t):
                 buf, loss_sum, tok_sum = carry
-                # stage 0 ingests microbatch t (clamped; masked later)
-                t_in = jnp.clip(t, 0, M - 1)
-                x0 = L.embed(shared["embed"],
-                             lax.dynamic_index_in_dim(
-                                 ids_mb, t_in, 0, keepdims=False)).astype(dt)
-                if cfg.position == "learned":
-                    x0 = x0 + shared["pos_embed"]["table"][:seq].astype(dt)
-                x = jnp.where(first, x0, buf)
-                # stage s processes microbatch t-s at tick t
                 t_here = jnp.clip(t - stage, 0, M - 1)
-                m_att = lax.dynamic_index_in_dim(amask_mb, t_here, 0,
-                                                 keepdims=False)
-                y = stage_fwd(blocks, x, m_att)
-
-                # last stage: unembed + loss for microbatch t-(S-1)
-                t_out = jnp.clip(t - (S - 1), 0, M - 1)
-                h = norm(shared["ln_f"], y)
-                if cfg.tie_embeddings:
-                    logits = h @ shared["embed"]["table"].astype(dt).T
-                else:
-                    logits = h @ shared["lm_head"]["kernel"].astype(dt)
-                lbl = lax.dynamic_index_in_dim(labels_mb, t_out, 0,
-                                               keepdims=False)
-                msk = lax.dynamic_index_in_dim(mask_mb, t_out, 0,
-                                               keepdims=False)
-                # lse - target_logit form: no fp32 [mb,seq,V] buffer
-                # (same rationale as cross_entropy_loss)
-                lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
-                tgt = jnp.take_along_axis(logits, lbl[..., None],
-                                          axis=-1)[..., 0]
-                nll = lse - tgt.astype(jnp.float32)
+                i, lbl, msk, am = mb_slice(
+                    (ids_mb, labels_mb, mask_mb, amask_mb), t_here)
+                y, contrib = stage_ext(blocks, shared, buf, i, lbl, msk,
+                                       am, cos, sin, pos0, seq_local)
+                # the last stage processes microbatch t-(S-1) at tick t
                 valid = last & (t >= S - 1)
-                contrib = jnp.where(valid, (nll * msk).sum(), 0.0)
+                contrib = jnp.where(valid, contrib, 0.0)
                 toks = jnp.where(valid, msk.sum(), 0.0)
-
-                # hand activation to the next stage
-                buf_next = lax.ppermute(y, PIPE_AXIS, perm) if S > 1 else y
+                buf_next = lax.ppermute(y, PIPE_AXIS, perm_down) \
+                    if S > 1 else y
                 return (buf_next, loss_sum + contrib, tok_sum + toks), None
 
-            buf0 = jnp.zeros((mb, seq, cfg.d_model), dt)
+            buf0 = jnp.zeros((mb, seq_local, cfg.d_model), dt)
             (_, loss_sum, tok_sum), _ = lax.scan(
                 tick, (buf0, jnp.float32(0.0), jnp.float32(0.0)),
                 jnp.arange(T))
-            # reduce over the pipe axis (only the last stage contributed)
-            # AND the batch axes — each data/fsdp shard saw different
-            # samples, and the global loss is sum/sum, not shard 0's mean
-            axes = (PIPE_AXIS,) + tuple(BATCH_AXES)
-            loss_sum = lax.psum(loss_sum, axes)
-            tok_sum = lax.psum(tok_sum, axes)
+            loss_sum = lax.psum(loss_sum, reduce_axes)
+            tok_sum = lax.psum(tok_sum, reduce_axes)
             return loss_sum / jnp.maximum(tok_sum, 1.0)
 
-        blocks = params["blocks"]
-        shared = {k: v for k, v in params.items() if k != "blocks"}
-
+        blocks, shared = split_params(params)
         blocks_specs = jax.tree.map(lambda _: P(PIPE_AXIS), blocks)
         shared_specs = jax.tree.map(lambda _: P(), shared)
-        data_spec = P(BATCH_AXES)
-
         return shard_map(
             local, mesh=mesh,
             in_specs=(blocks_specs, shared_specs, data_spec, data_spec,
                       data_spec, data_spec),
             out_specs=P(),
             check_vma=False)(blocks, shared, ids, labels, tgt_mask, amask)
+
+    if schedule == "gpipe":
+        return gpipe_loss
+
+    # ====================================================== 1f1b schedule
+    # fwd of mb m at stage s on tick m+s; bwd on tick m + 2(S-1) - s + 1.
+    # Ring of R = min(M, 2S-1) stashed boundary activations per stage.
+    R = min(M, 2 * S - 1)
+    T2 = M + 2 * S - 1
+
+    def sched_local(blocks, shared, ids, labels, tgt_mask, amask):
+        """Runs the full interleaved schedule; returns per-shard
+        (loss_sum, tok_sum, grad_blocks, grad_shared), all psum'd."""
+        stage = lax.axis_index(PIPE_AXIS)
+        last = stage == S - 1
+        dt = shared["embed"]["table"].dtype
+        views = batch_views(ids, labels, tgt_mask, amask)
+        ids_mb, labels_mb, mask_mb, amask_mb, mb, seq_local = views
+        pos0 = pos_offset(seq_local)
+        cos, sin = rope_tables(pos0, seq_local)
+
+        def run_ext(x_in, m):
+            i, lbl, msk, am = mb_slice(
+                (ids_mb, labels_mb, mask_mb, amask_mb), m)
+            return (lambda b, sh, x: stage_ext(
+                b, sh, x, i, lbl, msk, am, cos, sin, pos0, seq_local)), msk
+
+        def tick(carry, t):
+            buf_f, buf_b, stash, gb, gsh, loss_sum, tok_sum = carry
+
+            # ---- backward slot (reads stash BEFORE this tick's fwd write)
+            m_b = t - 2 * (S - 1) + stage - 1
+            b_active = (m_b >= 0) & (m_b < M)
+            m_b_c = jnp.clip(m_b, 0, M - 1)
+            x_st = lax.dynamic_index_in_dim(stash, m_b_c % R, 0,
+                                            keepdims=False)
+            fn, _ = run_ext(x_st, m_b_c)
+            _, pull = jax.vjp(fn, blocks, shared, x_st)
+            seed_y = jnp.where(b_active, buf_b, jnp.zeros_like(buf_b))
+            seed_c = jnp.where(b_active & last, 1.0, 0.0)
+            gb_m, gsh_m, x_bar = pull((seed_y.astype(dt), seed_c))
+            act = b_active.astype(jnp.float32)
+            gb = jax.tree.map(lambda a, g: a + act * g.astype(jnp.float32),
+                              gb, gb_m)
+            gsh = jax.tree.map(lambda a, g: a + act * g.astype(jnp.float32),
+                               gsh, gsh_m)
+            x_bar = jnp.where(b_active, x_bar, jnp.zeros_like(x_bar))
+
+            # ---- forward slot
+            m_f = t - stage
+            f_active = (m_f >= 0) & (m_f < M)
+            m_f_c = jnp.clip(m_f, 0, M - 1)
+            fn_f, msk_f = run_ext(buf_f, m_f_c)
+            y, contrib = fn_f(blocks, shared, buf_f)
+            valid = last & f_active
+            loss_sum = loss_sum + jnp.where(valid, contrib, 0.0)
+            tok_sum = tok_sum + jnp.where(valid, msk_f.sum(), 0.0)
+            stash = stash.at[m_f_c % R].set(
+                jnp.where(f_active, buf_f, stash[m_f_c % R]))
+
+            # ---- hand off: activation down, cotangent up.  NOTE: these
+            # and the slots' collectives are mutually independent; on the
+            # virtual CPU mesh this requires the sequential thunk
+            # scheduler (--xla_cpu_enable_concurrency_optimized_scheduler
+            # =false, see tests/conftest.py) or the in-process rendezvous
+            # can deadlock.  Real TPUs are unaffected.
+            buf_f_next = lax.ppermute(y, PIPE_AXIS, perm_down) \
+                if S > 1 else y
+            buf_b_next = lax.ppermute(x_bar, PIPE_AXIS, perm_up) \
+                if S > 1 else jnp.zeros_like(x_bar)
+            return (buf_f_next, buf_b_next, stash, gb, gsh,
+                    loss_sum, tok_sum), None
+
+        zeros_f32 = lambda tree: jax.tree.map(
+            lambda x: jnp.zeros(x.shape, jnp.float32), tree)
+        buf0 = jnp.zeros((mb, seq_local, cfg.d_model), dt)
+        stash0 = jnp.zeros((R, mb, seq_local, cfg.d_model), dt)
+        carry0 = (buf0, jnp.zeros_like(buf0), stash0,
+                  zeros_f32(blocks), zeros_f32(shared),
+                  jnp.float32(0.0), jnp.float32(0.0))
+        (_, _, _, gb, gsh, loss_sum, tok_sum), _ = lax.scan(
+            tick, carry0, jnp.arange(T2))
+
+        # blocks grads: each stage owns its slice — reduce over data axes
+        # only; shared grads: reduce over everything incl. pipe (the tied
+        # embed/head gradient allreduce of module.py:77)
+        loss_sum = lax.psum(loss_sum, reduce_axes)
+        tok_sum = lax.psum(tok_sum, reduce_axes)
+        gb = jax.tree.map(lambda g: lax.psum(g, batch_reduce_axes), gb)
+        gsh = jax.tree.map(lambda g: lax.psum(g, reduce_axes), gsh)
+        return loss_sum, tok_sum, gb, gsh
+
+    def run_sched(params, batch):
+        ids = batch["input_ids"]
+        B, seq = ids.shape
+        if (B // dp) % M:
+            raise ValueError(
+                f"per-dp-shard batch {B}//{dp} not divisible by "
+                f"num_microbatches {M}")
+        amask = batch.get("attention_mask")
+        labels, tgt_mask = rolled_lm_targets(ids, amask)
+        if amask is None:
+            amask = jnp.ones_like(ids, jnp.float32)
+        blocks, shared = split_params(params)
+        blocks_specs = jax.tree.map(lambda _: P(PIPE_AXIS), blocks)
+        shared_specs = jax.tree.map(lambda _: P(), shared)
+        loss_sum, tok_sum, gb, gsh = shard_map(
+            sched_local, mesh=mesh,
+            in_specs=(blocks_specs, shared_specs, data_spec, data_spec,
+                      data_spec, data_spec),
+            out_specs=(P(), P(), blocks_specs, shared_specs),
+            check_vma=False)(blocks, shared, ids, labels, tgt_mask, amask)
+        tok = jnp.maximum(tok_sum, 1.0)
+        grads = dict(gsh)
+        grads["blocks"] = gb
+        # d(loss)/dp where loss = loss_sum / tok (tok is constant in p)
+        grads = jax.tree.map(lambda g: g / tok, grads)
+        return loss_sum / tok, grads
+
+    @jax.custom_vjp
+    def loss_1f1b(params, batch):
+        loss, _ = run_sched(params, batch)
+        return loss
+
+    def loss_1f1b_fwd(params, batch):
+        loss, grads = run_sched(params, batch)
+        aval = lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype)
+        return loss, (grads, jax.tree.map(aval, params),
+                      jax.tree.map(aval, batch))
+
+    def loss_1f1b_bwd(res, g):
+        grads, pavals, bavals = res
+        pbar = jax.tree.map(lambda gr, a: (g * gr).astype(a.dtype),
+                            grads, pavals)
+        # batch cotangents are never consumed (grad is taken w.r.t.
+        # params only): float0 for integer leaves, zeros for float ones
+        bbar = jax.tree.map(
+            lambda a: np.zeros(a.shape, jax.dtypes.float0)
+            if jnp.issubdtype(a.dtype, jnp.integer)
+            or jnp.issubdtype(a.dtype, jnp.bool_)
+            else jnp.zeros(a.shape, a.dtype), bavals)
+        return pbar, bbar
+
+    loss_1f1b.defvjp(loss_1f1b_fwd, loss_1f1b_bwd)
+
+    def loss_fn(params, batch, rng):
+        return loss_1f1b(params, batch)
 
     return loss_fn

@@ -34,6 +34,26 @@ from ..comm.mesh import BATCH_AXES, MeshTopology, SEQ_AXIS, TENSOR_AXIS
 from ..models.layers import causal_attention
 
 
+def make_ulysses_local(base_attention: Callable = causal_attention
+                       ) -> Callable:
+    """Per-shard Ulysses attention for callers ALREADY inside a shard_map
+    over ``seq`` (e.g. the pipeline loss, which runs one outer shard_map
+    over pipe x data x seq).  Same a2a dance as ``make_ulysses_attention``
+    without the nested shard_map."""
+
+    def attn(q, k, v, mask=None, scale=None):
+        a2a = functools.partial(lax.all_to_all, axis_name=SEQ_AXIS,
+                                split_axis=2, concat_axis=1, tiled=True)
+        q_, k_, v_ = a2a(q), a2a(k), a2a(v)
+        if mask is not None:
+            mask = lax.all_gather(mask, SEQ_AXIS, axis=1, tiled=True)
+        o = base_attention(q_, k_, v_, mask=mask, scale=scale)
+        return lax.all_to_all(o, axis_name=SEQ_AXIS, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+    return attn
+
+
 def make_ulysses_attention(topology: MeshTopology,
                            base_attention: Callable = causal_attention
                            ) -> Callable:
@@ -53,18 +73,12 @@ def make_ulysses_attention(topology: MeshTopology,
                 f"Ulysses needs heads divisible by seq*tensor axes: "
                 f"H={H}, Hkv={Hkv}, seq={sp}, tensor={tp}")
 
+        # heads-scatter/seq-gather before local attention, inverse after
+        # (reference single_all_to_all layer.py:41)
+        inner = make_ulysses_local(base_attention)
+
         def local(q, k, v, mask):
-            # [B, S/sp, h, D] -> [B, S, h/sp, D]  (heads-scatter/seq-gather,
-            # reference single_all_to_all layer.py:41)
-            a2a = functools.partial(lax.all_to_all, axis_name=SEQ_AXIS,
-                                    split_axis=2, concat_axis=1, tiled=True)
-            q_, k_, v_ = a2a(q), a2a(k), a2a(v)
-            if mask is not None:
-                mask = lax.all_gather(mask, SEQ_AXIS, axis=1, tiled=True)
-            o = base_attention(q_, k_, v_, mask=mask, scale=scale)
-            # inverse: [B, S, h/sp, D] -> [B, S/sp, h, D]
-            return lax.all_to_all(o, axis_name=SEQ_AXIS, split_axis=1,
-                                  concat_axis=2, tiled=True)
+            return inner(q, k, v, mask=mask, scale=scale)
 
         qspec = P(BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, None)
         mspec = P(BATCH_AXES, SEQ_AXIS) if mask is not None else P()

@@ -1203,10 +1203,10 @@ def initialize(loss_fn: Callable = None,
         # Ulysses/ring wrapper over this run's mesh
         seq_size = max(cfg.mesh.seq, cfg.sequence_parallel.size)
         pipe_size = max(cfg.mesh.pipe, cfg.pipeline.stages)
-        if loss_fn is None and seq_size > 1 and hasattr(model, "config"):
-            if pipe_size > 1:
-                raise NotImplementedError(
-                    "sequence parallel + pipeline not yet composable")
+        # seq parallel WITHOUT pipeline: swap attention in the plain loss.
+        # With pipeline, make_pipelined_loss_fn composes seq itself.
+        if loss_fn is None and seq_size > 1 and pipe_size == 1 \
+                and hasattr(model, "config"):
             from ..parallel.sequence import make_attention
             from ..models.transformer import lm_loss_fn
 
@@ -1215,8 +1215,14 @@ def initialize(loss_fn: Callable = None,
             attn = make_attention(topology, cfg.sequence_parallel.mode,
                                   **({"base_attention": base} if base else {}))
             loss_fn = lm_loss_fn(model.config, attn)
-        # pipeline parallelism: GPipe loss over the pipe axis
+        # pipeline parallelism (gpipe/1f1b) over the pipe axis; seq > 1
+        # composes via per-shard Ulysses inside the pipeline shard_map
         if loss_fn is None and pipe_size > 1 and hasattr(model, "config"):
+            if seq_size > 1 and cfg.sequence_parallel.mode != "ulysses":
+                raise NotImplementedError(
+                    f"sequence_parallel.mode="
+                    f"{cfg.sequence_parallel.mode!r} is not composable "
+                    "with pipeline parallelism (only 'ulysses' is)")
             from ..parallel.pipeline import make_pipelined_loss_fn
 
             topology = topology or MeshTopology.build(cfg.mesh)

@@ -1,0 +1,133 @@
+"""Hybrid engine: one set of weights serving both RLHF training and fast
+generation.
+
+TPU-native re-design of the reference DeepSpeedHybridEngine
+(``runtime/hybrid_engine.py:32`` — ``generate()`` :363 flips the actor
+into inference containers sharing (gathered) ZeRO-3 weights, LoRA
+fuse/unfuse :141-158, then flips back for the PPO update).
+
+The XLA redesign is simpler because weights are immutable pytrees:
+
+* the training half is the ordinary :class:`~.engine.Engine` (ZeRO
+  sharded fp32 masters, single donated train step);
+* the generation half is the FastGen :class:`~..inference.InferenceEngine`
+  (paged KV, SplitFuse continuous batching, Pallas decode kernel);
+* ``generate()`` refreshes the serving weights from the training masters
+  when they are stale — one jitted gather+cast (``Engine.compute_params``
+  — under ZeRO-3 this is the same all-gather a training step performs)
+  followed by an optional LoRA **fuse** (``linear.merge_lora``).  Nothing
+  is mutated, so the reference's unfuse/"release & re-partition" dance
+  (:141,:158) has no analog: the training masters were never touched.
+* stale KV from a previous policy is never reused: a refresh flushes all
+  live sequences (each RLHF rollout starts against the new policy).
+
+Usage (the DeepSpeed-Chat actor loop)::
+
+    he = HybridEngine(model, config, inference_config=InferenceConfig(...))
+    rollouts = he.generate({0: prompt_tokens}, SamplingParams(...))
+    metrics = he.train_batch(ppo_batch)       # ZeRO train step
+    rollouts = he.generate(...)               # sees the updated policy
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..utils.logging import logger
+
+
+def fuse_lora_tree(params: Any, lora_config) -> Any:
+    """Merge every ``{weight, lora_a, lora_b}`` node into a plain fused
+    weight (reference: fuse_lora hybrid_engine.py:141 — here producing a
+    new tree; the trainable factors are untouched)."""
+    from ..linear.optimized_linear import merge_lora
+
+    def fuse(node):
+        if isinstance(node, dict) and "lora_a" in node:
+            merged = dict(node)
+            merged["base"] = merge_lora(node, lora_config)  # dense fused
+            merged.pop("lora_a"), merged.pop("lora_b")
+            return merged
+        return node
+
+    return jax.tree.map(
+        fuse, params,
+        is_leaf=lambda n: isinstance(n, dict) and "lora_a" in n)
+
+
+class HybridEngine:
+    def __init__(self, model, config, inference_config=None,
+                 lora_config=None, **engine_kw):
+        from .. import initialize
+        from ..inference import InferenceConfig, InferenceEngine
+
+        self.model = model
+        self.engine = initialize(model=model, config=config, **engine_kw)
+        self.lora_config = lora_config
+        self._icfg = inference_config or InferenceConfig()
+        self._infer: Optional[InferenceEngine] = None
+        self._params_step = -1          # train step the serving params match
+
+    # ------------------------------------------------------------ training
+    def train_batch(self, batch):
+        """One PPO/actor optimizer step (plain engine delegation)."""
+        return self.engine.train_batch(batch)
+
+    def eval_batch(self, batch):
+        return self.engine.eval_batch(batch)
+
+    def save_checkpoint(self, *a, **kw):
+        return self.engine.save_checkpoint(*a, **kw)
+
+    def load_checkpoint(self, *a, **kw):
+        out = self.engine.load_checkpoint(*a, **kw)
+        self._params_step = -1          # serving weights are now stale
+        return out
+
+    # ---------------------------------------------------------- generation
+    def _serving_params(self):
+        """Training masters -> serving weights: jitted gather+cast, then
+        LoRA fuse (reference: fuse_lora hybrid_engine.py:141)."""
+        params = self.engine.compute_params
+        if self.lora_config is not None:
+            params = fuse_lora_tree(params, self.lora_config)
+        return params
+
+    def _refresh(self):
+        step = int(np.asarray(self.engine.state.step))
+        if self._infer is not None and step == self._params_step:
+            return
+        from ..inference import InferenceEngine
+
+        params = self._serving_params()
+        if self._infer is None:
+            self._infer = InferenceEngine(self.model, self._icfg)
+        else:
+            # a new policy invalidates every live sequence's KV
+            for uid in list(self._infer.state.seqs):
+                self._infer.flush(uid)
+        # refresh_params re-casts AND re-quantizes under weight_quant —
+        # a bare params assignment would keep serving the old quantized
+        # weights captured in the step closure
+        self._infer.refresh_params(params)
+        self._params_step = step
+        logger.info(f"hybrid-engine: serving weights refreshed @ step {step}")
+
+    def generate(self, prompts: Dict[int, Sequence[int]], sampling=None,
+                 rng: Optional[jax.Array] = None) -> Dict[int, List[int]]:
+        """FastGen generation against the CURRENT policy weights
+        (reference: HybridEngine.generate :363)."""
+        from ..inference.sampler import SamplingParams
+
+        self._refresh()
+        return self._infer.generate(prompts, sampling or SamplingParams(),
+                                    rng=rng)
+
+    @property
+    def inference_engine(self):
+        """The live serving engine (refreshed; for put/step-level use)."""
+        self._refresh()
+        return self._infer

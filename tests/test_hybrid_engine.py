@@ -1,0 +1,124 @@
+"""Hybrid engine (RLHF train + generate on shared weights).
+Reference analog: runtime/hybrid_engine.py DeepSpeedHybridEngine."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.inference import InferenceConfig, SamplingParams
+from deepspeed_tpu.models import build_model
+
+
+def make_hybrid(**over):
+    m = build_model("gpt2", vocab_size=128, num_layers=2, d_model=64,
+                    num_heads=4, max_seq_len=64, seed=3)
+    cfg = {"train_micro_batch_size_per_device": 2,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+           "zero_optimization": {"stage": over.pop("stage", 3)},
+           "mesh": {"data": 2, "fsdp": 4},
+           "steps_per_print": 1000}
+    icfg = InferenceConfig(token_budget=32, max_seqs=4, kv_block_size=16,
+                           num_kv_blocks=32, kv_dtype=jnp.float32,
+                           param_dtype=jnp.float32)
+    return m, ds.HybridEngine(m, cfg, inference_config=icfg)
+
+
+GREEDY = SamplingParams(temperature=0.0, max_new_tokens=6)
+
+
+class TestHybridEngine:
+    def test_train_generate_train_cycle(self):
+        m, he = make_hybrid()
+        prompt = list(np.random.RandomState(0).randint(1, 128, 8))
+        ids = np.random.RandomState(1).randint(0, 128, (16, 32))
+
+        g0 = he.generate({0: prompt}, GREEDY)[0]
+        l0 = float(he.train_batch({"input_ids": ids})["loss"])
+        g1 = he.generate({0: prompt}, GREEDY)[0]
+        losses = [float(he.train_batch({"input_ids": ids})["loss"])
+                  for _ in range(4)]
+        assert losses[-1] < l0               # training kept working
+        assert len(g0) == len(g1) == 6
+
+    def test_generation_tracks_training_weights(self):
+        """After a large-LR step the served weights must be the UPDATED
+        policy: greedy output matches a dense forward of compute_params."""
+        m, he = make_hybrid()
+        ids = np.random.RandomState(1).randint(0, 128, (16, 32))
+        for _ in range(3):
+            he.train_batch({"input_ids": ids})
+        prompt = [5, 9, 2, 17]
+        out = he.generate({0: prompt}, GREEDY)[0]
+
+        params = he.engine.compute_params
+        seq = list(prompt)
+        for _ in range(len(out)):
+            logits = m.apply(params, jnp.asarray([seq], jnp.int32),
+                             dtype=jnp.float32)
+            seq.append(int(jnp.argmax(logits[0, -1])))
+        assert out == seq[len(prompt):]
+
+    def test_refresh_is_lazy(self):
+        m, he = make_hybrid()
+        he.generate({0: [1, 2, 3]}, GREEDY)
+        eng1 = he.inference_engine
+        step1 = he._params_step
+        he.generate({1: [4, 5]}, GREEDY)     # no train step between
+        assert he._params_step == step1
+        assert he.inference_engine is eng1   # engine reused, not rebuilt
+
+    def test_checkpoint_reload_invalidates_serving_weights(self):
+        import tempfile
+        m, he = make_hybrid()
+        ids = np.random.RandomState(1).randint(0, 128, (16, 32))
+        he.train_batch({"input_ids": ids})
+        he.generate({0: [1, 2, 3]}, GREEDY)
+        d = tempfile.mkdtemp()
+        he.save_checkpoint(d)
+        he.load_checkpoint(d)
+        assert he._params_step == -1
+
+    def test_lora_fuse_for_serving(self):
+        from deepspeed_tpu.linear.optimized_linear import (
+            LoRAConfig, init_optimized_linear)
+        from deepspeed_tpu.runtime.hybrid_engine import fuse_lora_tree
+
+        lcfg = LoRAConfig(lora_r=4, lora_alpha=8.0)
+        p = init_optimized_linear(jax.random.PRNGKey(0), 8, 8, lora=lcfg)
+        # nonzero lora_b so the fuse actually changes the weight
+        p["lora_b"] = jnp.ones_like(p["lora_b"]) * 0.1
+        tree = {"layer0": {"proj": p}, "other": jnp.ones((3,))}
+        out = fuse_lora_tree(tree, lcfg)
+        assert "lora_a" not in out["layer0"]["proj"]
+        ref = np.asarray(p["base"]) + (lcfg.lora_alpha / lcfg.lora_r) * (
+            np.asarray(p["lora_a"]) @ np.asarray(p["lora_b"]))
+        np.testing.assert_allclose(
+            np.asarray(out["layer0"]["proj"]["base"]), ref, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(out["other"]),
+                                      np.ones(3))
+
+    def test_quantized_serving_refreshes_with_policy(self):
+        """Under weight_quant the refresh must RE-QUANTIZE: the step
+        closure serves the quantized tree, not the dense params."""
+        m = build_model("gpt2", vocab_size=128, num_layers=2, d_model=64,
+                        num_heads=4, max_seq_len=64, seed=3)
+        cfg = {"train_micro_batch_size_per_device": 2,
+               "optimizer": {"type": "adamw", "params": {"lr": 5e-2}},
+               "mesh": {"data": 8}, "steps_per_print": 1000}
+        icfg = InferenceConfig(token_budget=32, max_seqs=4,
+                               kv_block_size=16, num_kv_blocks=32,
+                               weight_quant="int8")
+        he = ds.HybridEngine(m, cfg, inference_config=icfg)
+        he.generate({0: [1, 2, 3]}, GREEDY)
+        q0 = np.asarray(
+            he.inference_engine._quant["blocks"]["attn"]["wq"].data).copy()
+        ids = np.random.RandomState(1).randint(0, 128, (16, 32))
+        for _ in range(3):
+            he.train_batch({"input_ids": ids})
+        he.generate({1: [4, 5, 6]}, GREEDY)
+        q1 = np.asarray(
+            he.inference_engine._quant["blocks"]["attn"]["wq"].data)
+        assert not np.array_equal(q0, q1), \
+            "served quantized weights did not track the policy update"

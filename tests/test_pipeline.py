@@ -92,3 +92,97 @@ class TestPartitionBalanced:
 
     def test_more_parts_than_items(self):
         assert partition_balanced([1, 1], 4) == [0, 1, 2, 2, 2]
+
+
+class Test1F1B:
+    """True 1F1B (eager-gradient custom VJP): numerics match gpipe and
+    DP, activation memory is bounded by the stage count, not M
+    (reference: schedule.py:189 TrainSchedule, num_pipe_buffers :313)."""
+
+    def _model(self, layers=4, seed=2):
+        return build_model("gpt2", vocab_size=128, num_layers=layers,
+                           d_model=64, num_heads=4, max_seq_len=32,
+                           seed=seed)
+
+    def test_grads_match_gpipe(self):
+        m = self._model()
+        ids = np.random.RandomState(0).randint(0, 128, (16, 32))
+        engs = {}
+        for sched in ("gpipe", "1f1b"):
+            engs[sched] = ds.initialize(model=m, config=base_cfg(
+                train_micro_batch_size_per_device=8,
+                mesh={"data": 2, "pipe": 4},
+                pipeline={"stages": 4, "num_microbatches": 4,
+                          "schedule": sched}))
+        outs = {}
+        for sched, eng in engs.items():
+            mtr = eng.train_batch({"input_ids": ids})
+            outs[sched] = (float(mtr["loss"]), float(mtr["grad_norm"]))
+        assert outs["1f1b"][0] == pytest.approx(outs["gpipe"][0], rel=1e-4)
+        assert outs["1f1b"][1] == pytest.approx(outs["gpipe"][1], rel=1e-3)
+
+    def test_training_descends_1f1b(self):
+        m = self._model()
+        eng = ds.initialize(model=m, config=base_cfg(
+            mesh={"data": 2, "pipe": 4},
+            pipeline={"stages": 4, "num_microbatches": 4,
+                      "schedule": "1f1b"}))
+        r = np.random.RandomState(1)
+        losses = []
+        for i in range(8):
+            ids = r.randint(0, 128, (eng.train_batch_size, 32))
+            losses.append(float(eng.train_batch({"input_ids": ids})["loss"]))
+        assert losses[-1] < losses[0]
+
+    def test_1f1b_bounds_activation_memory(self):
+        """With M >> S, 1f1b's compiled temp memory stays well below
+        gpipe's (ring of min(M, 2S-1) stashes vs M live boundaries)."""
+        import jax.numpy as jnp
+        from deepspeed_tpu.comm.mesh import MeshTopology
+        from deepspeed_tpu.parallel.pipeline import make_pipelined_loss_fn
+        from deepspeed_tpu.config.config import MeshConfig
+
+        m = build_model("gpt2", vocab_size=128, num_layers=2, d_model=64,
+                        num_heads=4, max_seq_len=32, remat=True)
+        topo = MeshTopology.build(MeshConfig(data=4, pipe=2))
+        M = 8
+        temps = {}
+        ids = np.random.RandomState(0).randint(0, 128, (32, 32))
+        for sched in ("gpipe", "1f1b"):
+            loss_fn = make_pipelined_loss_fn(m.config, topo, M,
+                                             schedule=sched)
+            g = jax.jit(jax.grad(lambda p: loss_fn(
+                p, {"input_ids": jnp.asarray(ids)}, None)))
+            mem = g.lower(m.params).compile().memory_analysis()
+            temps[sched] = mem.temp_size_in_bytes
+        assert temps["1f1b"] < 0.6 * temps["gpipe"], temps
+
+    def test_pipe_with_seq_parallel(self):
+        """pipe x seq composes: Ulysses a2a inside the pipeline
+        shard_map; eval parity with plain DP."""
+        m = self._model(layers=2)
+        eng = ds.initialize(model=m, config=base_cfg(
+            train_micro_batch_size_per_device=8,
+            mesh={"data": 1, "pipe": 2, "seq": 4},
+            pipeline={"stages": 2, "num_microbatches": 2}))
+        eng_dp = ds.initialize(model=m, config=base_cfg(
+            train_micro_batch_size_per_device=2,
+            mesh={"data": 8}))
+        ids = np.random.RandomState(3).randint(0, 128, (16, 32))
+        a = float(eng.eval_batch({"input_ids": ids}))
+        b = float(eng_dp.eval_batch({"input_ids": ids}))
+        assert a == pytest.approx(b, rel=1e-3)
+
+    def test_pipe_seq_1f1b_trains(self):
+        m = self._model(layers=2)
+        eng = ds.initialize(model=m, config=base_cfg(
+            train_micro_batch_size_per_device=8,
+            mesh={"data": 1, "pipe": 2, "seq": 4},
+            pipeline={"stages": 2, "num_microbatches": 2,
+                      "schedule": "1f1b"}))
+        r = np.random.RandomState(5)
+        losses = []
+        for i in range(6):
+            ids = r.randint(0, 128, (eng.train_batch_size, 32))
+            losses.append(float(eng.train_batch({"input_ids": ids})["loss"]))
+        assert losses[-1] < losses[0]
