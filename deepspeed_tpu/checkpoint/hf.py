@@ -110,7 +110,8 @@ def _convert_gpt2(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
 # Llama / Mistral (torch Linear layout [out, in]; separate q/k/v; RMSNorm)
 # --------------------------------------------------------------------------
 
-def _convert_llama(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
+def _convert_llama(cfg: TransformerConfig, sd: Dict[str, Any],
+                   with_mlp: bool = True) -> Dict:
     H, D, Hkv = cfg.num_heads, cfg.head_dim, cfg.num_kv_heads
     dm, nl = cfg.d_model, cfg.num_layers
     pre = "model." if any(k.startswith("model.") for k in sd) else ""
@@ -129,20 +130,21 @@ def _convert_llama(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
                 "wo": _stack(sd, L + "self_attn.o_proj.weight", nl,
                              lambda w: _o_heads(w, H, D, True)),
             },
-            "mlp": {
-                "wg": _stack(sd, L + "mlp.gate_proj.weight", nl,
-                             lambda w: w.T),
-                "wi": _stack(sd, L + "mlp.up_proj.weight", nl,
-                             lambda w: w.T),
-                "wo": _stack(sd, L + "mlp.down_proj.weight", nl,
-                             lambda w: w.T),
-            },
             "ln1": {"scale": _stack(sd, L + "input_layernorm.weight", nl)},
             "ln2": {"scale": _stack(
                 sd, L + "post_attention_layernorm.weight", nl)},
         },
         "ln_f": {"scale": _np(sd[f"{pre}norm.weight"])},
     }
+    if with_mlp:
+        params["blocks"]["mlp"] = {
+            "wg": _stack(sd, L + "mlp.gate_proj.weight", nl,
+                         lambda w: w.T),
+            "wi": _stack(sd, L + "mlp.up_proj.weight", nl,
+                         lambda w: w.T),
+            "wo": _stack(sd, L + "mlp.down_proj.weight", nl,
+                         lambda w: w.T),
+        }
     head_key = "lm_head.weight"
     if head_key in sd and not cfg.tie_embeddings:
         params["lm_head"] = {"kernel": _np(sd[head_key]).T}
@@ -201,18 +203,142 @@ def _convert_opt(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
     return params
 
 
+# --------------------------------------------------------------------------
+# Falcon (fused MQA query_key_value, parallel residual, single block LN)
+# --------------------------------------------------------------------------
+
+def _convert_falcon(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
+    H, D, Hkv = cfg.num_heads, cfg.head_dim, cfg.num_kv_heads
+    dm, nl = cfg.d_model, cfg.num_layers
+    pre = "transformer." if any(k.startswith("transformer.") for k in sd) \
+        else ""
+    L = pre + "h.{}."
+
+    def qkv(i):
+        # fused [(H + 2*Hkv) * D, dm]: q heads then k then v
+        w = _np(sd[L.format(i) + "self_attention.query_key_value.weight"]).T
+        wq = w[:, :H * D].reshape(dm, H, D)
+        wk = w[:, H * D:(H + Hkv) * D].reshape(dm, Hkv, D)
+        wv = w[:, (H + Hkv) * D:].reshape(dm, Hkv, D)
+        return dict(wq=wq, wk=wk, wv=wv)
+
+    def stacked(fn):
+        outs = [fn(i) for i in range(nl)]
+        return {k: np.stack([o[k] for o in outs]) for k in outs[0]}
+
+    attn = stacked(qkv)
+    attn["wo"] = _stack(sd, L + "self_attention.dense.weight", nl,
+                        lambda w: _o_heads(w, H, D, True))
+    params = {
+        "embed": {"table": _np(sd[f"{pre}word_embeddings.weight"])},
+        "blocks": {
+            "attn": attn,
+            "mlp": {
+                "wi": _stack(sd, L + "mlp.dense_h_to_4h.weight", nl,
+                             lambda w: w.T),
+                "wo": _stack(sd, L + "mlp.dense_4h_to_h.weight", nl,
+                             lambda w: w.T),
+            },
+            # parallel residual: one shared input layernorm
+            "ln1": {"scale": _stack(sd, L + "input_layernorm.weight", nl),
+                    "bias": _stack(sd, L + "input_layernorm.bias", nl)},
+        },
+        "ln_f": {"scale": _np(sd[f"{pre}ln_f.weight"]),
+                 "bias": _np(sd[f"{pre}ln_f.bias"])},
+    }
+    return params
+
+
+# --------------------------------------------------------------------------
+# Phi (partial rotary, parallel residual, biased linears + biased lm_head)
+# --------------------------------------------------------------------------
+
+def _convert_phi(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
+    H, D, dm, nl = cfg.num_heads, cfg.head_dim, cfg.d_model, cfg.num_layers
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    L = pre + "layers.{}."
+
+    params = {
+        "embed": {"table": _np(sd[f"{pre}embed_tokens.weight"])},
+        "blocks": {
+            "attn": {
+                "wq": _stack(sd, L + "self_attn.q_proj.weight", nl,
+                             lambda w: _qkv_heads(w, H, D, True)),
+                "wk": _stack(sd, L + "self_attn.k_proj.weight", nl,
+                             lambda w: _qkv_heads(w, H, D, True)),
+                "wv": _stack(sd, L + "self_attn.v_proj.weight", nl,
+                             lambda w: _qkv_heads(w, H, D, True)),
+                "wo": _stack(sd, L + "self_attn.dense.weight", nl,
+                             lambda w: _o_heads(w, H, D, True)),
+                "bq": _stack(sd, L + "self_attn.q_proj.bias", nl,
+                             lambda b: b.reshape(H, D)),
+                "bk": _stack(sd, L + "self_attn.k_proj.bias", nl,
+                             lambda b: b.reshape(H, D)),
+                "bv": _stack(sd, L + "self_attn.v_proj.bias", nl,
+                             lambda b: b.reshape(H, D)),
+                "bo": _stack(sd, L + "self_attn.dense.bias", nl),
+            },
+            "mlp": {
+                "wi": _stack(sd, L + "mlp.fc1.weight", nl, lambda w: w.T),
+                "bi": _stack(sd, L + "mlp.fc1.bias", nl),
+                "wo": _stack(sd, L + "mlp.fc2.weight", nl, lambda w: w.T),
+                "bo": _stack(sd, L + "mlp.fc2.bias", nl),
+            },
+            # parallel residual: one shared input layernorm
+            "ln1": {"scale": _stack(sd, L + "input_layernorm.weight", nl),
+                    "bias": _stack(sd, L + "input_layernorm.bias", nl)},
+        },
+        "ln_f": {"scale": _np(sd[f"{pre}final_layernorm.weight"]),
+                 "bias": _np(sd[f"{pre}final_layernorm.bias"])},
+        "lm_head": {"kernel": _np(sd["lm_head.weight"]).T,
+                    "bias": _np(sd["lm_head.bias"])},
+    }
+    return params
+
+
+# --------------------------------------------------------------------------
+# Mixtral (llama attention + block-sparse MoE experts)
+# --------------------------------------------------------------------------
+
+def _convert_mixtral(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
+    params = _convert_llama(cfg, sd, with_mlp=False)
+    nl, E = cfg.num_layers, cfg.num_experts
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    L = pre + "layers.{}."
+
+    def experts(i, name):
+        # HF: w1 [ffn, dm] (gate), w3 [ffn, dm] (up), w2 [dm, ffn] (down)
+        return np.stack([
+            _np(sd[L.format(i) +
+                   f"block_sparse_moe.experts.{e}.{name}.weight"]).T
+            for e in range(E)])
+
+    params["blocks"]["gate"] = {"kernel": _stack(
+        sd, L + "block_sparse_moe.gate.weight", nl, lambda w: w.T)}
+    params["blocks"]["experts"] = {
+        "wg": np.stack([experts(i, "w1") for i in range(nl)]),
+        "wi": np.stack([experts(i, "w3") for i in range(nl)]),
+        "wo": np.stack([experts(i, "w2") for i in range(nl)]),
+    }
+    return params
+
+
 CONVERTERS: Dict[str, Callable] = {
     "gpt2": _convert_gpt2,
     "llama": _convert_llama,
     "mistral": _convert_llama,     # same tensor layout
     "qwen2": _convert_llama,
+    "mixtral": _convert_mixtral,
+    "falcon": _convert_falcon,
+    "phi": _convert_phi,
     "opt": _convert_opt,
 }
 
 
 def family_of(name_or_type: str) -> str:
     s = name_or_type.lower()
-    for fam in ("llama", "mistral", "qwen2", "gpt2", "opt"):
+    for fam in ("mixtral", "llama", "mistral", "qwen2", "gpt2", "falcon",
+                "phi", "opt"):
         if fam in s:
             return fam
     raise ValueError(f"no HF converter for {name_or_type!r}; "

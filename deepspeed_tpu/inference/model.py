@@ -124,7 +124,7 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
         x = x + params["pos_embed"]["table"][batch.positions].astype(dt)
         cos = sin = None
     else:
-        cos, sin = L.rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        cos, sin = L.rope_freqs(cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta)
 
     def block(x, xs):
         lp, kv_layer, li = xs
@@ -153,9 +153,10 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
         o = jnp.einsum("thk,hkd->td", o, ap["wo"].astype(dt))
         if cfg.attn_bias:
             o = o + ap["bo"].astype(dt)
-        x = x + o
-
-        h = norm(lp["ln2"], x)
+        if not cfg.parallel_block:
+            x = x + o
+            h = norm(lp["ln2"], x)
+        # parallel residual (falcon/phi): MLP reads the same ln1 output
         if cfg.num_experts > 1:
             from ..parallel import moe as M
 
@@ -179,6 +180,8 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
                 d = d + mp["bo"].astype(dt)
         if kv_host:
             kv_layer = jax.device_put(kv_layer, jax.memory.Space.Host)
+        if cfg.parallel_block:
+            return x + o + d, kv_layer
         return x + d, kv_layer
 
     x, new_kv = jax.lax.scan(
@@ -194,4 +197,6 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
         logits = last @ embed_tab["table"].astype(dt).T
     else:
         logits = last @ params["lm_head"]["kernel"].astype(dt)
+        if cfg.head_bias:
+            logits = logits + params["lm_head"]["bias"].astype(dt)
     return logits.astype(jnp.float32), new_kv

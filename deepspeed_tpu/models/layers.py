@@ -84,16 +84,20 @@ def rope_freqs(head_dim: int, max_seq: int, theta: float = 10000.0):
 
 
 def apply_rope(x, cos, sin, positions=None):
-    """x: [B, S, H, D]; cos/sin: [maxS, D/2]; positions: [B, S] or None."""
+    """x: [B, S, H, D]; cos/sin: [maxS, R/2] with R <= D (partial rotary
+    — phi-style — rotates only the first R head dims); positions: [B, S]
+    or None."""
     if positions is None:
         c = cos[: x.shape[1]][None, :, None, :]
         s = sin[: x.shape[1]][None, :, None, :]
     else:
         c = cos[positions][:, :, None, :]
         s = sin[positions][:, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rot = 2 * cos.shape[-1]
+    xr, x_pass = x[..., :rot], x[..., rot:]
+    x1, x2 = jnp.split(xr.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
-    return out.astype(x.dtype)
+    return jnp.concatenate([out.astype(x.dtype), x_pass], axis=-1)
 
 
 # --------------------------------------------------------------------------

@@ -67,6 +67,29 @@ PRESETS: Dict[str, dict] = {
                          rope_theta=1000000.0, tie_embeddings=False,
                          attn_bias=False, mlp_bias=False,
                          num_experts=8, moe_top_k=2),
+    # --- Falcon (MQA + parallel residual, reference: containers/falcon) --
+    "falcon-tiny": dict(vocab_size=1024, num_layers=4, d_model=256,
+                        num_heads=8, num_kv_heads=1, max_seq_len=2048,
+                        activation="gelu", norm="layernorm",
+                        position="rope", parallel_block=True,
+                        tie_embeddings=True, attn_bias=False,
+                        mlp_bias=False),
+    "falcon-7b": dict(vocab_size=65024, num_layers=32, d_model=4544,
+                      num_heads=71, num_kv_heads=1, max_seq_len=2048,
+                      activation="gelu", norm="layernorm", position="rope",
+                      parallel_block=True, tie_embeddings=True,
+                      attn_bias=False, mlp_bias=False),
+    # --- Phi (partial rotary + parallel residual + biased head) ----------
+    "phi-tiny": dict(vocab_size=1024, num_layers=4, d_model=256,
+                     num_heads=8, max_seq_len=2048, activation="gelu_new",
+                     norm="layernorm", position="rope", rope_pct=0.4,
+                     parallel_block=True, tie_embeddings=False,
+                     attn_bias=True, mlp_bias=True, head_bias=True),
+    "phi-2": dict(vocab_size=51200, num_layers=32, d_model=2560,
+                  num_heads=32, max_seq_len=2048, activation="gelu_new",
+                  norm="layernorm", position="rope", rope_pct=0.4,
+                  parallel_block=True, tie_embeddings=False,
+                  attn_bias=True, mlp_bias=True, head_bias=True),
     # --- OPT ------------------------------------------------------------
     "opt-125m": dict(vocab_size=50272, num_layers=12, d_model=768,
                      num_heads=12, max_seq_len=2048, activation="relu",

@@ -45,9 +45,14 @@ class TransformerConfig:
     norm: str = "layernorm"                   # layernorm | rmsnorm
     position: str = "learned"                 # learned | rope
     rope_theta: float = 10000.0
+    rope_pct: float = 1.0                     # partial rotary (phi: 0.4)
+    # parallel residual: x + attn(ln(x)) + mlp(ln(x)), one shared norm
+    # (falcon, phi)
+    parallel_block: bool = False
     tie_embeddings: bool = True
     attn_bias: bool = True
     mlp_bias: bool = True
+    head_bias: bool = False                   # lm_head bias (phi)
     eps: float = 1e-5
     remat: bool = False                       # jax.checkpoint each layer
     remat_policy: str = "nothing"              # nothing|dots|dots_no_batch
@@ -79,6 +84,11 @@ class TransformerConfig:
     @property
     def head_dim(self) -> int:
         return self.d_model // self.num_heads
+
+    @property
+    def rotary_dim(self) -> int:
+        """Head dims receiving rotary embedding (even, <= head_dim)."""
+        return (int(self.head_dim * self.rope_pct) // 2) * 2
 
 
 REMAT_POLICIES = {
@@ -187,8 +197,9 @@ def init_params(cfg: TransformerConfig, key) -> Tuple[Dict, Dict]:
     norm_init = L.layernorm_init if cfg.norm == "layernorm" else L.rmsnorm_init
     blk_p["ln1"], blk_a["ln1"] = stack_init(
         lambda k: norm_init(dm), keys[4])
-    blk_p["ln2"], blk_a["ln2"] = stack_init(
-        lambda k: norm_init(dm), keys[5])
+    if not cfg.parallel_block:               # parallel residual: one norm
+        blk_p["ln2"], blk_a["ln2"] = stack_init(
+            lambda k: norm_init(dm), keys[5])
 
     params["blocks"] = blk_p
     axes["blocks"] = blk_a
@@ -199,6 +210,9 @@ def init_params(cfg: TransformerConfig, key) -> Tuple[Dict, Dict]:
             {"kernel": jax.random.normal(keys[6], (dm, cfg.vocab_size))
              / math.sqrt(dm)},
             {"kernel": ("embed", "vocab")})
+        if cfg.head_bias:
+            params["lm_head"]["bias"] = jnp.zeros((cfg.vocab_size,))
+            axes["lm_head"]["bias"] = ("vocab",)
     return params, axes
 
 
@@ -236,9 +250,11 @@ def block_apply(cfg: TransformerConfig, lp, x, cos, sin,
     o = jnp.einsum("bshk,hkd->bsd", o, ap["wo"].astype(dt))
     if cfg.attn_bias:
         o = o + ap["bo"].astype(dt)
-    x = x + o
 
-    h = norm(lp["ln2"], x)
+    if not cfg.parallel_block:
+        x = x + o
+        h = norm(lp["ln2"], x)
+    # parallel residual (falcon/phi): the MLP reads the same ln1 output
     metrics: Dict[str, Any] = {}
     if cfg.num_experts > 1:
         from ..parallel import moe as M
@@ -260,6 +276,8 @@ def block_apply(cfg: TransformerConfig, lp, x, cos, sin,
         d = u @ mp["wo"].astype(dt)
         if cfg.mlp_bias:
             d = d + mp["bo"].astype(dt)
+    if cfg.parallel_block:
+        return x + o + d, metrics
     return x + d, metrics
 
 
@@ -276,7 +294,7 @@ def apply(cfg: TransformerConfig, params, input_ids, mask=None,
         x = x + params["pos_embed"]["table"][:S].astype(dt)
         cos = sin = None
     else:
-        cos, sin = L.rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        cos, sin = L.rope_freqs(cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta)
 
     have_rng = rng is not None
     layer_rngs = (jax.random.split(rng, cfg.num_layers) if have_rng
@@ -299,6 +317,8 @@ def apply(cfg: TransformerConfig, params, input_ids, mask=None,
         logits = x @ params["embed"]["table"].astype(dt).T
     else:
         logits = x @ params["lm_head"]["kernel"].astype(dt)
+        if cfg.head_bias:
+            logits = logits + params["lm_head"]["bias"].astype(dt)
     if with_aux:
         aux = {k: v.mean() for k, v in metrics.items()} if metrics else {}
         return logits, aux

@@ -115,6 +115,8 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
             logits = h @ shared["embed"]["table"].astype(dt).T
         else:
             logits = h @ shared["lm_head"]["kernel"].astype(dt)
+            if cfg.head_bias:
+                logits = logits + shared["lm_head"]["bias"].astype(dt)
         lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
         tgt = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
         nll = lse - tgt.astype(jnp.float32)
@@ -132,7 +134,7 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
     def rope_tables(pos0, seq_local):
         if cfg.position != "rope":
             return None, None
-        cos, sin = L.rope_freqs(cfg.head_dim, cfg.max_seq_len,
+        cos, sin = L.rope_freqs(cfg.rotary_dim, cfg.max_seq_len,
                                 cfg.rope_theta)
         return (lax.dynamic_slice_in_dim(cos, pos0, seq_local),
                 lax.dynamic_slice_in_dim(sin, pos0, seq_local))
