@@ -374,6 +374,10 @@ class Config(ConfigModel):
     gradient_clipping: float = C.GRADIENT_CLIPPING_DEFAULT
     prescale_gradients: bool = False
     gradient_predivide_factor: float = 1.0
+    # sparse embedding-grad reduction over DP (reference:
+    # sparse_gradients_enabled; runtime/sparse_grads.py) — untied
+    # embeddings only (tied heads produce dense vocab gradients)
+    sparse_gradients: bool = False
     seed: int = C.SEED_DEFAULT
     # loss reported to monitor/scheduler is averaged over data axis
     dump_state: bool = False

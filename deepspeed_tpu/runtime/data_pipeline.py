@@ -119,9 +119,12 @@ class CurriculumDataSampler:
 
 
 class DataAnalyzer:
-    """Offline difficulty-metric computation (reference:
-    data_sampling/data_analyzer.py — map a metric fn over the dataset and
-    persist the index)."""
+    """Single-metric, in-memory convenience wrapper.  The full offline
+    map-reduce analyzer (multi-metric, worker-sharded, mmap-corpus,
+    sorted metric_to_sample indexes — reference:
+    data_sampling/data_analyzer.py, 880 LoC) is
+    :class:`deepspeed_tpu.runtime.data_analyzer.DataAnalyzer`; pair it
+    with :mod:`deepspeed_tpu.runtime.indexed_dataset` for large corpora."""
 
     def __init__(self, metric_fn: Callable[[Any], float]):
         self.metric_fn = metric_fn

@@ -135,6 +135,7 @@ class Engine:
                 config.optimizer.params, buffer_size=off_opt.buffer_size)
         self._build_shardings(params)
         self._qgz_axes = self._qgz_manual_axes()
+        self._sparse_axes = self._sparse_manual_axes(params)
 
         # optimizer + schedule (reference: _configure_basic_optimizer :1322)
         opt_cfg = config.optimizer
@@ -469,14 +470,44 @@ class Engine:
         (persistent) leaves remain full-precision."""
         if not self.config.zero_optimization.zero_quantized_gradients:
             return ()
+        return self._manual_reduce_axes("zero_quantized_gradients")
+
+    def _sparse_manual_axes(self, params) -> Tuple[str, ...]:
+        """Mesh axes for the sparse embedding-grad reduction
+        (config.sparse_gradients; reference: sparse_gradients_enabled +
+        engine.py sparse_allreduce_bucket)."""
+        if not self.config.sparse_gradients:
+            return ()
+        if self.config.zero_optimization.zero_quantized_gradients:
+            logger.warning("sparse_gradients + zero_quantized_gradients: "
+                           "qgZ takes the manual reduction; ignoring "
+                           "sparse_gradients")
+            return ()
+        # tied embeddings feed the unembed projection: the table's grad
+        # is DENSE over the vocab and row-capacity truncation would
+        # silently corrupt it.  Untied models carry a separate lm_head
+        # leaf — absence means tied; warn-and-disable.
+        from ..parallel.zero import _is_axes
+        a_flat = jax.tree.leaves(self.param_axes, is_leaf=_is_axes)
+        has_vocab_table = any(
+            isinstance(a, tuple) and len(a) >= 2 and a[0] == "vocab"
+            for a in a_flat)
+        untied = isinstance(params, dict) and "lm_head" in params
+        if has_vocab_table and not untied:
+            logger.warning("sparse_gradients: model ties embeddings (no "
+                           "lm_head leaf) — the vocab-table gradient is "
+                           "dense; ignoring sparse_gradients")
+            return ()
+        return self._manual_reduce_axes("sparse_gradients")
+
+    def _manual_reduce_axes(self, feature: str) -> Tuple[str, ...]:
         sizes = self.topology.axis_sizes
         if sizes.get("pipe", 1) > 1 or sizes.get("seq", 1) > 1:
             # both wrap the loss in their own shard_map (pipeline stages /
-            # Ulysses all_to_all), which cannot nest inside the qgZ manual
+            # Ulysses all_to_all), which cannot nest inside the manual
             # region
-            logger.warning("zero_quantized_gradients is not composable "
-                           "with pipeline or sequence parallelism yet; "
-                           "ignoring")
+            logger.warning(f"{feature} is not composable with pipeline "
+                           "or sequence parallelism yet; ignoring")
             return ()
         axes = []
         if sizes.get(DATA_AXIS, 1) > 1:
@@ -484,8 +515,8 @@ class Engine:
         if self.zero.stage <= 2 and sizes.get(FSDP_AXIS, 1) > 1:
             axes.append(FSDP_AXIS)
         if not axes:
-            logger.warning("zero_quantized_gradients: no multi-device "
-                           "reduction axis on this mesh; ignoring")
+            logger.warning(f"{feature}: no multi-device reduction axis "
+                           "on this mesh; ignoring")
         return tuple(axes)
 
     @staticmethod
@@ -510,20 +541,16 @@ class Engine:
         runtime/comm/coalesced_collectives.py + quant_reduce.cu;
         docs/_tutorials/zeropp.md:12-17 4x comm-volume claim).
 
-        shard_map is *manual* over the reduce axes and auto elsewhere
-        (TP/SP collectives stay compiler-placed).  Per grad leaf: axes
-        appearing in its grad spec get an int8 reduce-scatter onto the
-        owner shard (dequant-reduce on arrival); axes the leaf replicates
-        over get an int8 reduce-scatter + all-gather."""
+        Per grad leaf: axes appearing in its grad spec get an int8
+        reduce-scatter onto the owner shard (dequant-reduce on arrival);
+        axes the leaf replicates over get an int8 reduce-scatter +
+        all-gather."""
         from ..ops.quant import (quantized_all_reduce,
                                  quantized_psum_scatter_dim)
 
         manual = self._qgz_axes
-        mesh = self.topology.mesh
-        sizes = self.topology.axis_sizes
-        nred = int(np.prod([sizes[a] for a in manual]))
 
-        def reduce_leaf(g, spec):
+        def reduce_leaf(g, spec, axes, batch_tokens):
             ents = list(spec) + [None] * (g.ndim - len(list(spec)))
             seen = set()
             for d, e in enumerate(ents):
@@ -540,6 +567,52 @@ class Engine:
                 if a not in seen:
                     g = quantized_all_reduce(g, a)
             return g
+
+        return self._build_manual_grads(gas, manual, reduce_leaf)
+
+    def _build_sparse_grads(self, gas: int):
+        """Per-microbatch gradients with SPARSE reduction of embedding
+        grads (reference: runtime/sparse_tensor.py + engine.py:2518
+        sparse_allreduce_bucket): vocab-leading leaves travel as
+        (row ids, rows) over the DP axes — capacity one row per shard
+        token, so the reduction is lossless for pure-lookup embeddings.
+        NOTE: tied embeddings receive a DENSE unembed gradient; enable
+        only for untied models (capacity would truncate by row mass)."""
+        from .sparse_grads import is_sparse_leaf, sparse_psum
+
+        manual = self._sparse_axes
+
+        def reduce_leaf(g, spec, axes, batch_tokens):
+            ents = list(spec) + [None] * (g.ndim - len(list(spec)))
+            seen = set()
+            for d, e in enumerate(ents):
+                if e is None:
+                    continue
+                ax = (e,) if isinstance(e, str) else tuple(e)
+                for a in ax:
+                    if a in manual:
+                        g = jax.lax.psum_scatter(
+                            g, a, scatter_dimension=d, tiled=True)
+                        seen.add(a)
+            rest = tuple(a for a in manual if a not in seen)
+            if rest:
+                if is_sparse_leaf(axes):
+                    g = sparse_psum(g, rest,
+                                    capacity=min(g.shape[0], batch_tokens))
+                else:
+                    g = jax.lax.psum(g, rest)
+            return g
+
+        return self._build_manual_grads(gas, manual, reduce_leaf)
+
+    def _build_manual_grads(self, gas: int, manual: Tuple[str, ...],
+                            reduce_leaf):
+        """Shared scaffolding for explicitly-reduced gradient paths (qgZ,
+        sparse): shard_map *manual* over the reduce axes and auto
+        elsewhere (TP collectives stay compiler-placed)."""
+        mesh = self.topology.mesh
+        sizes = self.topology.axis_sizes
+        nred = int(np.prod([sizes[a] for a in manual]))
 
         grad_specs = self.grad_specs
         p_in = jax.tree.map(lambda s: self._restrict_spec(s, manual),
@@ -562,9 +635,15 @@ class Engine:
 
             (_, (loss, aux)), grads = jax.value_and_grad(
                 scaled_loss, has_aux=True)(cparams)
-            grads = jax.tree.map(
-                reduce_leaf, grads, grad_specs,
-                is_leaf=lambda x: isinstance(x, P))
+            batch_tokens = int(jax.tree.leaves(batch)[0].size)
+            g_flat, treedef = jax.tree.flatten(grads)
+            s_flat = jax.tree.leaves(grad_specs,
+                                     is_leaf=lambda x: isinstance(x, P))
+            from ..parallel.zero import _is_axes
+            a_flat = jax.tree.leaves(self.param_axes, is_leaf=_is_axes)
+            grads = jax.tree.unflatten(treedef, [
+                reduce_leaf(g, s, a, batch_tokens)
+                for g, s, a in zip(g_flat, s_flat, a_flat)])
             # local losses are means over the local batch shard; the
             # global mean divides the reduced sums by the rank count
             grads = jax.tree.map(lambda g: (g / nred).astype(g.dtype), grads)
@@ -572,7 +651,7 @@ class Engine:
             aux = jax.tree.map(lambda a: jax.lax.psum(a, manual) / nred, aux)
             return loss, aux, grads
 
-        def qgz_grads(cparams, batch, rng, scale):
+        def manual_grads(cparams, batch, rng, scale):
             mb_specs = jax.tree.map(lambda _: batch_spec, batch)
             return jax.shard_map(
                 local, mesh=mesh,
@@ -582,7 +661,7 @@ class Engine:
                 check_vma=False,            # shardings stay compiler-placed
             )(cparams, batch, rng, scale)
 
-        return qgz_grads
+        return manual_grads
 
     def _offload_update(self, grads, opt_state, master, step, finite):
         """ZeRO-Offload optimizer step: fp32 master + moments live in host
@@ -658,6 +737,8 @@ class Engine:
         and NVMe-offloaded train steps (gas scan = the IPG/bucketing
         analog, compiler-scheduled)."""
         qgz_grads = self._build_qgz_grads(gas) if self._qgz_axes else None
+        if qgz_grads is None and self._sparse_axes:
+            qgz_grads = self._build_sparse_grads(gas)
 
         def grads_of_microbatch(cparams, batch, rng, scale):
             if qgz_grads is not None:

@@ -1,0 +1,62 @@
+"""Sparse gradient reduction for embedding-heavy models.
+
+TPU-native analog of the reference's sparse-gradient path
+(``runtime/sparse_tensor.py`` SparseTensor, ``engine.py:2518-2587``
+``sparse_allreduce_bucket`` — embedding grads travel as (indices, values)
+instead of the dense [vocab, d] table).
+
+XLA needs static shapes, so sparsity is expressed as a fixed row
+``capacity`` per shard: each data-parallel shard picks its ``capacity``
+highest-mass rows (all nonzero rows fit whenever capacity >= tokens in
+the shard's batch — the embedding gradient touches at most one row per
+token, so the default is lossless), all-gathers only (ids, rows), and
+scatter-adds the gathered contributions into the dense result.
+
+Wire volume: ``DP * capacity * (d + 1)`` vs the dense ring-allreduce's
+``~2 * vocab * d`` — e.g. GPT-2's [50257, 768] table with an 8k-token
+shard batch moves ~8x less.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def sparse_psum(g: jax.Array, axis_name, capacity: int) -> jax.Array:
+    """Sum a row-sparse gradient over ``axis_name`` shards.
+
+    g: [V, d] (or [V]) per-shard dense gradient whose nonzero rows are
+    few; returns the dense sum, numerically identical to ``psum`` as
+    long as every shard has <= capacity nonzero rows (rows beyond the
+    capacity — lowest row mass first — are dropped, so size capacity to
+    the shard's token count)."""
+    V = g.shape[0]
+    capacity = min(int(capacity), V)
+    flat = g.reshape(V, -1)
+    mass = jnp.abs(flat).sum(axis=1)                      # [V]
+    _, ids = lax.top_k(mass, capacity)                    # [cap]
+    rows = flat[ids]                                      # [cap, d]
+    # zero-mass picks contribute zeros — harmless in the scatter-add
+    all_ids = lax.all_gather(ids, axis_name, tiled=True)  # [DP*cap]
+    all_rows = lax.all_gather(rows, axis_name, axis=0,
+                              tiled=True)                 # [DP*cap, d]
+    dense = jnp.zeros_like(flat).at[all_ids].add(all_rows)
+    return dense.reshape(g.shape)
+
+
+def is_sparse_leaf(axes) -> bool:
+    """Only 2-D vocab-leading leaves — embedding TABLES — qualify: the
+    lookup gradient touches one row per token.  1-D vocab leaves (an
+    lm_head bias) and vocab-trailing projections receive DENSE gradients
+    (every vocab entry gets softmax mass) and must reduce densely."""
+    return (isinstance(axes, tuple) and len(axes) >= 2
+            and axes[0] == "vocab")
+
+
+def default_capacity(batch_tokens: int, vocab: int) -> int:
+    """Lossless default: one gradient row per token in the shard batch."""
+    return min(vocab, max(1, batch_tokens))

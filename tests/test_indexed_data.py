@@ -1,0 +1,126 @@
+"""Indexed dataset + offline DataAnalyzer (reference analogs:
+data_sampling/indexed_dataset.py, data_analyzer.py,
+tests/unit/runtime/data_pipeline)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_analyzer import (DataAnalyzer,
+                                                 difficulty_buckets,
+                                                 samples_up_to_difficulty)
+from deepspeed_tpu.runtime.indexed_dataset import (MMapIndexedDataset,
+                                                   MMapIndexedDatasetBuilder)
+
+
+def build_corpus(prefix, n=20, seed=0, dtype=np.int32):
+    r = np.random.RandomState(seed)
+    b = MMapIndexedDatasetBuilder(prefix, dtype=dtype)
+    samples = [r.randint(0, 100, r.randint(3, 12)).astype(dtype)
+               for _ in range(n)]
+    for s in samples:
+        b.add_item(s)
+    b.finalize()
+    return samples
+
+
+class TestIndexedDataset:
+    def test_roundtrip(self, tmp_path):
+        prefix = str(tmp_path / "corpus")
+        samples = build_corpus(prefix)
+        ds = MMapIndexedDataset(prefix)
+        assert len(ds) == len(samples)
+        for i, s in enumerate(samples):
+            np.testing.assert_array_equal(ds[i], s)
+        assert ds.total_tokens == sum(len(s) for s in samples)
+
+    def test_negative_and_slice(self, tmp_path):
+        prefix = str(tmp_path / "c")
+        samples = build_corpus(prefix)
+        ds = MMapIndexedDataset(prefix)
+        np.testing.assert_array_equal(ds[-1], samples[-1])
+        got = ds[2:5]
+        for g, s in zip(got, samples[2:5]):
+            np.testing.assert_array_equal(g, s)
+
+    def test_batch_pads_and_truncates(self, tmp_path):
+        prefix = str(tmp_path / "c")
+        b = MMapIndexedDatasetBuilder(prefix)
+        b.add_item(np.array([1, 2, 3], np.int32))
+        b.add_item(np.arange(10, 30, dtype=np.int32))
+        b.finalize()
+        ds = MMapIndexedDataset(prefix)
+        out = ds.batch([0, 1], seq_len=8, pad_id=-1)
+        np.testing.assert_array_equal(out[0], [1, 2, 3, -1, -1, -1, -1, -1])
+        np.testing.assert_array_equal(out[1], np.arange(10, 18))
+
+    def test_merge_file(self, tmp_path):
+        a = str(tmp_path / "a")
+        c = str(tmp_path / "b")
+        sa = build_corpus(a, n=5, seed=1)
+        sb = build_corpus(c, n=7, seed=2)
+        m = MMapIndexedDatasetBuilder(str(tmp_path / "m"))
+        for s in sa:
+            m.add_item(s)
+        m.merge_file(c)
+        m.finalize()
+        ds = MMapIndexedDataset(str(tmp_path / "m"))
+        assert len(ds) == 12
+        np.testing.assert_array_equal(ds[5], sb[0])
+
+    def test_bad_magic_raises(self, tmp_path):
+        prefix = str(tmp_path / "x")
+        build_corpus(prefix)
+        with open(prefix + ".idx", "r+b") as f:
+            f.write(b"GARBAGE!")
+        with pytest.raises(ValueError, match="magic"):
+            MMapIndexedDataset(prefix)
+
+
+class TestDataAnalyzer:
+    def test_map_reduce_single_worker(self, tmp_path):
+        prefix = str(tmp_path / "c")
+        samples = build_corpus(prefix, n=30)
+        ds = MMapIndexedDataset(prefix)
+        out = str(tmp_path / "analysis")
+        DataAnalyzer(ds, {"length": len,
+                          "mean_tok": lambda s: float(np.mean(s))},
+                     save_path=out).run()
+        lens = np.load(os.path.join(out, "length", "sample_to_metric.npy"))
+        np.testing.assert_array_equal(lens,
+                                      [len(s) for s in samples])
+        order = np.load(os.path.join(out, "length",
+                                     "metric_sorted_samples.npy"))
+        sorted_lens = lens[order]
+        assert (np.diff(sorted_lens) >= 0).all()
+
+    def test_multi_worker_matches_single(self, tmp_path):
+        prefix = str(tmp_path / "c")
+        build_corpus(prefix, n=23)
+        ds = MMapIndexedDataset(prefix)
+        single = str(tmp_path / "s")
+        DataAnalyzer(ds, {"length": len}, save_path=single).run()
+        multi = str(tmp_path / "m")
+        for w in range(3):
+            DataAnalyzer(ds, {"length": len}, save_path=multi,
+                         num_workers=3, worker_id=w).run_map()
+        DataAnalyzer(ds, {"length": len}, save_path=multi,
+                     num_workers=3).run_reduce()
+        np.testing.assert_array_equal(
+            np.load(os.path.join(single, "length", "sample_to_metric.npy")),
+            np.load(os.path.join(multi, "length", "sample_to_metric.npy")))
+
+    def test_curriculum_consumption(self, tmp_path):
+        prefix = str(tmp_path / "c")
+        samples = build_corpus(prefix, n=40)
+        ds = MMapIndexedDataset(prefix)
+        out = str(tmp_path / "a")
+        DataAnalyzer(ds, {"length": len}, save_path=out).run()
+        easy = samples_up_to_difficulty(out, "length", max_value=6)
+        assert all(len(samples[i]) <= 6 for i in easy)
+        assert len(easy) == sum(len(s) <= 6 for s in samples)
+        buckets = difficulty_buckets(out, "length", 4)
+        assert sum(len(b) for b in buckets) == 40
+        assert max(len(samples[i]) for i in buckets[0]) <= \
+            min(len(samples[i]) for i in buckets[-1])
