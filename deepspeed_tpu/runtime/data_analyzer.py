@@ -43,7 +43,7 @@ class DataAnalyzer:
     def _shard_range(self):
         n = len(self.dataset)
         per = -(-n // self.num_workers)
-        lo = self.worker_id * per
+        lo = min(n, self.worker_id * per)      # late workers: empty shard
         return lo, min(n, lo + per)
 
     def _worker_file(self, metric: str, worker: int) -> str:

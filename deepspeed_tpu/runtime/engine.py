@@ -57,6 +57,14 @@ class TrainState(NamedTuple):
     skipped: jnp.ndarray       # i32 count of overflow-skipped steps
 
 
+class OnebitCommState(NamedTuple):
+    """Optimizer-state wrapper for 1-bit compressed communication: the
+    base optimizer's state plus the per-shard error-feedback buffers
+    (stacked over the reduce axes — each shard owns its slice)."""
+    base: Any
+    comm_err: Any
+
+
 class _StagedBatch(dict):
     """Marker: this batch is already device-placed (and, when staged with
     accumulate=True and gas>1, reshaped to [gas, micro, ...])."""
@@ -147,8 +155,46 @@ class Engine:
             self.lr_schedule = build_schedule(config.scheduler.type, sched_params)
         else:
             self.lr_schedule = constant(lr)
-        self.optimizer: Optimizer = build_optimizer(
-            opt_cfg.type, self.lr_schedule, opt_cfg.params)
+
+        # 1-bit optimizers: route the DP gradient reduction through the
+        # packed sign+scale collective with error feedback (reference:
+        # compressed_allreduce nccl.py:16; up to 5x/32x comm reduction,
+        # docs/_tutorials/onebit-adam.md:2)
+        self._onebit_axes: Tuple[str, ...] = ()
+        if ("onebit" in opt_cfg.type.lower()
+                or "zeroone" in opt_cfg.type.lower()) \
+                and self._nvme is None and not self._qgz_axes \
+                and not self._sparse_axes \
+                and not getattr(self, "offload_active", False):
+            self._onebit_axes = self._manual_reduce_axes(
+                "onebit compressed communication")
+        self._onebit_freeze = 0
+        if self._onebit_axes:
+            # exact (uncompressed) reduction through the warmup, like the
+            # reference's pre-freeze allreduce
+            self._onebit_freeze = int(opt_cfg.params.get(
+                "freeze_step", opt_cfg.params.get("var_freeze_step", 100)))
+            self._onebit_b1 = float(
+                opt_cfg.params.get("betas", (0.9, 0.999))[0])
+            # the wire carries the compression now — the in-optimizer
+            # momentum compression would compound the noise
+            base_opt = build_optimizer(
+                opt_cfg.type, self.lr_schedule,
+                {**opt_cfg.params, "compress": False})
+            W = int(np.prod([self.topology.axis_sizes[a]
+                             for a in self._onebit_axes]))
+
+            def ob_init(master, _base=base_opt, _w=W):
+                return OnebitCommState(
+                    base=_base.init(master),
+                    comm_err=jax.tree.map(
+                        lambda p: jnp.zeros((_w,) + p.shape, jnp.float32),
+                        master))
+
+            self.optimizer = Optimizer(ob_init, base_opt.update)
+        else:
+            self.optimizer: Optimizer = build_optimizer(
+                opt_cfg.type, self.lr_schedule, opt_cfg.params)
 
         # state init (sharded via jit out_shardings → no host-side gather)
         self.state = self._init_state(params)
@@ -168,6 +214,7 @@ class Engine:
                 monitor = None
         self.monitor = monitor
         self._train_step_fn = None
+        self._warmup_step_fn = None
         self._eval_step_fn = None
         self._nvme_step_fn = None
 
@@ -280,6 +327,13 @@ class Engine:
         master_def = jax.tree.structure(master)
 
         def rec(node):
+            if isinstance(node, OnebitCommState):
+                err_sh = jax.tree.map(
+                    lambda _: NamedSharding(
+                        self.topology.mesh, P(self._onebit_axes)),
+                    node.comm_err)
+                return OnebitCommState(base=rec(node.base),
+                                       comm_err=err_sh)
             if jax.tree.structure(node) == master_def:
                 return self.master_shardings
             if isinstance(node, tuple) and hasattr(node, "_fields"):
@@ -581,6 +635,7 @@ class Engine:
         from .sparse_grads import is_sparse_leaf, sparse_psum
 
         manual = self._sparse_axes
+        sizes = self.topology.axis_sizes
 
         def reduce_leaf(g, spec, axes, batch_tokens):
             ents = list(spec) + [None] * (g.ndim - len(list(spec)))
@@ -597,18 +652,77 @@ class Engine:
             rest = tuple(a for a in manual if a not in seen)
             if rest:
                 if is_sparse_leaf(axes):
-                    g = sparse_psum(g, rest,
-                                    capacity=min(g.shape[0], batch_tokens))
+                    # a preceding psum_scatter (stage-2 fsdp grad layout)
+                    # merged rows from every scattered peer into the
+                    # local vocab slice — the lossless capacity is one
+                    # row per token across ALL merged shards
+                    merged = int(np.prod([sizes[a] for a in seen])) \
+                        if seen else 1
+                    g = sparse_psum(
+                        g, rest,
+                        capacity=min(g.shape[0], batch_tokens * merged))
                 else:
                     g = jax.lax.psum(g, rest)
             return g
 
         return self._build_manual_grads(gas, manual, reduce_leaf)
 
+    def _build_local_grads(self, gas: int):
+        """UNREDUCED per-shard gradients, stacked on a leading reduce-axes
+        dim — the front half of the 1-bit compressed-communication step
+        (the actual packed reduce happens once per step on the
+        accumulated gradient, see ``_onebit_reduce``)."""
+        manual = self._onebit_axes
+
+        def reduce_leaf(g, spec, axes, batch_tokens):
+            return g[None]                       # stack; no collective
+
+        return self._build_manual_grads(gas, manual, reduce_leaf,
+                                        stacked=True)
+
+    def _onebit_reduce(self, grads_stacked, err, m_prev, b1, denom):
+        """The reference 1-bit step at the wire: each shard forms its
+        LOCAL momentum ``b1*m + (1-b1)*g_local``, sends sign bits + one
+        scale (error feedback local), and the mean of the per-shard
+        reconstructions is the new global momentum
+        (reference: OnebitAdam.step adam.py:198 + compressed_allreduce).
+
+        Returns (pseudo_grads, new_err): feeding
+        ``(m_hat - b1*m_prev)/(1-b1)`` to the uncompressed-momentum
+        optimizer makes its ``m`` land exactly on ``m_hat``."""
+        from ..ops.quant import onebit_all_reduce
+
+        manual = self._onebit_axes
+        mesh = self.topology.mesh
+        spec_in = jax.tree.map(lambda _: P(manual), grads_stacked)
+        rep = jax.tree.map(lambda _: P(), grads_stacked)
+
+        def local(gs, es, ms):
+            def one(g, e, m):
+                m_loc = b1 * m + (1 - b1) * (g[0].astype(jnp.float32)
+                                             / denom)
+                return onebit_all_reduce(m_loc, manual, e[0])
+            outs = jax.tree.map(one, gs, es, ms)
+            m_hat = jax.tree.map(lambda o: o[0], outs,
+                                 is_leaf=lambda x: isinstance(x, tuple))
+            e_new = jax.tree.map(lambda o: o[1][None], outs,
+                                 is_leaf=lambda x: isinstance(x, tuple))
+            return m_hat, e_new
+
+        m_hat, new_err = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(spec_in, spec_in, rep),
+            out_specs=(rep, spec_in),
+            axis_names=set(manual),
+            check_vma=False)(grads_stacked, err, m_prev)
+        pseudo = jax.tree.map(lambda mh, m: (mh - b1 * m) / (1 - b1),
+                              m_hat, m_prev)
+        return pseudo, new_err
+
     def _build_manual_grads(self, gas: int, manual: Tuple[str, ...],
-                            reduce_leaf):
+                            reduce_leaf, stacked: bool = False):
         """Shared scaffolding for explicitly-reduced gradient paths (qgZ,
-        sparse): shard_map *manual* over the reduce axes and auto
+        sparse, 1-bit): shard_map *manual* over the reduce axes and auto
         elsewhere (TP collectives stay compiler-placed)."""
         mesh = self.topology.mesh
         sizes = self.topology.axis_sizes
@@ -618,8 +732,16 @@ class Engine:
         p_in = jax.tree.map(lambda s: self._restrict_spec(s, manual),
                             self.param_specs,
                             is_leaf=lambda x: isinstance(x, P))
-        g_out = jax.tree.map(lambda s: self._restrict_spec(s, manual),
-                             grad_specs, is_leaf=lambda x: isinstance(x, P))
+        if stacked:
+            # leading dim = the reduce-axes product; no manual axes on
+            # the unreduced leaf dims (every shard keeps its full local
+            # gradient)
+            g_out = jax.tree.map(lambda s: P(manual), grad_specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        else:
+            g_out = jax.tree.map(
+                lambda s: self._restrict_spec(s, manual),
+                grad_specs, is_leaf=lambda x: isinstance(x, P))
         batch_spec = P(self._restrict_spec(
             P((DATA_AXIS, FSDP_AXIS)), manual)[0])
 
@@ -644,9 +766,11 @@ class Engine:
             grads = jax.tree.unflatten(treedef, [
                 reduce_leaf(g, s, a, batch_tokens)
                 for g, s, a in zip(g_flat, s_flat, a_flat)])
-            # local losses are means over the local batch shard; the
-            # global mean divides the reduced sums by the rank count
-            grads = jax.tree.map(lambda g: (g / nred).astype(g.dtype), grads)
+            if not stacked:
+                # local losses are means over the local batch shard; the
+                # global mean divides the reduced sums by the rank count
+                grads = jax.tree.map(
+                    lambda g: (g / nred).astype(g.dtype), grads)
             loss = jax.lax.psum(loss, manual) / nred
             aux = jax.tree.map(lambda a: jax.lax.psum(a, manual) / nred, aux)
             return loss, aux, grads
@@ -739,6 +863,9 @@ class Engine:
         qgz_grads = self._build_qgz_grads(gas) if self._qgz_axes else None
         if qgz_grads is None and self._sparse_axes:
             qgz_grads = self._build_sparse_grads(gas)
+        stacked = bool(self._onebit_axes)
+        if qgz_grads is None and stacked:
+            qgz_grads = self._build_local_grads(gas)
 
         def grads_of_microbatch(cparams, batch, rng, scale):
             if qgz_grads is not None:
@@ -751,11 +878,18 @@ class Engine:
                 scaled_loss, has_aux=True)(cparams)
             return loss, aux, grads
 
+        if stacked:
+            acc_specs = jax.tree.map(
+                lambda _: P(self._onebit_axes), self.grad_specs,
+                is_leaf=lambda x: isinstance(x, P))
+        else:
+            acc_specs = self.grad_specs
+
         def shard_grads(g):
             return jax.tree.map(
                 lambda t, spec: jax.lax.with_sharding_constraint(
                     t, NamedSharding(self.topology.mesh, spec)),
-                g, self.grad_specs)
+                g, acc_specs)
 
         def pipeline(cparams, batch, rng, scale):
             if gas > 1:
@@ -771,11 +905,15 @@ class Engine:
                     acc_g = jax.tree.map(jnp.add, acc_g, g)
                     return (acc_g, acc_loss + loss), aux
 
+                W = int(np.prod([self.topology.axis_sizes[a]
+                                 for a in self._onebit_axes])) \
+                    if stacked else 1
                 zero_g = jax.tree.map(
                     lambda p, spec: jax.lax.with_sharding_constraint(
-                        jnp.zeros(np.shape(p), jnp.float32),
+                        jnp.zeros(((W,) if stacked else ())
+                                  + tuple(np.shape(p)), jnp.float32),
                         NamedSharding(self.topology.mesh, spec)),
-                    cparams, self.grad_specs)
+                    cparams, acc_specs)
                 rngs = jax.random.split(rng, gas)
                 (grads, loss_sum), aux = jax.lax.scan(
                     body, (zero_g, jnp.float32(0.0)), (batch, rngs))
@@ -806,7 +944,7 @@ class Engine:
             return grads, finite, gnorm
         return epilogue
 
-    def _build_train_step(self):
+    def _build_train_step(self, onebit_compress: bool = True):
         gas = self.gas
         scaler = self.scaler
         use_scaling = self.precision == "fp16"
@@ -814,11 +952,49 @@ class Engine:
         pipeline = self._build_grad_pipeline(gas)
         epilogue = self._build_grad_epilogue()
 
+        onebit = bool(self._onebit_axes)
+        opt_update = self.optimizer.update
+        if onebit:
+            # phase-aligned optimizer: the engine switches host-side on
+            # global_steps, but the optimizer's own frozen flag counts
+            # only APPLIED steps (state.step) — under fp16 overflow skips
+            # the two drift apart.  Pin the optimizer to this compiled
+            # step's phase instead of its step counter.
+            opt_cfg = self.config.optimizer
+            key = ("var_freeze_step" if "zeroone" in opt_cfg.type.lower()
+                   else "freeze_step")
+            phase_params = {**opt_cfg.params, "compress": False,
+                            key: -1 if onebit_compress else (1 << 30)}
+            from .optimizers import build_optimizer
+            opt_update = build_optimizer(
+                opt_cfg.type, self.lr_schedule, phase_params).update
+
         def train_step(state: TrainState, batch, rng):
             scale = state.loss_scale.scale if use_scaling else jnp.float32(1.0)
             cparams = self._compute_params(state.master)
             loss, aux, grads = pipeline(cparams, batch, rng, scale)
-            grads, finite, gnorm = epilogue(grads, scale)
+            opt_in = state.opt_state
+            if onebit:
+                # packed 1-bit momentum reduce with error feedback,
+                # threaded through the opt state.  During warmup
+                # (reference: exact allreduce until freeze_step) the
+                # mean is exact and EF stays zero.
+                err = opt_in.comm_err
+                opt_in = opt_in.base
+                if onebit_compress:
+                    # loss-scale unscaling happens inside the reduce; the
+                    # epilogue (called with scale=1) still applies the
+                    # predivide factor exactly once
+                    grads, new_err = self._onebit_reduce(
+                        grads, err, opt_in.m, self._onebit_b1, scale)
+                    grads, finite, gnorm = epilogue(grads,
+                                                    jnp.float32(1.0))
+                else:
+                    grads = jax.tree.map(lambda g: g.mean(axis=0), grads)
+                    new_err = err
+                    grads, finite, gnorm = epilogue(grads, scale)
+            else:
+                grads, finite, gnorm = epilogue(grads, scale)
 
             # overflow → skip update (jnp.where keeps shapes static)
             def sel(new, old):
@@ -830,17 +1006,20 @@ class Engine:
             step_next = state.step + 1
 
             def update_master(grads, opt_state, master):
-                updates, new_opt = self.optimizer.update(
+                updates, new_opt = opt_update(
                     grads, opt_state, master, step_next)
                 new_master = jax.tree.map(lambda p, u: p + u, master, updates)
                 return sel(new_master, master), sel(new_opt, opt_state)
 
             if offloaded:
                 new_master, new_opt = self._offload_update(
-                    grads, state.opt_state, state.master, step_next, finite)
+                    grads, opt_in, state.master, step_next, finite)
             else:
                 new_master, new_opt = update_master(
-                    grads, state.opt_state, state.master)
+                    grads, opt_in, state.master)
+            if onebit:
+                new_opt = OnebitCommState(
+                    base=new_opt, comm_err=sel(new_err, err))
             new_step = jnp.where(finite, step_next, state.step)
             new_scale_state = scaler.update(state.loss_scale, ~finite)
             new_skipped = state.skipped + jnp.where(finite, 0, 1)
@@ -964,22 +1143,35 @@ class Engine:
             rng = jax.random.PRNGKey(self.config.seed + self.global_steps)
         if self._nvme is not None:
             return self._train_batch_nvme(batch, rng)
-        if self._train_step_fn is None:
-            self._train_step_fn = self._build_train_step()
+        step_fn = self._pick_train_step()
         batch = self.shard_batch(batch)
         self.tput.start()
         try:
-            self.state, metrics = self._train_step_fn(self.state, batch, rng)
+            self.state, metrics = step_fn(self.state, batch, rng)
         except jax.errors.JaxRuntimeError as e:
             # only the *first* execution may fall back — a later failure is
             # a genuine runtime error, not a backend capability gap
             if not self.offload_active or self._offload_validated:
                 raise
             self._disable_offload(e)
-            self._train_step_fn = self._build_train_step()
-            self.state, metrics = self._train_step_fn(self.state, batch, rng)
+            self._train_step_fn = self._warmup_step_fn = None
+            step_fn = self._pick_train_step()
+            self.state, metrics = step_fn(self.state, batch, rng)
         self._offload_validated = True
         return self._finish_step(batch, rng, metrics)
+
+    def _pick_train_step(self):
+        """Standard jitted step, or — for 1-bit optimizers — the exact
+        warmup step until ``freeze_step`` optimizer updates have run
+        (reference: uncompressed allreduce during warmup, adam.py)."""
+        if self._onebit_axes and self.global_steps < self._onebit_freeze:
+            if self._warmup_step_fn is None:
+                self._warmup_step_fn = self._build_train_step(
+                    onebit_compress=False)
+            return self._warmup_step_fn
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        return self._train_step_fn
 
     def _finish_step(self, batch, rng, metrics) -> Dict[str, Any]:
         self.global_steps += 1
@@ -1084,6 +1276,7 @@ class Engine:
             skipped=self.state.skipped)
         # drop every jit compiled against the host-placed shardings
         self._train_step_fn = None
+        self._warmup_step_fn = None
         self._eval_step_fn = None
         self._nvme_step_fn = None
         if hasattr(self, "_compute_params_fn"):

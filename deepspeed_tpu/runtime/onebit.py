@@ -52,8 +52,14 @@ class OnebitAdamState(NamedTuple):
 
 def onebit_adam(lr, betas=(0.9, 0.999), eps: float = 1e-8,
                 weight_decay: float = 0.0,
-                freeze_step: int = 100) -> Optimizer:
-    """(reference: runtime/fp16/onebit/adam.py OnebitAdam)."""
+                freeze_step: int = 100,
+                compress: bool = True) -> Optimizer:
+    """(reference: runtime/fp16/onebit/adam.py OnebitAdam).
+
+    ``compress=False`` keeps the frozen-variance Adam math but skips the
+    in-optimizer momentum compression — used when the ENGINE already
+    compresses the gradient reduction on the wire
+    (``Engine._onebit_reduce``): compressing twice compounds the noise."""
     b1, b2 = betas
     lr_fn = lr if callable(lr) else (lambda _: jnp.asarray(lr, jnp.float32))
 
@@ -70,10 +76,13 @@ def onebit_adam(lr, betas=(0.9, 0.999), eps: float = 1e-8,
         def upd(g, m, v, e, p):
             g32 = g.astype(jnp.float32)
             m_exact = b1 * m + (1 - b1) * g32
-            # compressed path: compress the new momentum w/ error feedback
-            m_comp, e_new = _compress_1bit(m_exact, e)
-            m_ = jnp.where(frozen, m_comp, m_exact)
-            e_ = jnp.where(frozen, e_new, e)
+            if compress:
+                # compress the new momentum w/ error feedback
+                m_comp, e_new = _compress_1bit(m_exact, e)
+                m_ = jnp.where(frozen, m_comp, m_exact)
+                e_ = jnp.where(frozen, e_new, e)
+            else:
+                m_, e_ = m_exact, e
             v_ = jnp.where(frozen, v, b2 * v + (1 - b2) * (g32 * g32))
             # bias correction only during warmup: the reference's frozen
             # phase is uncorrected exp_avg/(sqrt(exp_avg_sq)+eps)
@@ -103,7 +112,8 @@ def zero_one_adam(lr, betas=(0.9, 0.999), eps: float = 1e-8,
                   var_freeze_step: int = 100,
                   var_update_scaler: int = 16,
                   local_step_scaler: int = 32768,
-                  local_step_clipper: int = 16) -> Optimizer:
+                  local_step_clipper: int = 16,
+                  compress: bool = True) -> Optimizer:
     """0/1 Adam (reference: runtime/fp16/onebit/zoadam.py ZeroOneAdam):
     variance refreshes on an exponentially-spaced interval — the k-th
     refresh happens at step ``var_update_scaler * 2^k`` with the exponent
@@ -137,9 +147,12 @@ def zero_one_adam(lr, betas=(0.9, 0.999), eps: float = 1e-8,
         def upd(g, m, v, e, p):
             g32 = g.astype(jnp.float32)
             m_exact = b1 * m + (1 - b1) * g32
-            m_comp, e_new = _compress_1bit(m_exact, e)
-            m_ = jnp.where(refresh, m_exact, m_comp)
-            e_ = jnp.where(refresh, e, e_new)
+            if compress:
+                m_comp, e_new = _compress_1bit(m_exact, e)
+                m_ = jnp.where(refresh, m_exact, m_comp)
+                e_ = jnp.where(refresh, e, e_new)
+            else:
+                m_, e_ = m_exact, e
             v_ = jnp.where(refresh, b2 * v + (1 - b2) * (g32 * g32), v)
             # deliberate deviation from the uncorrected reference update:
             # always-on bias correction decays smoothly to 1, avoiding
@@ -162,7 +175,8 @@ def zero_one_adam(lr, betas=(0.9, 0.999), eps: float = 1e-8,
 
 def onebit_lamb(lr, betas=(0.9, 0.999), eps: float = 1e-6,
                 weight_decay: float = 0.0, freeze_step: int = 100,
-                min_trust: float = 0.01, max_trust: float = 10.0) -> Optimizer:
+                min_trust: float = 0.01, max_trust: float = 10.0,
+                compress: bool = True) -> Optimizer:
     """(reference: runtime/fp16/onebit/lamb.py OnebitLamb — compressed
     momentum + per-tensor trust ratio after freeze)."""
     b1, b2 = betas
@@ -182,9 +196,12 @@ def onebit_lamb(lr, betas=(0.9, 0.999), eps: float = 1e-6,
             g32 = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
             m_exact = b1 * m + (1 - b1) * g32
-            m_comp, e_new = _compress_1bit(m_exact, e)
-            m_ = jnp.where(frozen, m_comp, m_exact)
-            e_ = jnp.where(frozen, e_new, e)
+            if compress:
+                m_comp, e_new = _compress_1bit(m_exact, e)
+                m_ = jnp.where(frozen, m_comp, m_exact)
+                e_ = jnp.where(frozen, e_new, e)
+            else:
+                m_, e_ = m_exact, e
             v_ = jnp.where(frozen, v, b2 * v + (1 - b2) * (g32 * g32))
             # uncorrected after freeze, matching the reference (see
             # onebit_adam)

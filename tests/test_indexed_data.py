@@ -124,3 +124,18 @@ class TestDataAnalyzer:
         assert sum(len(b) for b in buckets) == 40
         assert max(len(samples[i]) for i in buckets[0]) <= \
             min(len(samples[i]) for i in buckets[-1])
+
+
+def test_more_workers_than_samples(tmp_path):
+    """Late workers get empty shards instead of crashing."""
+    prefix = str(tmp_path / "c")
+    build_corpus(prefix, n=5)
+    ds = MMapIndexedDataset(prefix)
+    out = str(tmp_path / "a")
+    for w in range(4):
+        DataAnalyzer(ds, {"length": len}, save_path=out,
+                     num_workers=4, worker_id=w).run_map()
+    DataAnalyzer(ds, {"length": len}, save_path=out,
+                 num_workers=4).run_reduce()
+    vals = np.load(os.path.join(out, "length", "sample_to_metric.npy"))
+    assert len(vals) == 5 and np.isfinite(vals).all()

@@ -77,3 +77,61 @@ class TestOnebitOptimizers:
             make_batch(eng.train_batch_size, seed=i))["loss"])
             for i in range(6)]
         assert losses[-1] < losses[0]
+
+
+class TestCompressedCommunication:
+    """The DP gradient reduction of the 1-bit family rides the packed
+    sign+scale collective with error feedback (reference: nccl.py:16
+    compressed_allreduce; onebit-adam.md 5x comm claim)."""
+
+    def test_engine_enables_onebit_comm(self):
+        p, ax, loss_fn = make_mlp()
+        eng = ds.initialize(loss_fn=loss_fn, params=p, param_axes=ax,
+                            config={
+            "train_micro_batch_size_per_device": 2,
+            "optimizer": {"type": "OnebitAdam",
+                          "params": {"lr": 1e-2, "freeze_step": 2}},
+            "mesh": {"data": 8}, "steps_per_print": 1000})
+        assert eng._onebit_axes == ("data",)
+        from deepspeed_tpu.runtime.engine import OnebitCommState
+        assert isinstance(eng.state.opt_state, OnebitCommState)
+        err0 = jax.tree.leaves(eng.state.opt_state.comm_err)[0]
+        assert err0.shape[0] == 8                 # per-shard EF buffers
+
+    def test_training_converges_and_err_updates(self):
+        p, ax, loss_fn = make_mlp()
+        eng = ds.initialize(loss_fn=loss_fn, params=p, param_axes=ax,
+                            config={
+            "train_micro_batch_size_per_device": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "OnebitAdam",
+                          "params": {"lr": 5e-3, "freeze_step": 8}},
+            "mesh": {"data": 4, "fsdp": 2}, "steps_per_print": 1000})
+        assert set(eng._onebit_axes) == {"data", "fsdp"}
+        losses = [float(eng.train_batch(
+            make_batch(eng.train_batch_size, seed=i))["loss"])
+            for i in range(16)]
+        # warmup (exact) + compressed phase both improve the loss
+        assert losses[-1] < 0.5 * losses[0]
+        err = jax.tree.leaves(eng.state.opt_state.comm_err)[0]
+        assert float(jnp.abs(err).sum()) > 0      # EF actually in use
+
+    def test_checkpoint_roundtrip_with_comm_state(self):
+        import tempfile
+        p, ax, loss_fn = make_mlp()
+        cfg = {"train_micro_batch_size_per_device": 2,
+               "optimizer": {"type": "OnebitAdam",
+                             "params": {"lr": 1e-2, "freeze_step": 2}},
+               "mesh": {"data": 8}, "steps_per_print": 1000}
+        eng = ds.initialize(loss_fn=loss_fn, params=p, param_axes=ax,
+                            config=cfg)
+        for i in range(3):
+            eng.train_batch(make_batch(eng.train_batch_size, seed=i))
+        d = tempfile.mkdtemp()
+        eng.save_checkpoint(d)
+        eng2 = ds.initialize(loss_fn=loss_fn, params=p, param_axes=ax,
+                             config=cfg)
+        eng2.load_checkpoint(d)
+        a = jax.tree.leaves(eng.state.opt_state.comm_err)[0]
+        b = jax.tree.leaves(eng2.state.opt_state.comm_err)[0]
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))

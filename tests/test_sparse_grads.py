@@ -99,3 +99,26 @@ class TestEngineSparseGradients:
     def test_head_bias_leaf_not_sparse(self):
         # a 1-D vocab leaf (lm_head bias) receives DENSE gradients
         assert not is_sparse_leaf(("vocab",))
+
+    def test_matches_dense_under_stage2_fsdp(self):
+        """Stage-2 + fsdp reduce-scatters the table grad first; the
+        capacity must cover rows merged from every scattered peer."""
+        from deepspeed_tpu.models import build_model
+
+        m = build_model("llama-tiny", vocab_size=512, num_layers=2,
+                        d_model=32, num_heads=4, num_kv_heads=2, d_ff=64,
+                        max_seq_len=16, seed=0)
+        ids = np.random.RandomState(0).randint(0, 512, (16, 16))
+        losses = {}
+        for sparse in (False, True):
+            eng = ds.initialize(model=m, config={
+                "train_micro_batch_size_per_device": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "sparse_gradients": sparse,
+                "zero_optimization": {"stage": 2},
+                "mesh": {"data": 2, "fsdp": 4}, "steps_per_print": 1000})
+            losses[sparse] = [
+                float(eng.train_batch({"input_ids": ids})["loss"])
+                for _ in range(4)]
+        np.testing.assert_allclose(losses[True], losses[False],
+                                   rtol=2e-4, atol=2e-4)
