@@ -90,9 +90,9 @@ class InferenceEngine:
         self._quant = None
         if self.icfg.weight_quant:
             from .quantization import quantize_model_params
-            bits = {"int8": 8, "int4": 4}[self.icfg.weight_quant]
+            from ..ops.quant import WEIGHT_QUANT_BITS
             self.params, self._quant = quantize_model_params(
-                self.params, bits=bits,
+                self.params, bits=WEIGHT_QUANT_BITS[self.icfg.weight_quant],
                 quantize_embeddings=self.icfg.quantize_embeddings)
         if self.icfg.kv_offload:
             self._offload_kv()
@@ -113,9 +113,9 @@ class InferenceEngine:
             if x.dtype == jnp.float32 else x, params)
         if self.icfg.weight_quant:
             from .quantization import quantize_model_params
-            bits = {"int8": 8, "int4": 4}[self.icfg.weight_quant]
+            from ..ops.quant import WEIGHT_QUANT_BITS
             self.params, self._quant = quantize_model_params(
-                self.params, bits=bits,
+                self.params, bits=WEIGHT_QUANT_BITS[self.icfg.weight_quant],
                 quantize_embeddings=self.icfg.quantize_embeddings)
             self._step_fn = None        # closure holds the old quant tree
 

@@ -108,9 +108,10 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
     so device memory holds a single layer's KV at a time.
     """
     if quant is not None:
-        from .quantization import dequantize, merge_layer
+        from .quantization import merge_layer
+        from ..ops.quant import dequantize_any
     if quant is not None and "embed" in quant:
-        embed_tab = {"table": dequantize(quant["embed"]["table"])}
+        embed_tab = {"table": dequantize_any(quant["embed"]["table"])}
         dt = embed_tab["table"].dtype
     else:
         embed_tab = params["embed"]

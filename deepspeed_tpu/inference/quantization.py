@@ -30,7 +30,9 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.quant import QuantizedTensor, default_groups, dequantize, quantize
+from ..ops.quant import (MINIFLOAT_BY_BITS, QuantizedTensor,
+                         default_groups, dequantize_any,
+                         minifloat_quantize, quantize)
 
 # weights eligible for quantization inside a block (2D+ matmul operands)
 _BLOCK_WEIGHTS = ("wq", "wk", "wv", "wo", "wi", "wg")
@@ -39,10 +41,16 @@ _BLOCK_WEIGHTS = ("wq", "wk", "wv", "wo", "wi", "wg")
 def _quantize_stacked(w: jax.Array, bits: int) -> QuantizedTensor:
     """Quantize a [L, ...] stacked weight layer-by-layer (eager, at
     engine build), so a single layer can be dequantized without touching
-    the others."""
+    the others.  bits 4/8 = grouped int; 6/12 = emulated minifloat
+    (reference: csrc/fp_quantizer FP6/FP12)."""
     groups = default_groups(w[0].size)
-    qts = [quantize(w[i], bits=bits, num_groups=groups)
-           for i in range(w.shape[0])]
+    if bits in MINIFLOAT_BY_BITS:
+        fmt = MINIFLOAT_BY_BITS[bits]
+        qts = [minifloat_quantize(w[i], fmt=fmt, num_groups=groups)
+               for i in range(w.shape[0])]
+    else:
+        qts = [quantize(w[i], bits=bits, num_groups=groups)
+               for i in range(w.shape[0])]
     return QuantizedTensor(
         data=jnp.stack([q.data for q in qts]),
         scale=jnp.stack([q.scale for q in qts]),
@@ -56,7 +64,7 @@ def layer_weight(qt: QuantizedTensor, i, dt) -> jax.Array:
     row = QuantizedTensor(qt.data[i], qt.scale[i],
                           None if qt.zero is None else qt.zero[i],
                           qt.bits, qt.shape[1:], qt.dtype)
-    return dequantize(row, dt)
+    return dequantize_any(row, dt)
 
 
 def quantize_model_params(params: Dict[str, Any], bits: int = 8,
@@ -85,7 +93,11 @@ def quantize_model_params(params: Dict[str, Any], bits: int = 8,
 
     if quantize_embeddings:
         tab = dense["embed"]["table"]
-        quant["embed"] = {"table": quantize(tab, bits=bits)}
+        if bits in MINIFLOAT_BY_BITS:
+            quant["embed"] = {"table": minifloat_quantize(
+                tab, fmt=MINIFLOAT_BY_BITS[bits])}
+        else:
+            quant["embed"] = {"table": quantize(tab, bits=bits)}
         del dense["embed"]["table"]
     return dense, quant
 

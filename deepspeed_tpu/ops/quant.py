@@ -302,3 +302,114 @@ def onebit_all_reduce(x: jax.Array, axis_name, err: jax.Array
     signs = jax.vmap(unpack_signs)(all_packed)             # [W, n]
     mean = (signs * all_scale[:, None]).mean(axis=0)
     return mean[:n].reshape(shape).astype(dtype), new_err
+
+
+# --------------------------------------------------------------------------
+# Emulated minifloat formats + selective dequantize (reference:
+# csrc/fp_quantizer — FP6 e3m2 / FP12 quantize + selective_dequantize used
+# to expand only the rows a step touches, e.g. routed MoE experts)
+# --------------------------------------------------------------------------
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _minifloat_table(exp_bits: int, man_bits: int) -> np.ndarray:
+    """All non-negative representable values of a (1, e, m) minifloat
+    with IEEE-style subnormals, ascending."""
+    bias = (1 << (exp_bits - 1)) - 1
+    vals = []
+    for e in range(1 << exp_bits):
+        for m in range(1 << man_bits):
+            if e == 0:
+                v = (m / (1 << man_bits)) * 2.0 ** (1 - bias)
+            else:
+                v = (1 + m / (1 << man_bits)) * 2.0 ** (e - bias)
+            vals.append(v)
+    return np.asarray(vals, np.float32)
+
+
+_MINIFLOAT_FORMATS = {
+    # name: (exp_bits, man_bits, container dtype)
+    "fp6_e3m2": (3, 2, jnp.int8),
+    "fp12_e4m7": (4, 7, jnp.int16),
+}
+
+# the single source of truth for weight-quant format names (serving
+# config strings), bit widths, and minifloat format ids
+WEIGHT_QUANT_BITS = {"int8": 8, "int4": 4, "fp6": 6, "fp12": 12}
+MINIFLOAT_BY_BITS = {6: "fp6_e3m2", 12: "fp12_e4m7"}
+
+
+def dequantize_any(qt: "QuantizedTensor", dtype=None) -> jax.Array:
+    """Dispatch on bit width: grouped-int (4/8) vs minifloat (6/12)."""
+    if qt.bits in MINIFLOAT_BY_BITS:
+        return minifloat_dequantize(qt, dtype)
+    return dequantize(qt, dtype)
+
+
+def minifloat_quantize(x: jax.Array, fmt: str = "fp6_e3m2",
+                       num_groups: Optional[int] = None) -> QuantizedTensor:
+    """Emulated FP6/FP12 grouped quantization: per-group scale onto the
+    format's dynamic range, then nearest representable value; codes are
+    stored in the smallest integer container (1 byte for fp6, 2 for
+    fp12 — the reference packs 6-bit lanes the same way on GPUs without
+    native types)."""
+    if fmt not in _MINIFLOAT_FORMATS:
+        raise ValueError(f"unknown minifloat format {fmt!r}; "
+                         f"known: {sorted(_MINIFLOAT_FORMATS)}")
+    eb, mb, container = _MINIFLOAT_FORMATS[fmt]
+    table = _minifloat_table(eb, mb)
+    fmax = float(table[-1])
+    orig_shape, orig_dtype = tuple(x.shape), x.dtype
+    if num_groups is None:
+        num_groups = default_groups(x.size)
+    g = _group(x.astype(jnp.float32), num_groups)
+    scale = jnp.max(jnp.abs(g), axis=1, keepdims=True) / fmax
+    scale = jnp.where(scale == 0, 1.0, scale)
+    t = g / scale
+    mags = jnp.abs(t)
+    tab = jnp.asarray(table)
+    # nearest representable: searchsorted against midpoints
+    mids = jnp.asarray((table[1:] + table[:-1]) / 2.0)
+    code = jnp.searchsorted(mids, mags).astype(jnp.int32)
+    signed = jnp.where(t < 0, -code - 1, code)     # sign folded into code
+    qt = QuantizedTensor(signed.astype(container), scale, None,
+                         eb + mb + 1, orig_shape, orig_dtype)
+    return qt
+
+
+def minifloat_dequantize(qt: QuantizedTensor, dtype=None) -> jax.Array:
+    fmt = MINIFLOAT_BY_BITS[qt.bits]
+    eb, mb, _ = _MINIFLOAT_FORMATS[fmt]
+    tab = jnp.asarray(_minifloat_table(eb, mb))
+    code = qt.data.astype(jnp.int32)
+    mag = tab[jnp.where(code < 0, -code - 1, code)]
+    val = jnp.where(code < 0, -mag, mag) * qt.scale
+    return val.reshape(qt.shape).astype(dtype or qt.dtype)
+
+
+def selective_dequantize(qt: QuantizedTensor, rows: jax.Array,
+                         dtype=None) -> jax.Array:
+    """Dequantize only the selected first-dim rows of a grouped
+    QuantizedTensor (reference: selective_dequantize fp_quantizer — the
+    MoE path expands just the routed experts' weights).
+
+    Requires the grouping to not straddle rows (row size a multiple of
+    the group size), which ``default_groups`` guarantees whenever the
+    first dim divides the group count."""
+    n_rows = qt.shape[0]
+    G = qt.data.shape[0]
+    if G % n_rows:
+        raise ValueError(
+            f"groups ({G}) must align with rows ({n_rows}) for "
+            "selective dequantize; quantize with num_groups a multiple "
+            "of the first dim")
+    gpr = G // n_rows                       # groups per row
+    rows = jnp.asarray(rows, jnp.int32)
+    gidx = (rows[:, None] * gpr + jnp.arange(gpr)[None, :]).reshape(-1)
+    sub = QuantizedTensor(
+        qt.data[gidx], qt.scale[gidx],
+        None if qt.zero is None else qt.zero[gidx],
+        qt.bits, (int(rows.shape[0]),) + tuple(qt.shape[1:]), qt.dtype)
+    return dequantize_any(sub, dtype)

@@ -239,3 +239,57 @@ class TestOnebitAllReduce:
                                    atol=1e-6)
         # EF makes the long-run average track the exact mean
         np.testing.assert_allclose(acc / steps, true_mean, atol=0.05)
+
+
+class TestMinifloatAndSelective:
+    """(reference: csrc/fp_quantizer FP6/FP12 + selective_dequantize)."""
+
+    @pytest.mark.parametrize("fmt,tol", [("fp6_e3m2", 0.15),
+                                         ("fp12_e4m7", 0.005)])
+    def test_roundtrip_error_bounded(self, fmt, tol):
+        from deepspeed_tpu.ops.quant import (minifloat_dequantize,
+                                             minifloat_quantize)
+        x = jnp.asarray(np.random.RandomState(0).randn(64, 64), jnp.float32)
+        qt = minifloat_quantize(x, fmt=fmt)
+        y = minifloat_dequantize(qt)
+        err = np.abs(np.asarray(y) - np.asarray(x)).max()
+        assert err < tol * np.abs(np.asarray(x)).max(), err
+
+    def test_fp6_container_byte_sizes(self):
+        from deepspeed_tpu.ops.quant import minifloat_quantize
+        x = jnp.ones((64, 64))
+        q6 = minifloat_quantize(x, fmt="fp6_e3m2")
+        q12 = minifloat_quantize(x, fmt="fp12_e4m7")
+        assert q6.data.dtype == jnp.int8 and q12.data.dtype == jnp.int16
+
+    def test_selective_matches_full(self):
+        from deepspeed_tpu.ops.quant import (dequantize, quantize,
+                                             selective_dequantize)
+        E, d, f = 8, 32, 64
+        w = jnp.asarray(np.random.RandomState(1).randn(E, d, f), jnp.float32)
+        qt = quantize(w, bits=8, num_groups=E * 4)
+        rows = jnp.asarray([1, 5, 2])
+        sel = selective_dequantize(qt, rows)
+        full = dequantize(qt)
+        np.testing.assert_allclose(np.asarray(sel),
+                                   np.asarray(full)[np.asarray(rows)],
+                                   atol=1e-6)
+
+    def test_selective_minifloat(self):
+        from deepspeed_tpu.ops.quant import (minifloat_dequantize,
+                                             minifloat_quantize,
+                                             selective_dequantize)
+        E, d = 4, 128
+        w = jnp.asarray(np.random.RandomState(2).randn(E, d), jnp.float32)
+        qt = minifloat_quantize(w, fmt="fp6_e3m2", num_groups=E * 2)
+        sel = selective_dequantize(qt, jnp.asarray([3, 0]))
+        full = minifloat_dequantize(qt)
+        np.testing.assert_allclose(np.asarray(sel),
+                                   np.asarray(full)[[3, 0]], atol=1e-6)
+
+    def test_misaligned_groups_raise(self):
+        from deepspeed_tpu.ops.quant import quantize, selective_dequantize
+        w = jnp.ones((6, 10))
+        qt = quantize(w, bits=8, num_groups=4)    # 4 groups, 6 rows
+        with pytest.raises(ValueError, match="align"):
+            selective_dequantize(qt, jnp.asarray([0]))

@@ -92,3 +92,28 @@ class TestKVOffload:
         if eng._kv_on_host:
             kind = getattr(eng.state.kv.sharding, "memory_kind", None)
             assert kind in ("pinned_host", "unpinned_host")
+
+
+class TestMinifloatServing:
+    def test_fp6_serving_runs_and_tracks_fp(self):
+        """fp6 weights (reference FP6 of csrc/fp_quantizer) serve with
+        bounded drift from the fp path."""
+        m = tiny_model()
+        eng_fp = make_engine(m, kv_dtype=jnp.float32,
+                             param_dtype=jnp.float32)
+        eng_q = make_engine(m, kv_dtype=jnp.float32,
+                            param_dtype=jnp.float32, weight_quant="fp6")
+        prompt = list(np.random.RandomState(4).randint(1, 128, 8))
+        out_fp = eng_fp.generate({1: prompt}, GREEDY)[1]
+        out_q = eng_q.generate({1: prompt}, GREEDY)[1]
+        assert len(out_q) == len(out_fp)
+
+    def test_fp12_matches_greedy(self):
+        m = tiny_model()
+        eng_fp = make_engine(m, kv_dtype=jnp.float32,
+                             param_dtype=jnp.float32)
+        eng_q = make_engine(m, kv_dtype=jnp.float32,
+                            param_dtype=jnp.float32, weight_quant="fp12")
+        prompt = list(np.random.RandomState(5).randint(1, 128, 8))
+        assert eng_q.generate({1: prompt}, GREEDY)[1] == \
+            eng_fp.generate({1: prompt}, GREEDY)[1]
