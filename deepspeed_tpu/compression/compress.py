@@ -206,3 +206,78 @@ def redundancy_clean(params: Any, ds_config: Dict,
     (reference: compress.py:148 redundancy_clean)."""
     return CompressionScheduler.from_config(
         ds_config.get("compression_training", {})).apply(params, step)
+
+
+# --------------------------------------------------------------------------
+# Layer reduction + distillation init (reference: compress.py:119
+# init_compression layer_reduction branch, :192 student_initialization;
+# config.py LAYER_REDUCTION keep_number_layer/teacher_layer)
+# --------------------------------------------------------------------------
+
+def student_initialization(student_params: Any, teacher_params: Any,
+                           ds_config: Dict) -> Any:
+    """Initialize a depth-reduced student from chosen teacher layers.
+
+    The stacked-blocks layout makes the reference's per-module copy loop
+    (student_initialization compress.py:192-230) a single gather on the
+    leading layers dim: ``blocks[teacher_layer]``.  Embeddings, final
+    norm, and any other non-block leaves are copied whole.
+
+    Config (reference: config.py layer_reduction)::
+
+        {"compression_training": {"layer_reduction": {
+            "enabled": true,
+            "keep_number_layer": 6,
+            "teacher_layer": [1, 3, 5, 7, 9, 11]   # default: even spread
+        }}}
+    """
+    lr = (ds_config.get("compression_training", {})
+          .get("layer_reduction", {}))
+    if not lr.get("enabled", False):
+        raise ValueError("layer_reduction.enabled must be true")
+    t_blocks = teacher_params["blocks"]
+    n_teacher = jax.tree.leaves(t_blocks)[0].shape[0]
+    keep = int(lr.get("keep_number_layer",
+                      jax.tree.leaves(student_params["blocks"])[0].shape[0]))
+    layers = lr.get("teacher_layer")
+    if layers is None:
+        # even spread, biased to later layers (reference default keeps
+        # a contiguous prefix; the spread matches common KD practice)
+        layers = np.linspace(0, n_teacher - 1, keep).round().astype(int)
+    layers = np.asarray(layers, np.int32)
+    n_student = jax.tree.leaves(student_params["blocks"])[0].shape[0]
+    if keep != n_student:
+        raise ValueError(f"keep_number_layer={keep} but the student has "
+                         f"{n_student} layers")
+    if len(layers) != keep:
+        raise ValueError(f"teacher_layer has {len(layers)} entries but "
+                         f"keep_number_layer={keep}")
+    if layers.min() < 0 or layers.max() >= n_teacher:
+        raise ValueError(f"teacher_layer {layers.tolist()} out of range "
+                         f"({n_teacher} teacher layers)")
+
+    out = {k: v for k, v in student_params.items()}
+    out["blocks"] = jax.tree.map(lambda w: w[layers], t_blocks)
+    for k in student_params:
+        if k == "blocks":
+            continue
+        if k in teacher_params:
+            ts = jax.tree.map(np.shape, teacher_params[k])
+            ss = jax.tree.map(np.shape, student_params[k])
+            if ts == ss:
+                out[k] = teacher_params[k]
+    logger.info("student initialized from teacher layers %s",
+                layers.tolist())
+    return out
+
+
+def kd_loss(student_logits: jax.Array, teacher_logits: jax.Array,
+            temperature: float = 1.0) -> jax.Array:
+    """Distillation soft cross-entropy — KL(teacher-softened || student)
+    up to the teacher-entropy constant — the loss the layer-reduced
+    student trains against (DeepSpeed compression tutorial pairing;
+    reference ships the init, examples ship the loss)."""
+    t = temperature
+    s = jax.nn.log_softmax(student_logits.astype(jnp.float32) / t, axis=-1)
+    p = jax.nn.softmax(teacher_logits.astype(jnp.float32) / t, axis=-1)
+    return -(p * s).sum(axis=-1).mean() * (t * t)

@@ -208,3 +208,68 @@ class TestCompression:
         params = {"w": jax.random.normal(jax.random.PRNGKey(0), (64,))}
         out = redundancy_clean(params, cfg)
         assert float((np.asarray(out["w"]) == 0).mean()) >= 0.85
+
+
+class TestLayerReductionDistillation:
+    """(reference: compression/compress.py:119 layer_reduction +
+    student_initialization :192)."""
+
+    def _models(self):
+        from deepspeed_tpu.models import build_model
+        t = build_model("gpt2", vocab_size=128, num_layers=8, d_model=32,
+                        num_heads=4, max_seq_len=16, seed=0)
+        s = build_model("gpt2", vocab_size=128, num_layers=4, d_model=32,
+                        num_heads=4, max_seq_len=16, seed=1)
+        return t, s
+
+    def test_student_init_gathers_teacher_layers(self):
+        import numpy as np
+        from deepspeed_tpu.compression.compress import student_initialization
+        t, s = self._models()
+        cfg = {"compression_training": {"layer_reduction": {
+            "enabled": True, "keep_number_layer": 4,
+            "teacher_layer": [1, 3, 5, 7]}}}
+        p = student_initialization(s.params, t.params, cfg)
+        np.testing.assert_array_equal(
+            np.asarray(p["blocks"]["attn"]["wq"][2]),
+            np.asarray(t.params["blocks"]["attn"]["wq"][5]))
+        np.testing.assert_array_equal(
+            np.asarray(p["embed"]["table"]),
+            np.asarray(t.params["embed"]["table"]))
+
+    def test_student_trains_and_distills(self):
+        import numpy as np
+        import jax.numpy as jnp
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.compression.compress import (kd_loss,
+                                                        student_initialization)
+        t, s = self._models()
+        cfg = {"compression_training": {"layer_reduction": {
+            "enabled": True, "keep_number_layer": 4}}}
+        sp = student_initialization(s.params, t.params, cfg)
+        ids = np.random.RandomState(0).randint(0, 128, (8, 16))
+
+        def loss_fn(params, batch, rng):
+            sl = s.apply(params, batch["input_ids"], dtype=jnp.float32)
+            tl = t.apply(t.params, batch["input_ids"], dtype=jnp.float32)
+            return kd_loss(sl, tl, temperature=2.0)
+
+        eng = ds.initialize(loss_fn=loss_fn, params=sp, config={
+            "train_micro_batch_size_per_device": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+            "mesh": {"data": 8}, "steps_per_print": 1000})
+        losses = [float(eng.train_batch({"input_ids": ids})["loss"])
+                  for _ in range(8)]
+        assert losses[-1] < losses[0]
+
+    def test_bad_config_raises(self):
+        import pytest
+        from deepspeed_tpu.compression.compress import student_initialization
+        t, s = self._models()
+        with pytest.raises(ValueError, match="enabled"):
+            student_initialization(s.params, t.params, {})
+        with pytest.raises(ValueError, match="out of range"):
+            student_initialization(s.params, t.params, {
+                "compression_training": {"layer_reduction": {
+                    "enabled": True, "keep_number_layer": 4,
+                    "teacher_layer": [0, 1, 2, 99]}}})

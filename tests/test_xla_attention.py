@@ -117,3 +117,16 @@ class TestFusedAttention:
         data = synthetic_lm_data(128, eng.train_batch_size, 32)
         losses = [float(eng.train_batch(data)["loss"]) for _ in range(8)]
         assert losses[-1] < losses[0]
+
+
+class TestCrossLength:
+    def test_longer_keys_than_queries(self):
+        """Sq != Sk (decode-style suffix queries) must take the general
+        path and match the reference's causal offset."""
+        B, H, D = 2, 4, 16
+        q = _rand((B, 16, H, D), 0)
+        k = _rand((B, 24, H, D), 1)
+        v = _rand((B, 24, H, D), 2)
+        np.testing.assert_allclose(
+            np.asarray(fused_attention(q, k, v)),
+            np.asarray(causal_attention(q, k, v)), atol=1e-5, rtol=1e-5)
