@@ -136,7 +136,7 @@ def serving_bench(on_tpu: bool):
                                          SamplingParams)
     from deepspeed_tpu.models import build_model
 
-    n_seqs, prompt_len = (16, 128) if on_tpu else (2, 8)
+    n_seqs, prompt_len = (32, 128) if on_tpu else (2, 8)
     model = build_model(
         "gpt2",
         **(dict(max_seq_len=1024) if on_tpu else
@@ -145,7 +145,8 @@ def serving_bench(on_tpu: bool):
     eng = InferenceEngine(model, InferenceConfig(
         token_budget=256 if on_tpu else 16, max_seqs=n_seqs,
         kv_block_size=64 if on_tpu else 16,
-        num_kv_blocks=512 if on_tpu else 32))
+        num_kv_blocks=1024 if on_tpu else 32,
+        decode_burst=8 if on_tpu else 2))
     r = np.random.RandomState(0)
     sp = SamplingParams(temperature=0.0, max_new_tokens=1 << 30)
     vocab = model.config.vocab_size
@@ -168,17 +169,20 @@ def serving_bench(on_tpu: bool):
             ttft.setdefault(uid, now * 1e3)
     ttft_p50_ms = float(np.median(list(ttft.values())))
 
-    # --- steady-state decode throughput: all seqs live, decode-only steps
-    decode_steps = 20 if on_tpu else 3
+    # --- steady-state decode throughput: all seqs live, device-side
+    # decode bursts (K forwards per dispatch — the sampled token feeds
+    # the next forward on-device)
+    rounds = 6 if on_tpu else 2
     for uid in range(n_seqs):           # feed the sampled token back
         eng.put(uid, [1])
-    eng.step(sampling=sp)               # settle into the decode signature
+    out = eng.decode_burst(sampling=sp)          # compile + settle
     produced = 0
     t0 = time.perf_counter()
-    for _ in range(decode_steps):
-        for uid in range(n_seqs):
-            eng.put(uid, [1])
-        produced += len(eng.step(sampling=sp))
+    for _ in range(rounds):
+        for uid in out:
+            eng.put(uid, [out[uid][-1]])
+        out = eng.decode_burst(sampling=sp)
+        produced += sum(len(v) for v in out.values())
     dt = time.perf_counter() - t0
     return ttft_p50_ms, produced / dt
 

@@ -60,6 +60,12 @@ class InferenceConfig:
     # keep the paged KV cache in host memory, streaming one layer per
     # scan step through HBM (over-HBM contexts; needs pinned_host)
     kv_offload: bool = False
+    # device-side decode bursts: run K decode iterations in ONE dispatch
+    # (sampled tokens fed back on-device via lax.scan), amortizing the
+    # host round trip over K tokens.  1 disables.  Sequences that hit
+    # their stop token mid-burst over-generate up to K-1 tokens, which
+    # generate() discards (the usual multi-step-scheduling trade).
+    decode_burst: int = 1
 
 
 # attn-impl probe results, memoized per (backend, shape signature)
@@ -100,6 +106,7 @@ class InferenceEngine:
         self._ctx_exhausted: set = set()
         self._rng = jax.random.PRNGKey(0)
         self._step_fn = None
+        self._burst_fns: Dict[tuple, object] = {}
         self._steps_done = 0
 
     def refresh_params(self, params) -> None:
@@ -117,7 +124,9 @@ class InferenceEngine:
             self.params, self._quant = quantize_model_params(
                 self.params, bits=WEIGHT_QUANT_BITS[self.icfg.weight_quant],
                 quantize_embeddings=self.icfg.quantize_embeddings)
-            self._step_fn = None        # closure holds the old quant tree
+            # step/burst closures hold the old quant tree
+            self._step_fn = None
+            self._burst_fns.clear()
 
     def _offload_kv(self) -> None:
         """Move the paged KV cache to host memory (ZeRO-Inference KV
@@ -358,10 +367,127 @@ class InferenceEngine:
         return out
 
     # ------------------------------------------------------------------
+    # device-side decode bursts
+    # ------------------------------------------------------------------
+    def _build_burst(self, steps: int, sampling: SamplingParams, P: int):
+        """One jitted burst program per (steps, sampling, prefix bucket):
+        gather a dense READ-ONLY prefix of every live context, scan
+        ``steps`` decode iterations carrying only the tiny in-burst KV
+        tail, then scatter the tail into the (donated) paged cache.
+        Carrying the paged cache itself through the scan copies the full
+        pool every iteration (~80 ms/iter for a GPT-2-sized pool on a
+        v5e) — the prefix/tail split removes that entirely."""
+        from .model import (decode_burst_forward, scatter_tail,
+                            snapshot_prefix)
+
+        cfg = self.cfg
+        bs = self.icfg.kv_block_size
+        quant = self._quant
+
+        def sample_fn(logits, r):
+            return sample(logits, sampling, r)
+
+        def burst(params, kv, block_tables, base_ctx, token0, rng):
+            prefix = snapshot_prefix(kv, block_tables, P, bs)
+            toks, tail = decode_burst_forward(
+                cfg, params, prefix, base_ctx, token0, steps, sample_fn,
+                rng, quant=quant)
+            kv = scatter_tail(kv, tail, block_tables, base_ctx, bs)
+            return toks, kv
+
+        return jax.jit(burst, donate_argnums=(1,))
+
+    def decode_burst(self, steps: Optional[int] = None,
+                     sampling: SamplingParams = SamplingParams(),
+                     rng: Optional[jax.Array] = None) -> Dict[int, List[int]]:
+        """Run ``steps`` decode iterations in ONE device dispatch: the
+        sampled token feeds the next forward on-device (lax.scan), so the
+        host round trip — which dominates decode latency on
+        high-latency links — is paid once per burst instead of once per
+        token.  All pending requests must be single-token continuations
+        of live sequences (pure decode); KV blocks for the whole burst
+        are pre-reserved host-side.  Returns {uid: [token, ...]}."""
+        steps = steps or max(1, self.icfg.decode_burst)
+        pending = {u: t for u, t in self._pending.items() if t}
+        if not pending:
+            return {}
+        if any(len(t) != 1 or u not in self.state.seqs
+               for u, t in pending.items()):
+            raise ValueError("decode_burst requires every pending request "
+                             "to be a single-token continuation; use "
+                             "step() for prefill")
+        if getattr(self, "_kv_on_host", False):
+            # bursts need the cache addressable on device
+            out = self.step(rng=rng, sampling=sampling)
+            return {u: [t] for u, t in out.items()}
+        # cap the burst by context headroom, then reserve its KV blocks
+        steps = min([steps] + [self.state.context_remaining(u)
+                               for u in pending])
+        # shrink the burst until the whole reservation fits the free
+        # pool (the stepwise scheduler degrades the same way — a burst
+        # must never crash a workload step() would survive)
+        bs_blk = self.icfg.kv_block_size
+        while steps > 1:
+            need = sum(self.state.seqs[u].blocks_needed(steps, bs_blk)
+                       for u in pending)
+            if need <= self.state.allocator.free_blocks:
+                break
+            steps -= 1
+        if steps <= 1:
+            out = self.step(rng=rng, sampling=sampling)
+            return {u: [t] for u, t in out.items()}
+        for uid in pending:
+            if not self.state.reserve_ahead(uid, steps):
+                raise RuntimeError(      # unreachable after the fit check
+                    f"uid {uid}: cannot reserve {steps} tokens of KV")
+
+        st = self.state
+        S = self.icfg.max_seqs
+        base = np.zeros(S, np.int32)
+        tok0 = np.zeros(S, np.int32)
+        tables = np.full((S, self.icfg.num_kv_blocks), -1, np.int32)
+        for uid in pending:
+            slot = st.slot(uid)
+            seq = st.seqs[uid]
+            base[slot] = seq.seen_tokens
+            tok0[slot] = pending[uid][0]
+            tables[slot, :len(seq.blocks)] = seq.blocks
+        # prefix bucket: smallest block-aligned 256-ish chunk covering the
+        # longest live context (bounds recompiles as contexts grow)
+        chunk = self.icfg.kv_block_size * max(
+            1, -(-256 // self.icfg.kv_block_size))
+        P = int(min(self.max_blocks_per_seq * self.icfg.kv_block_size,
+                    max(chunk, chunk * -(-int(base.max()) // chunk))))
+
+        key = (steps, sampling, P)
+        if key not in self._burst_fns:
+            self._burst_fns[key] = self._build_burst(steps, sampling, P)
+        if rng is None:
+            self._rng, rng = jax.random.split(self._rng)
+        toks, self.state.kv = self._burst_fns[key](
+            self.params, self.state.kv, jnp.asarray(tables),
+            jnp.asarray(base), jnp.asarray(tok0), rng)
+        self._steps_done += steps
+        toks_np = np.asarray(toks)                     # ONE fetch
+        out: Dict[int, List[int]] = {}
+        for uid in pending:
+            slot = st.slot(uid)
+            seq_toks = [int(t) for t in toks_np[:, slot]]
+            st.seqs[uid].tokens.extend(seq_toks)
+            # the burst wrote `steps` KV rows: the fed token + the first
+            # steps-1 sampled ones
+            st.advance(uid, steps)
+            self._pending[uid] = []
+            out[uid] = seq_toks
+        return out
+
+    # ------------------------------------------------------------------
     def generate(self, prompts: Dict[int, Sequence[int]],
                  sampling: SamplingParams = SamplingParams(),
                  rng: Optional[jax.Array] = None) -> Dict[int, List[int]]:
-        """Convenience loop: run all prompts to max_new_tokens/stop."""
+        """Convenience loop: run all prompts to max_new_tokens/stop.
+        With ``InferenceConfig.decode_burst > 1``, decode-only rounds run
+        as device-side bursts."""
         for uid, p in prompts.items():
             self.put(uid, p)
         done: Dict[int, List[int]] = {uid: [] for uid in prompts}
@@ -372,24 +498,42 @@ class InferenceEngine:
                 rng, sub = jax.random.split(rng)
             else:
                 sub = None
-            out = self.step(rng=sub, sampling=sampling)
+            pending = {u: t for u, t in self._pending.items() if t}
+            decode_only = pending and all(
+                len(t) == 1 and u in self.state.seqs
+                for u, t in pending.items())
+            burst = 1
+            if decode_only and self.icfg.decode_burst > 1:
+                room = min(sampling.max_new_tokens - len(done[u])
+                           for u in pending if u in done)
+                burst = max(1, min(self.icfg.decode_burst, room))
+            if burst > 1:
+                outs = self.decode_burst(burst, sampling=sampling, rng=sub)
+            else:
+                outs = {u: [t] for u, t in
+                        self.step(rng=sub, sampling=sampling).items()}
             # sequences that hit the context limit end their generation
             for uid in list(self._ctx_exhausted):
                 if uid in active:
                     active.discard(uid)
                     self.flush(uid)
                 self._ctx_exhausted.discard(uid)
-            for uid, tok in out.items():
+            for uid, toks in outs.items():
                 if uid not in active:
                     continue
-                done[uid].append(tok)
-                stop = (sampling.stop_token is not None
-                        and tok == sampling.stop_token)
-                if stop or len(done[uid]) >= sampling.max_new_tokens:
+                finished = False
+                for tok in toks:
+                    done[uid].append(tok)
+                    stop = (sampling.stop_token is not None
+                            and tok == sampling.stop_token)
+                    if stop or len(done[uid]) >= sampling.max_new_tokens:
+                        finished = True
+                        break
+                if finished:
                     active.discard(uid)
                     self.flush(uid)
                 else:
-                    self.put(uid, [tok])
+                    self.put(uid, [toks[-1]])
             i += 1
             if i > 100_000:
                 raise RuntimeError("generate() did not terminate")

@@ -88,6 +88,50 @@ def _paged_attention(kv_layer, q, batch: RaggedBatch, block_size: int,
     return o.reshape(T, H, D)
 
 
+
+
+def _qkv_proj(cfg, ap, h, dt, cos, sin, positions):
+    """Shared qkv projection + biases + rotary for the serving forwards
+    (ragged step and decode burst)."""
+    q = jnp.einsum("td,dhk->thk", h, ap["wq"].astype(dt))
+    k = jnp.einsum("td,dhk->thk", h, ap["wk"].astype(dt))
+    v = jnp.einsum("td,dhk->thk", h, ap["wv"].astype(dt))
+    if cfg.attn_bias:
+        q = q + ap["bq"].astype(dt)
+        k = k + ap["bk"].astype(dt)
+        v = v + ap["bv"].astype(dt)
+    if cfg.position == "rope":
+        # apply_rope expects [B, S, H, D]; B=1 with per-token positions
+        q = L.apply_rope(q[None], cos, sin, positions=positions[None])[0]
+        k = L.apply_rope(k[None], cos, sin, positions=positions[None])[0]
+    return q, k, v
+
+
+def _ffn(cfg, lp, h, dt, act):
+    """Shared MLP / MoE branch of a serving layer."""
+    if cfg.num_experts > 1:
+        from ..parallel import moe as M
+
+        d, _ = M.moe_ffn(lp["gate"], lp["experts"], h[None],
+                         top_k=cfg.moe_top_k,
+                         capacity_factor=cfg.eval_capacity_factor,
+                         min_capacity=cfg.min_capacity,
+                         activation=act, gated=cfg.gated_mlp)
+        return d[0]
+    mp = lp["mlp"]
+    u = h @ mp["wi"].astype(dt)
+    if cfg.mlp_bias:
+        u = u + mp["bi"].astype(dt)
+    if cfg.gated_mlp:
+        u = act(h @ mp["wg"].astype(dt)) * u
+    else:
+        u = act(u)
+    d = u @ mp["wo"].astype(dt)
+    if cfg.mlp_bias:
+        d = d + mp["bo"].astype(dt)
+    return d
+
+
 def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
                    block_size: int, max_blocks_per_seq: int,
                    rng: Optional[jax.Array] = None,
@@ -135,18 +179,7 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
             lp = merge_layer(lp, quant["blocks"], li, dt)
         ap = lp["attn"]
         h = norm(lp["ln1"], x)
-        q = jnp.einsum("td,dhk->thk", h, ap["wq"].astype(dt))
-        k = jnp.einsum("td,dhk->thk", h, ap["wk"].astype(dt))
-        v = jnp.einsum("td,dhk->thk", h, ap["wv"].astype(dt))
-        if cfg.attn_bias:
-            q = q + ap["bq"].astype(dt)
-            k = k + ap["bk"].astype(dt)
-            v = v + ap["bv"].astype(dt)
-        if cfg.position == "rope":
-            # apply_rope expects [B, S, H, D]; use B=1 with per-token pos
-            pos = batch.positions[None]
-            q = L.apply_rope(q[None], cos, sin, positions=pos)[0]
-            k = L.apply_rope(k[None], cos, sin, positions=pos)[0]
+        q, k, v = _qkv_proj(cfg, ap, h, dt, cos, sin, batch.positions)
         kv_layer = _write_kv(kv_layer, k, v, batch, block_size)
         attn = (_paged_attention_pallas if attn_impl == "pallas"
                 else _paged_attention)
@@ -158,27 +191,7 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
             x = x + o
             h = norm(lp["ln2"], x)
         # parallel residual (falcon/phi): MLP reads the same ln1 output
-        if cfg.num_experts > 1:
-            from ..parallel import moe as M
-
-            d, _ = M.moe_ffn(lp["gate"], lp["experts"], h[None],
-                             top_k=cfg.moe_top_k,
-                             capacity_factor=cfg.eval_capacity_factor,
-                             min_capacity=cfg.min_capacity,
-                             activation=act, gated=cfg.gated_mlp)
-            d = d[0]
-        else:
-            mp = lp["mlp"]
-            u = h @ mp["wi"].astype(dt)
-            if cfg.mlp_bias:
-                u = u + mp["bi"].astype(dt)
-            if cfg.gated_mlp:
-                u = act(h @ mp["wg"].astype(dt)) * u
-            else:
-                u = act(u)
-            d = u @ mp["wo"].astype(dt)
-            if cfg.mlp_bias:
-                d = d + mp["bo"].astype(dt)
+        d = _ffn(cfg, lp, h, dt, act)
         if kv_host:
             kv_layer = jax.device_put(kv_layer, jax.memory.Space.Host)
         if cfg.parallel_block:
@@ -201,3 +214,165 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
         if cfg.head_bias:
             logits = logits + params["lm_head"]["bias"].astype(dt)
     return logits.astype(jnp.float32), new_kv
+
+
+# --------------------------------------------------------------------------
+# Device-side decode bursts (multi-token decode in one dispatch)
+# --------------------------------------------------------------------------
+
+def snapshot_prefix(kv, block_tables, P: int, block_size: int):
+    """Gather each slot's first ``P`` context tokens into a dense
+    read-only buffer [L, S, P, 2, Hkv, D] (the burst's attention operand;
+    gathered ONCE per burst, never carried through the scan — carrying
+    the paged cache itself copies it every iteration)."""
+    nb = P // block_size
+    tables = block_tables[:, :nb]                     # [S, nb]
+    trash = kv.shape[1] - 1
+    tables = jnp.where(tables < 0, trash, tables)
+    ctx = kv[:, tables]            # [L, S, nb, bs, 2, Hkv, D]
+    L, S = ctx.shape[0], ctx.shape[1]
+    return ctx.reshape(L, S, P, 2, ctx.shape[-2], ctx.shape[-1])
+
+
+def decode_burst_forward(cfg: TransformerConfig, params, prefix,
+                         base_ctx, token0, steps: int, sample_fn,
+                         rng, quant=None):
+    """Run ``steps`` decode iterations entirely on device.
+
+    prefix: [L, S, P, 2, Hkv, D] dense read-only context (closure-sized
+    operand); base_ctx: [S] i32 tokens already in context per slot;
+    token0: [S] i32 the last fed token per slot.  Returns
+    (tokens [steps, S], tail [L, S, steps, 2, Hkv, D]) — the caller
+    scatters the tail back into the paged cache.
+
+    Attention per token = ONLINE-SOFTMAX MERGE of (a) dense attention
+    over the prefix (masked by base_ctx) and (b) attention over the
+    in-burst tail (masked by iteration) — no concatenation, the prefix
+    is never copied."""
+    nL = prefix.shape[0]
+    S, P = prefix.shape[1], prefix.shape[2]
+    Hkv, D = prefix.shape[4], prefix.shape[5]
+    H = cfg.num_heads
+    rep = H // Hkv
+    norm = _norm(cfg)
+    act = L.ACTIVATIONS[cfg.activation]
+    scale = 1.0 / (cfg.head_dim ** 0.5)
+    if quant is not None:
+        from .quantization import merge_layer
+        from ..ops.quant import dequantize_any
+    if quant is not None and "embed" in quant:
+        embed_tab = {"table": dequantize_any(quant["embed"]["table"])}
+    else:
+        embed_tab = params["embed"]
+    dt = embed_tab["table"].dtype
+    if cfg.position == "rope":
+        cos, sin = L.rope_freqs(cfg.rotary_dim, cfg.max_seq_len,
+                                cfg.rope_theta)
+    else:
+        cos = sin = None
+
+    def one_layer(x, lp, li, tail_l, pos, j):
+        """x: [S, dm]; tail_l: [S, K, 2, Hkv, D] this layer's in-burst
+        KV.  Returns (y, tail_l with slot j written)."""
+        if quant is not None:
+            lp = merge_layer(lp, quant["blocks"], li, dt)
+        ap = lp["attn"]
+        h = norm(lp["ln1"], x)
+        q, k, v = _qkv_proj(cfg, ap, h, dt, cos, sin, pos)
+        tail_l = tail_l.at[:, j, 0].set(k)
+        tail_l = tail_l.at[:, j, 1].set(v)
+
+        qg = q.reshape(S, Hkv, rep, D)
+        # (a) prefix attention, masked by each slot's true context length
+        kp = prefix[li, :, :, 0]                      # [S, P, Hkv, D]
+        vp = prefix[li, :, :, 1]
+        sa = jnp.einsum("shrd,sphd->shrp", qg, kp.astype(dt)
+                        ).astype(jnp.float32) * scale
+        cols = jnp.arange(P)[None, :]
+        valid = cols < base_ctx[:, None]              # [S, P]
+        sa = jnp.where(valid[:, None, None, :], sa, -1e30)
+        ma = sa.max(axis=-1)
+        pa = jnp.exp(sa - ma[..., None])
+        la = pa.sum(axis=-1)
+        oa = jnp.einsum("shrp,sphd->shrd", pa.astype(dt), vp.astype(dt))
+        # (b) in-burst tail attention, masked by iteration (<= j)
+        kt = tail_l[:, :, 0]                          # [S, K, Hkv, D]
+        vt = tail_l[:, :, 1]
+        sb = jnp.einsum("shrd,skhd->shrk", qg, kt).astype(jnp.float32) \
+            * scale
+        it_valid = jnp.arange(tail_l.shape[1]) <= j
+        sb = jnp.where(it_valid[None, None, None, :], sb, -1e30)
+        mb = sb.max(axis=-1)
+        pb = jnp.exp(sb - mb[..., None])
+        lb = pb.sum(axis=-1)
+        ob = jnp.einsum("shrk,skhd->shrd", pb.astype(dt), vt)
+        # online-softmax merge of the two parts
+        m = jnp.maximum(ma, mb)
+        wa = jnp.exp(ma - m)
+        wb = jnp.exp(mb - m)
+        denom = la * wa + lb * wb
+        o = (oa.astype(jnp.float32) * wa[..., None]
+             + ob.astype(jnp.float32) * wb[..., None]) / \
+            jnp.maximum(denom, 1e-30)[..., None]
+        o = o.reshape(S, H, D).astype(dt)
+
+        o = jnp.einsum("thk,hkd->td", o, ap["wo"].astype(dt))
+        if cfg.attn_bias:
+            o = o + ap["bo"].astype(dt)
+        if not cfg.parallel_block:
+            x = x + o
+            h = norm(lp["ln2"], x)
+        d = _ffn(cfg, lp, h, dt, act)
+        y = (x + o + d) if cfg.parallel_block else (x + d)
+        return y, tail_l
+
+    tail0 = jnp.zeros((nL, S, steps, 2, Hkv, D), dt)
+    rngs = jax.random.split(rng, steps)
+
+    def iteration(carry, xs):
+        tok, tail = carry
+        j, r = xs
+        pos = base_ctx + j                           # this token's position
+        x = L.embed(embed_tab, tok).astype(dt)
+        if cfg.position == "learned":
+            x = x + params["pos_embed"]["table"][pos].astype(dt)
+
+        def body(x, xs2):
+            lp, li, tl = xs2
+            y, tl = one_layer(x, lp, li, tl, pos, j)
+            return y, tl
+
+        x, tail = jax.lax.scan(
+            body, x, (params["blocks"],
+                      jnp.arange(cfg.num_layers, dtype=jnp.int32), tail))
+        x = norm(params["ln_f"], x)
+        if cfg.tie_embeddings:
+            logits = x @ embed_tab["table"].astype(dt).T
+        else:
+            logits = x @ params["lm_head"]["kernel"].astype(dt)
+            if cfg.head_bias:
+                logits = logits + params["lm_head"]["bias"].astype(dt)
+        nxt = sample_fn(logits.astype(jnp.float32), r)
+        return (nxt, tail), nxt
+
+    (_, tail), toks = jax.lax.scan(
+        iteration, (token0, tail0),
+        (jnp.arange(steps, dtype=jnp.int32), rngs))
+    return toks, tail
+
+
+def scatter_tail(kv, tail, block_tables, base_ctx, block_size: int):
+    """Write the burst's tail KV into the paged cache (one donated
+    dispatch after the scan): token (slot s, iter j) lands at block
+    tables[s, (base+j)//bs], offset (base+j)%bs."""
+    nL, S, K = tail.shape[0], tail.shape[1], tail.shape[2]
+    pos = base_ctx[:, None] + jnp.arange(K)[None, :]          # [S, K]
+    blk = jnp.take_along_axis(block_tables, pos // block_size,
+                              axis=1)                          # [S, K]
+    trash = kv.shape[1] - 1
+    blk = jnp.where(blk < 0, trash, blk)
+    off = pos % block_size
+    li = jnp.arange(nL)[:, None, None]
+    # kv[l, blk[s,k], off[s,k]] <- tail[l, s, k]  ([2, Hkv, D] payload)
+    kv = kv.at[li, blk[None], off[None]].set(tail)
+    return kv

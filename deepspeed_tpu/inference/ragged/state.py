@@ -130,6 +130,26 @@ class StateManager:
         return (need <= self.allocator.free_blocks and slot_ok
                 and new_tokens <= self.context_remaining(uid))
 
+    def reserve_ahead(self, uid: int, n_tokens: int) -> bool:
+        """Pre-allocate KV blocks covering ``n_tokens`` beyond the
+        current context (device-side decode bursts write K tokens
+        between host block allocations).  Returns False when the pool
+        or context limit cannot cover it."""
+        seq = self.seqs[uid]
+        if n_tokens > self.context_remaining(uid):
+            return False
+        need = seq.blocks_needed(n_tokens, self.cfg.block_size)
+        if need > self.allocator.free_blocks:
+            return False
+        if need:
+            seq.blocks.extend(self.allocator.allocate(need))
+        return True
+
+    def advance(self, uid: int, n_tokens: int) -> None:
+        """Account tokens written device-side (burst iterations past the
+        first host-fed token)."""
+        self.seqs[uid].seen_tokens += n_tokens
+
     # ---- batch building --------------------------------------------------
     def build_batch(self, requests: List[tuple], token_budget: int
                     ) -> RaggedBatch:
