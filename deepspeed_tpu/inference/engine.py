@@ -452,15 +452,21 @@ class InferenceEngine:
             base[slot] = seq.seen_tokens
             tok0[slot] = pending[uid][0]
             tables[slot, :len(seq.blocks)] = seq.blocks
-        # prefix bucket: smallest block-aligned 256-ish chunk covering the
-        # longest live context (bounds recompiles as contexts grow)
+        # prefix bucket: geometric (doubling) block-aligned sizes, so a
+        # 32k-context engine compiles O(log) burst programs, not one per
+        # 256 tokens of context growth
         chunk = self.icfg.kv_block_size * max(
             1, -(-256 // self.icfg.kv_block_size))
-        P = int(min(self.max_blocks_per_seq * self.icfg.kv_block_size,
-                    max(chunk, chunk * -(-int(base.max()) // chunk))))
+        cap = self.max_blocks_per_seq * self.icfg.kv_block_size
+        P = chunk
+        while P < min(int(base.max()), cap):
+            P *= 2
+        P = int(min(P, cap))
 
         key = (steps, sampling, P)
         if key not in self._burst_fns:
+            if len(self._burst_fns) >= 8:     # bound retained executables
+                self._burst_fns.pop(next(iter(self._burst_fns)))
             self._burst_fns[key] = self._build_burst(steps, sampling, P)
         if rng is None:
             self._rng, rng = jax.random.split(self._rng)
@@ -506,7 +512,9 @@ class InferenceEngine:
             if decode_only and self.icfg.decode_burst > 1:
                 room = min(sampling.max_new_tokens - len(done[u])
                            for u in pending if u in done)
-                burst = max(1, min(self.icfg.decode_burst, room))
+                # only burst at the full configured width: a shrinking
+                # tail would mint one compiled program per remaining-K
+                burst = self.icfg.decode_burst                     if room >= self.icfg.decode_burst else 1
             if burst > 1:
                 outs = self.decode_burst(burst, sampling=sampling, rng=sub)
             else:

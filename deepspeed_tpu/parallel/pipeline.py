@@ -76,11 +76,13 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
     if cfg.num_layers % S:
         raise ValueError(f"num_layers {cfg.num_layers} not divisible by "
                          f"pipe stages {S}")
-    if cfg.num_experts > 1:
-        raise NotImplementedError("pipeline + MoE not yet supported")
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r} "
                          "(gpipe | 1f1b)")
+    if cfg.num_experts > 1 and schedule == "1f1b":
+        # the eager-gradient VJP would need an aux-loss cotangent channel
+        raise NotImplementedError(
+            "pipeline + MoE currently supports the gpipe schedule only")
     if sp > 1:
         if cfg.num_heads % sp or cfg.num_kv_heads % sp:
             raise ValueError(
@@ -98,13 +100,18 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
 
     # ---------------------------------------------------------------- util
     def stage_fwd(blocks_local, x, attn_mask, cos, sin):
+        """Apply this stage's layer slice.  Returns (x, aux) where aux is
+        the mean MoE load-balancing loss over the local layers (0.0 for
+        dense models)."""
         def body(h, lp):
-            h, _ = block_apply(cfg, lp, h, cos, sin, mask=attn_mask,
-                               attention_fn=attention_fn)
-            return h, None
+            h, metrics = block_apply(cfg, lp, h, cos, sin, mask=attn_mask,
+                                     attention_fn=attention_fn)
+            aux = metrics.get("moe_aux_loss", jnp.float32(0.0)) \
+                if metrics else jnp.float32(0.0)
+            return h, aux
         body_fn = jax.checkpoint(body) if cfg.remat else body
-        x, _ = lax.scan(body_fn, x, blocks_local)
-        return x
+        x, aux = lax.scan(body_fn, x, blocks_local)
+        return x, jnp.mean(aux)
 
     def head_nll(shared, y, labels, msk):
         """Unembed + lse - target_logit loss sum (no fp32 [mb,S,V]
@@ -153,9 +160,9 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
         first, last = stage == 0, stage == S - 1
         x0 = embed_in(shared, ids, pos0, seq_local)
         x = jnp.where(first, x0, x_in)
-        y = stage_fwd(blocks_local, x, amask, cos, sin)
+        y, aux = stage_fwd(blocks_local, x, amask, cos, sin)
         contrib = jnp.where(last, head_nll(shared, y, labels, msk), 0.0)
-        return y, contrib
+        return y, contrib, aux
 
     # ------------------------------------------------------------- shared
     def split_params(params):
@@ -203,27 +210,44 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
             T = M + S - 1
 
             def tick(carry, t):
-                buf, loss_sum, tok_sum = carry
+                buf, loss_sum, tok_sum, aux_sum, aux_n = carry
                 t_here = jnp.clip(t - stage, 0, M - 1)
                 i, lbl, msk, am = mb_slice(
                     (ids_mb, labels_mb, mask_mb, amask_mb), t_here)
-                y, contrib = stage_ext(blocks, shared, buf, i, lbl, msk,
-                                       am, cos, sin, pos0, seq_local)
+                y, contrib, aux = stage_ext(blocks, shared, buf, i, lbl,
+                                            msk, am, cos, sin, pos0,
+                                            seq_local)
                 # the last stage processes microbatch t-(S-1) at tick t
                 valid = last & (t >= S - 1)
                 contrib = jnp.where(valid, contrib, 0.0)
                 toks = jnp.where(valid, msk.sum(), 0.0)
+                # every stage contributes its layers' MoE aux loss for
+                # the microbatch it actually processed this tick
+                a_valid = (t >= stage) & (t - stage < M)
+                aux_sum = aux_sum + jnp.where(a_valid, aux, 0.0)
+                aux_n = aux_n + a_valid.astype(jnp.float32)
                 buf_next = lax.ppermute(y, PIPE_AXIS, perm_down) \
                     if S > 1 else y
-                return (buf_next, loss_sum + contrib, tok_sum + toks), None
+                return (buf_next, loss_sum + contrib, tok_sum + toks,
+                        aux_sum, aux_n), None
 
             buf0 = jnp.zeros((mb, seq_local, cfg.d_model), dt)
-            (_, loss_sum, tok_sum), _ = lax.scan(
-                tick, (buf0, jnp.float32(0.0), jnp.float32(0.0)),
+            (_, loss_sum, tok_sum, aux_sum, aux_n), _ = lax.scan(
+                tick, (buf0, jnp.float32(0.0), jnp.float32(0.0),
+                       jnp.float32(0.0), jnp.float32(0.0)),
                 jnp.arange(T))
             loss_sum = lax.psum(loss_sum, reduce_axes)
             tok_sum = lax.psum(tok_sum, reduce_axes)
-            return loss_sum / jnp.maximum(tok_sum, 1.0)
+            loss = loss_sum / jnp.maximum(tok_sum, 1.0)
+            if cfg.num_experts > 1:
+                # mean over (stages x microbatches x data shards) of the
+                # per-stage layer-mean aux loss (reference: l_aux summed
+                # into the LM loss, sharded_moe.py)
+                aux_sum = lax.psum(aux_sum, reduce_axes)
+                aux_n = lax.psum(aux_n, reduce_axes)
+                loss = loss + cfg.aux_loss_coef * (
+                    aux_sum / jnp.maximum(aux_n, 1.0))
+            return loss
 
         blocks, shared = split_params(params)
         blocks_specs = jax.tree.map(lambda _: P(PIPE_AXIS), blocks)
@@ -258,8 +282,11 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
         def run_ext(x_in, m):
             i, lbl, msk, am = mb_slice(
                 (ids_mb, labels_mb, mask_mb, amask_mb), m)
+            # (y, contrib) only: MoE (the aux output) is gpipe-only, so
+            # the eager VJP seeds exactly these two cotangents
             return (lambda b, sh, x: stage_ext(
-                b, sh, x, i, lbl, msk, am, cos, sin, pos0, seq_local)), msk
+                b, sh, x, i, lbl, msk, am, cos, sin, pos0,
+                seq_local)[:2]), msk
 
         def tick(carry, t):
             buf_f, buf_b, stash, gb, gsh, loss_sum, tok_sum = carry
