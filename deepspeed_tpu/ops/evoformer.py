@@ -1,0 +1,156 @@
+"""Evoformer (DS4Science) attention: biased multi-head attention for
+AlphaFold-style pair/MSA stacks.
+
+TPU-native analog of the reference's DS4Sci_EvoformerAttention
+(``deepspeed/ops/deepspeed4science/evoformer_attn.py:88`` — CUTLASS
+fused kernels behind ``EvoformerFusedAttention``): attention over the
+last sequence dim with up to two additive biases,
+
+    softmax(Q K^T / sqrt(d) + bias1 + bias2) V
+
+* ``bias1`` [B, N, 1, 1, Sk] — the MSA/row mask bias (broadcast over
+  heads and queries);
+* ``bias2`` [B, 1, H, Sq, Sk] — the pair-representation bias (broadcast
+  over the N dim).
+
+Shapes follow the reference contract: Q/K/V are [B, N, Sq|Sk, H, D].
+XLA fuses the bias adds into the softmax the same way the CUTLASS
+kernel fuses them into the matmul epilogue; the flash-style LSE/delta
+backward of ``ops/xla_attention.py`` applies verbatim and is reused via
+the same single-exp recompute trick.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _check_biases(Q, K, bias1, bias2):
+    B, N, Sq, H, D = Q.shape
+    Sk = K.shape[2]
+    if bias1 is not None:
+        if (bias1.ndim != 5 or bias1.shape[:2] != (B, N)
+                or bias1.shape[2:4] != (1, 1)
+                or bias1.shape[4] != Sk):
+            raise ValueError(f"bias1 shape {tuple(bias1.shape)} != "
+                             f"[B={B}, N={N}, 1, 1, Sk={Sk}]")
+    if bias2 is not None:
+        if (bias2.ndim != 5 or bias2.shape[0] != B or bias2.shape[1] != 1
+                or bias2.shape[2] != H or bias2.shape[3] != Sq
+                or bias2.shape[4] != Sk):
+            raise ValueError(f"bias2 shape {tuple(bias2.shape)} != "
+                             f"[B={B}, 1, H={H}, Sq={Sq}, Sk={Sk}]")
+
+
+def _logits(Q, K, bias1, bias2, scale):
+    # [B, N, Sq, H, D] x [B, N, Sk, H, D] -> [B, N, H, Sq, Sk]
+    s = jnp.einsum("bnqhd,bnkhd->bnhqk", Q, K) * scale
+    s = s.astype(jnp.float32)
+    if bias1 is not None:
+        # [B, N, 1, 1, Sk] broadcasts over (H, Sq)
+        s = s + bias1.astype(jnp.float32)
+    if bias2 is not None:
+        # [B, 1, H, Sq, Sk] broadcasts over N
+        s = s + bias2.astype(jnp.float32)
+    return s
+
+
+def _fwd(Q, K, V, bias1, bias2, scale):
+    s = _logits(Q, K, bias1, bias2, scale)
+    lse = jax.nn.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None]).astype(Q.dtype)
+    o = jnp.einsum("bnhqk,bnkhd->bnqhd", p, V)
+    return o, lse
+
+
+def _bwd_core(Q, K, V, bias1, bias2, o, lse, do, scale):
+    delta = jnp.einsum("bnqhd,bnqhd->bnhq", do.astype(jnp.float32),
+                       o.astype(jnp.float32))
+    s = _logits(Q, K, bias1, bias2, scale)
+    p = jnp.exp(s - lse[..., None]).astype(Q.dtype)
+    dv = jnp.einsum("bnhqk,bnqhd->bnkhd", p, do)
+    dp = jnp.einsum("bnqhd,bnkhd->bnhqk", do, V)
+    ds = (p.astype(jnp.float32)
+          * (dp.astype(jnp.float32) - delta[..., None]))
+    dq = jnp.einsum("bnhqk,bnkhd->bnqhd",
+                    (ds * scale).astype(Q.dtype), K)
+    dk = jnp.einsum("bnhqk,bnqhd->bnkhd",
+                    (ds * scale).astype(Q.dtype), Q)
+    db1 = ds.sum(axis=(2, 3), keepdims=True) \
+        if bias1 is not None else None              # [B, N, 1, 1, Sk]
+    db2 = ds.sum(axis=1, keepdims=True) \
+        if bias2 is not None else None              # [B, 1, H, Sq, Sk]
+    return dq, dk, dv, db1, db2
+
+
+def _make(variant: str):
+    has1 = "1" in variant
+    has2 = "2" in variant
+
+    @jax.custom_vjp
+    def attn(Q, K, V, *biases):
+        b1 = biases[0] if has1 else None
+        b2 = biases[-1] if has2 else None
+        scale = 1.0 / math.sqrt(Q.shape[-1])
+        o, _ = _fwd(Q, K, V, b1, b2, scale)
+        return o
+
+    def fwd(Q, K, V, *biases):
+        b1 = biases[0] if has1 else None
+        b2 = biases[-1] if has2 else None
+        scale = 1.0 / math.sqrt(Q.shape[-1])
+        o, lse = _fwd(Q, K, V, b1, b2, scale)
+        return o, (Q, K, V, b1, b2, o, lse)
+
+    def bwd(res, do):
+        Q, K, V, b1, b2, o, lse = res
+        scale = 1.0 / math.sqrt(Q.shape[-1])
+        dq, dk, dv, db1, db2 = _bwd_core(Q, K, V, b1, b2, o, lse, do,
+                                         scale)
+        grads = [dq, dk, dv]
+        if has1:
+            grads.append(db1.astype(b1.dtype))
+        if has2:
+            grads.append(db2.astype(b2.dtype))
+        return tuple(grads)
+
+    attn.defvjp(fwd, bwd)
+    return attn
+
+
+_VARIANTS = {v: _make(v) for v in ("", "1", "2", "12")}
+
+
+def evoformer_attention(Q, K, V,
+                        biases: Optional[Sequence] = None) -> jax.Array:
+    """Drop-in for ``DS4Sci_EvoformerAttention(Q, K, V, biases)``
+    (reference: evoformer_attn.py:88): Q/K/V [B, N, S, H, D], up to two
+    additive biases (see module docstring for their shapes)."""
+    biases = [b for b in (biases or []) if b is not None]
+    if len(biases) > 2:
+        raise ValueError("at most two biases")
+    b1 = b2 = None
+    for b in biases:
+        if b.ndim != 5:
+            raise ValueError(
+                f"bias rank {b.ndim} != 5; expected [B, N, 1, 1, Sk] "
+                "(mask bias) or [B, 1, H, Sq, Sk] (pair bias)")
+        if b.shape[2] == 1 and b.shape[3] == 1:
+            if b1 is not None:
+                raise ValueError("two mask-shaped ([B, N, 1, 1, Sk]) "
+                                 "biases passed")
+            b1 = b
+        else:
+            if b2 is not None:
+                raise ValueError("two pair-shaped biases passed — one "
+                                 "must be [B, N, 1, 1, Sk]")
+            b2 = b
+    _check_biases(Q, K, b1, b2)
+    variant = ("1" if b1 is not None else "") + \
+        ("2" if b2 is not None else "")
+    args = [x for x in (b1, b2) if x is not None]
+    return _VARIANTS[variant](Q, K, V, *args)
