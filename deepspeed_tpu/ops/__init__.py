@@ -1,4 +1,21 @@
-from .flash_attention import flash_attention
 from .builder import AsyncIOBuilder, BuildError, OpBuilder
+from .evoformer import evoformer_attention
+from .flash_attention import flash_attention
+from .paged_attention import paged_attention
+from .sparse_attention import (BigBirdSparsityConfig,
+                               BSLongformerSparsityConfig,
+                               DenseSparsityConfig, FixedSparsityConfig,
+                               VariableSparsityConfig,
+                               block_sparse_attention,
+                               make_block_sparse_attention)
+from .xla_attention import fused_attention
 
-__all__ = ["flash_attention", "AsyncIOBuilder", "BuildError", "OpBuilder"]
+__all__ = [
+    "AsyncIOBuilder", "BuildError", "OpBuilder",
+    "evoformer_attention", "flash_attention", "paged_attention",
+    "fused_attention",
+    "BigBirdSparsityConfig", "BSLongformerSparsityConfig",
+    "DenseSparsityConfig", "FixedSparsityConfig",
+    "VariableSparsityConfig", "block_sparse_attention",
+    "make_block_sparse_attention",
+]

@@ -5,7 +5,11 @@ from .lr_schedules import build_schedule, SCHEDULES
 from .loss_scaler import LossScaler, LossScaleState, all_finite
 from .runtime_utils import (global_norm, clip_by_global_norm,
                             partition_balanced, see_memory_usage, param_count)
-from .dataloader import DataLoader, synthetic_lm_data
+from .dataloader import DataLoader, PrefetchingLoader, synthetic_lm_data
+from .data_analyzer import (DataAnalyzer as OfflineDataAnalyzer,
+                            difficulty_buckets, samples_up_to_difficulty)
+from .hybrid_engine import HybridEngine
+from .indexed_dataset import MMapIndexedDataset, MMapIndexedDatasetBuilder
 
 __all__ = [
     "Engine", "TrainState", "initialize",
@@ -15,5 +19,9 @@ __all__ = [
     "LossScaler", "LossScaleState", "all_finite",
     "global_norm", "clip_by_global_norm", "partition_balanced",
     "see_memory_usage", "param_count",
-    "DataLoader", "synthetic_lm_data",
+    "DataLoader", "PrefetchingLoader", "synthetic_lm_data",
+    "OfflineDataAnalyzer", "difficulty_buckets",
+    "samples_up_to_difficulty",
+    "HybridEngine",
+    "MMapIndexedDataset", "MMapIndexedDatasetBuilder",
 ]
