@@ -79,10 +79,7 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r} "
                          "(gpipe | 1f1b)")
-    if cfg.num_experts > 1 and schedule == "1f1b":
-        # the eager-gradient VJP would need an aux-loss cotangent channel
-        raise NotImplementedError(
-            "pipeline + MoE currently supports the gpipe schedule only")
+
     if sp > 1:
         if cfg.num_heads % sp or cfg.num_kv_heads % sp:
             raise ValueError(
@@ -282,14 +279,24 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
         def run_ext(x_in, m):
             i, lbl, msk, am = mb_slice(
                 (ids_mb, labels_mb, mask_mb, amask_mb), m)
-            # (y, contrib) only: MoE (the aux output) is gpipe-only, so
-            # the eager VJP seeds exactly these two cotangents
-            return (lambda b, sh, x: stage_ext(
-                b, sh, x, i, lbl, msk, am, cos, sin, pos0,
-                seq_local)[:2]), msk
+            return lambda b, sh, x: stage_ext(
+                b, sh, x, i, lbl, msk, am, cos, sin, pos0, seq_local)
+
+        # tokens and aux-slot counts are needed BEFORE the schedule so
+        # the eager VJP can seed ALREADY-NORMALIZED cotangents — a single
+        # cotangent chain then carries both the LM and the MoE aux terms
+        # masks are REPLICATED across pipe — count them once per batch
+        # (and seq) shard only
+        tok_global = lax.psum(tgt_mask.sum().astype(jnp.float32),
+                              batch_reduce_axes)
+        inv_tok = 1.0 / jnp.maximum(tok_global, 1.0)
+        # every (stage, microbatch, batch shard) contributes one aux value
+        n_aux = float(M * S * dp * sp)
+        aux_seed = (cfg.aux_loss_coef / n_aux) \
+            if cfg.num_experts > 1 else 0.0
 
         def tick(carry, t):
-            buf_f, buf_b, stash, gb, gsh, loss_sum, tok_sum = carry
+            buf_f, buf_b, stash, gb, gsh, loss_sum, aux_acc = carry
 
             # ---- backward slot (reads stash BEFORE this tick's fwd write)
             m_b = t - 2 * (S - 1) + stage - 1
@@ -297,11 +304,12 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
             m_b_c = jnp.clip(m_b, 0, M - 1)
             x_st = lax.dynamic_index_in_dim(stash, m_b_c % R, 0,
                                             keepdims=False)
-            fn, _ = run_ext(x_st, m_b_c)
+            fn = run_ext(x_st, m_b_c)
             _, pull = jax.vjp(fn, blocks, shared, x_st)
             seed_y = jnp.where(b_active, buf_b, jnp.zeros_like(buf_b))
-            seed_c = jnp.where(b_active & last, 1.0, 0.0)
-            gb_m, gsh_m, x_bar = pull((seed_y.astype(dt), seed_c))
+            seed_c = jnp.where(b_active & last, inv_tok, 0.0)
+            seed_a = jnp.where(b_active, jnp.float32(aux_seed), 0.0)
+            gb_m, gsh_m, x_bar = pull((seed_y.astype(dt), seed_c, seed_a))
             act = b_active.astype(jnp.float32)
             gb = jax.tree.map(lambda a, g: a + act * g.astype(jnp.float32),
                               gb, gb_m)
@@ -313,11 +321,11 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
             m_f = t - stage
             f_active = (m_f >= 0) & (m_f < M)
             m_f_c = jnp.clip(m_f, 0, M - 1)
-            fn_f, msk_f = run_ext(buf_f, m_f_c)
-            y, contrib = fn_f(blocks, shared, buf_f)
+            fn_f = run_ext(buf_f, m_f_c)
+            y, contrib, aux = fn_f(blocks, shared, buf_f)
             valid = last & f_active
             loss_sum = loss_sum + jnp.where(valid, contrib, 0.0)
-            tok_sum = tok_sum + jnp.where(valid, msk_f.sum(), 0.0)
+            aux_acc = aux_acc + jnp.where(f_active, aux, 0.0)
             stash = stash.at[m_f_c % R].set(
                 jnp.where(f_active, buf_f, stash[m_f_c % R]))
 
@@ -332,7 +340,7 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
             buf_b_next = lax.ppermute(x_bar, PIPE_AXIS, perm_up) \
                 if S > 1 else jnp.zeros_like(x_bar)
             return (buf_f_next, buf_b_next, stash, gb, gsh,
-                    loss_sum, tok_sum), None
+                    loss_sum, aux_acc), None
 
         zeros_f32 = lambda tree: jax.tree.map(
             lambda x: jnp.zeros(x.shape, jnp.float32), tree)
@@ -341,17 +349,19 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
         carry0 = (buf0, jnp.zeros_like(buf0), stash0,
                   zeros_f32(blocks), zeros_f32(shared),
                   jnp.float32(0.0), jnp.float32(0.0))
-        (_, _, _, gb, gsh, loss_sum, tok_sum), _ = lax.scan(
+        (_, _, _, gb, gsh, loss_sum, aux_acc), _ = lax.scan(
             tick, carry0, jnp.arange(T2))
 
         # blocks grads: each stage owns its slice — reduce over data axes
         # only; shared grads: reduce over everything incl. pipe (the tied
         # embed/head gradient allreduce of module.py:77)
-        loss_sum = lax.psum(loss_sum, reduce_axes)
-        tok_sum = lax.psum(tok_sum, reduce_axes)
+        loss = lax.psum(loss_sum, reduce_axes) * inv_tok
+        if cfg.num_experts > 1:
+            loss = loss + cfg.aux_loss_coef * \
+                lax.psum(aux_acc, reduce_axes) / n_aux
         gb = jax.tree.map(lambda g: lax.psum(g, batch_reduce_axes), gb)
         gsh = jax.tree.map(lambda g: lax.psum(g, reduce_axes), gsh)
-        return loss_sum, tok_sum, gb, gsh
+        return loss, gb, gsh
 
     def run_sched(params, batch):
         ids = batch["input_ids"]
@@ -367,18 +377,17 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
         blocks, shared = split_params(params)
         blocks_specs = jax.tree.map(lambda _: P(PIPE_AXIS), blocks)
         shared_specs = jax.tree.map(lambda _: P(), shared)
-        loss_sum, tok_sum, gb, gsh = shard_map(
+        loss, gb, gsh = shard_map(
             sched_local, mesh=mesh,
             in_specs=(blocks_specs, shared_specs, data_spec, data_spec,
                       data_spec, data_spec),
-            out_specs=(P(), P(), blocks_specs, shared_specs),
+            out_specs=(P(), blocks_specs, shared_specs),
             check_vma=False)(blocks, shared, ids, labels, tgt_mask, amask)
-        tok = jnp.maximum(tok_sum, 1.0)
         grads = dict(gsh)
         grads["blocks"] = gb
-        # d(loss)/dp where loss = loss_sum / tok (tok is constant in p)
-        grads = jax.tree.map(lambda g: g / tok, grads)
-        return loss_sum / tok, grads
+        # cotangents were seeded pre-normalized (1/tokens for the LM
+        # term, coef/n_aux for MoE) — grads are d(loss)/dp directly
+        return loss, grads
 
     @jax.custom_vjp
     def loss_1f1b(params, batch):
