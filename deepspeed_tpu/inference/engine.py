@@ -28,7 +28,9 @@ from typing import Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..comm.mesh import FSDP_AXIS, MeshTopology, TENSOR_AXIS
 from ..models.transformer import Model, TransformerConfig
 from ..utils.logging import logger
 from .model import ragged_forward
@@ -73,7 +75,19 @@ _PROBE_CACHE: Dict[tuple, str] = {}
 
 
 class InferenceEngine:
-    def __init__(self, model: Model, config: InferenceConfig = None):
+    """Serving engine.  With ``topology`` (a :class:`MeshTopology`), the
+    model is served SPMD over the mesh: weights follow the training-side
+    logical-axis TP rules (Megatron-style head/mlp/vocab splits —
+    reference: ``module_inject/auto_tp.py:189`` ``ReplaceWithTensorSlicing``
+    :30, and the v2 declarative sharding helpers
+    ``inference/v2/model_implementations/sharding/qkv.py``), the paged KV
+    cache is head-split over the ``tensor`` axis, and any ``fsdp`` mesh
+    axis memory-shards weights ZeRO-Inference-style (XLA gathers per
+    use).  GSPMD inserts the per-layer collectives; no imperative tensor
+    slicing."""
+
+    def __init__(self, model: Model, config: InferenceConfig = None,
+                 topology: Optional[MeshTopology] = None):
         self.model = model
         self.cfg: TransformerConfig = model.config
         self.icfg = config or InferenceConfig()
@@ -90,6 +104,8 @@ class InferenceEngine:
             dtype=self.icfg.kv_dtype)
         self.state = StateManager(kv_cfg, max_seqs=self.icfg.max_seqs,
                                   max_blocks_per_seq=self.max_blocks_per_seq)
+        self.topology = topology if (
+            topology is not None and topology.device_count > 1) else None
         self.params = jax.tree.map(
             lambda x: x.astype(self.icfg.param_dtype)
             if x.dtype == jnp.float32 else x, model.params)
@@ -100,8 +116,13 @@ class InferenceEngine:
             self.params, self._quant = quantize_model_params(
                 self.params, bits=WEIGHT_QUANT_BITS[self.icfg.weight_quant],
                 quantize_embeddings=self.icfg.quantize_embeddings)
+        self._setup_sharding()
         if self.icfg.kv_offload:
-            self._offload_kv()
+            if self.topology is not None:
+                logger.warning("kv_offload is single-device only; ignored "
+                               "under a multi-device topology")
+            else:
+                self._offload_kv()
         self._pending: Dict[int, List[int]] = {}   # uid -> unprocessed toks
         self._ctx_exhausted: set = set()
         self._rng = jax.random.PRNGKey(0)
@@ -127,6 +148,98 @@ class InferenceEngine:
             # step/burst closures hold the old quant tree
             self._step_fn = None
             self._burst_fns.clear()
+        self._shard_weights()
+
+    # ------------------------------------------------------------------
+    # SPMD sharding (TP + ZeRO-Inference weight sharding)
+    # ------------------------------------------------------------------
+    def _setup_sharding(self) -> None:
+        """Resolve mesh shardings once: KV head-split + weight specs."""
+        self._repl = None
+        self._kv_nsh = None
+        self._tp_mesh = None
+        topo = self.topology
+        if topo is None:
+            return
+        self._repl = topo.replicated
+        tp = topo.tp_size
+        cfg = self.cfg
+        head_split = (tp > 1 and cfg.num_kv_heads % tp == 0
+                      and cfg.num_heads % tp == 0)
+        # kv: [L, blocks, bs, 2, Hkv, D] — split the kv-head dim
+        kv_spec = P(None, None, None, None,
+                    TENSOR_AXIS if head_split else None)
+        self._kv_nsh = NamedSharding(topo.mesh, kv_spec)
+        if head_split:
+            # the Pallas kernel runs under shard_map, one head group/chip
+            self._tp_mesh = topo.mesh
+        self.state.kv = jax.device_put(self.state.kv, self._kv_nsh)
+        self._kv_shape_dtype = (self.state.kv.shape, self.state.kv.dtype)
+        self._shard_weights()
+
+    def _shard_weights(self) -> None:
+        """Place the (possibly quantized) weight trees on the mesh.
+
+        Dense un-quantized weights use the logical-axis TP rules
+        (parallel/sharding.py — the same specs that shard training), with
+        any ``fsdp`` axis layered on as pure memory sharding (the
+        ZeRO-Inference analog: XLA all-gathers each layer at use).
+        Quantized trees have grouped flat layouts the head rules cannot
+        address, so they are memory-sharded over the largest divisible
+        dim instead."""
+        topo = self.topology
+        if topo is None:
+            return
+        from ..parallel import sharding as shd
+
+        def put(x, spec):
+            return jax.device_put(x, NamedSharding(topo.mesh, spec))
+
+        def generic(tree):
+            """Memory-shard every array leaf: tensor axis first, then
+            fsdp, over whichever large dims divide."""
+            def go(x):
+                if not isinstance(x, (jax.Array, np.ndarray)) \
+                        or np.ndim(x) == 0:
+                    return x
+                spec = shd.add_fsdp_to_spec(P(), x.shape, topo,
+                                            min_size=1 << 14,
+                                            axis=TENSOR_AXIS)
+                spec = shd.add_fsdp_to_spec(spec, x.shape, topo,
+                                            min_size=1 << 14,
+                                            axis=FSDP_AXIS)
+                return put(x, spec)
+            return jax.tree.map(go, tree)
+
+        if self._quant is None:
+            shapes = jax.tree.map(lambda x: tuple(x.shape), self.params)
+            specs = shd.tree_specs(self.model.param_axes, topo,
+                                   shapes=shapes)
+            is_spec = lambda s: isinstance(s, P)   # noqa: E731
+            specs = jax.tree.map(
+                lambda s, x: shd.add_fsdp_to_spec(s, tuple(x.shape), topo,
+                                                  min_size=1 << 14),
+                specs, self.params, is_leaf=is_spec)
+            self.params = jax.tree.map(put, self.params, specs,
+                                       is_leaf=lambda x: isinstance(x, P))
+        else:
+            # dense remainder (norms/biases/embeds) + quantized payloads
+            self.params = generic(self.params)
+            self._quant = generic(self._quant)
+
+    def _stage(self, tree):
+        """Replicate host-built batch metadata onto the mesh."""
+        if self._repl is None:
+            return tree
+        return jax.device_put(tree, self._repl)
+
+    def _kv_zeros(self):
+        """A pristine zero cache with the serving sharding applied."""
+        kv = jnp.zeros(*getattr(self, "_kv_shape_dtype",
+                                (self.state.kv.shape, self.state.kv.dtype)))
+        if self._kv_nsh is not None:
+            kv = jax.device_put(kv, self._kv_nsh)
+        return kv
 
     def _offload_kv(self) -> None:
         """Move the paged KV cache to host memory (ZeRO-Inference KV
@@ -161,11 +274,12 @@ class InferenceEngine:
 
         quant = self._quant
         kv_host = getattr(self, "_kv_on_host", False)
+        shard_mesh = self._tp_mesh
 
         def step(params, kv, batch: RaggedBatch):
             return ragged_forward(cfg, params, kv, batch, bs, mbs,
                                   attn_impl=impl, quant=quant,
-                                  kv_host=kv_host)
+                                  kv_host=kv_host, shard_mesh=shard_mesh)
 
         if kv_host:
             # pin the cache output to host memory so the persistent
@@ -173,6 +287,11 @@ class InferenceEngine:
             out_sh = (None, self.state.kv.sharding)
             return jax.jit(step, donate_argnums=(1,),
                            out_shardings=out_sh)
+        if self._kv_nsh is not None:
+            # logits replicated (one small host fetch), cache keeps its
+            # head-split sharding across the donation
+            return jax.jit(step, donate_argnums=(1,),
+                           out_shardings=(self._repl, self._kv_nsh))
         return jax.jit(step, donate_argnums=(1,))
 
     def _probe_attn_impl(self) -> str:
@@ -187,8 +306,11 @@ class InferenceEngine:
             self.max_blocks_per_seq
         T, ms = self.icfg.token_budget, self.icfg.max_seqs
         nb = self.icfg.num_kv_blocks
+        topo_sig = (None if self.topology is None else
+                    tuple(sorted(self.topology.axis_sizes.items())))
         key = (jax.default_backend(), cfg.num_layers, cfg.d_model,
-               cfg.num_heads, cfg.num_kv_heads, T, ms, bs, nb, mbs)
+               cfg.num_heads, cfg.num_kv_heads, T, ms, bs, nb, mbs,
+               topo_sig, self._tp_mesh is not None)
         cached = _PROBE_CACHE.get(key)
         if cached is not None:
             return cached
@@ -211,6 +333,7 @@ class InferenceEngine:
             context_lens=jnp.full(ms, last_pos + 1, jnp.int32),
             logits_idx=jnp.full(ms, -1, jnp.int32).at[0].set(0),
             n_tokens=T, n_seqs=ms)
+        batch = self._stage(batch)
         results = {}
         # probe on the real (pre-serving, all-zeros) cache with donation,
         # threading the cache through — never two full KV pools live at
@@ -218,12 +341,16 @@ class InferenceEngine:
         kv = self.state.kv
         for impl in ("xla", "pallas"):
             try:
+                jit_kw = {}
+                if self._kv_nsh is not None:
+                    jit_kw["out_shardings"] = (self._repl, self._kv_nsh)
                 f = jax.jit(partial(ragged_forward, cfg, attn_impl=impl,
                                     block_size=bs, max_blocks_per_seq=mbs,
                                     quant=self._quant,
+                                    shard_mesh=self._tp_mesh,
                                     kv_host=getattr(self, "_kv_on_host",
                                                     False)),
-                            donate_argnums=(1,))
+                            donate_argnums=(1,), **jit_kw)
                 logits, kv = f(self.params, kv, batch)
                 jax.block_until_ready(logits)
                 t0 = time.perf_counter()
@@ -235,7 +362,7 @@ class InferenceEngine:
                 logger.warning(f"paged-attention probe: {impl} failed "
                                f"({type(e).__name__}); skipping")
         # restore a pristine zero cache (the probe wrote its fake token)
-        self.state.kv = jnp.zeros(kv.shape, kv.dtype)
+        self.state.kv = self._kv_zeros()
         if getattr(self, "_kv_on_host", False):
             self.state.kv = jax.device_put(self.state.kv,
                                            jax.memory.Space.Host)
@@ -329,7 +456,8 @@ class InferenceEngine:
             return {}
         if self._step_fn is None:
             self._step_fn = self._build_step()
-        batch = self.state.build_batch(sched, self.icfg.token_budget)
+        batch = self._stage(
+            self.state.build_batch(sched, self.icfg.token_budget))
         try:
             logits, self.state.kv = self._step_fn(
                 self.params, self.state.kv, batch)
@@ -395,7 +523,10 @@ class InferenceEngine:
             kv = scatter_tail(kv, tail, block_tables, base_ctx, bs)
             return toks, kv
 
-        return jax.jit(burst, donate_argnums=(1,))
+        jit_kw = {}
+        if self._kv_nsh is not None:
+            jit_kw["out_shardings"] = (self._repl, self._kv_nsh)
+        return jax.jit(burst, donate_argnums=(1,), **jit_kw)
 
     def decode_burst(self, steps: Optional[int] = None,
                      sampling: SamplingParams = SamplingParams(),
@@ -471,8 +602,9 @@ class InferenceEngine:
         if rng is None:
             self._rng, rng = jax.random.split(self._rng)
         toks, self.state.kv = self._burst_fns[key](
-            self.params, self.state.kv, jnp.asarray(tables),
-            jnp.asarray(base), jnp.asarray(tok0), rng)
+            self.params, self.state.kv,
+            self._stage(jnp.asarray(tables)), self._stage(jnp.asarray(base)),
+            self._stage(jnp.asarray(tok0)), self._stage(rng))
         self._steps_done += steps
         toks_np = np.asarray(toks)                     # ONE fetch
         out: Dict[int, List[int]] = {}

@@ -49,13 +49,35 @@ def _write_kv(kv_layer, k, v, batch: RaggedBatch, block_size: int):
 
 def _paged_attention_pallas(kv_layer, q, batch: RaggedBatch,
                             block_size: int, max_blocks_per_seq: int,
-                            scale: float):
+                            scale: float, shard_mesh=None):
     """Pallas streaming kernel behind the same signature
-    (ops/paged_attention.py — reference: blocked_flash)."""
+    (ops/paged_attention.py — reference: blocked_flash).
+
+    With ``shard_mesh`` (TP serving), the kernel runs under ``shard_map``:
+    attention is embarrassingly parallel over heads, so each chip streams
+    only its own head group's KV blocks (kv head-split on the ``tensor``
+    mesh axis) — the TPU analog of the reference's TP-aware blocked_flash
+    dispatch (inference/v2/model_implementations/sharding/attn.py)."""
     from ..ops.paged_attention import paged_attention
-    return paged_attention(kv_layer, q, batch.seq_slot, batch.positions,
-                           batch.block_tables, block_size,
-                           max_blocks_per_seq, scale)
+
+    if shard_mesh is None:
+        return paged_attention(kv_layer, q, batch.seq_slot, batch.positions,
+                               batch.block_tables, block_size,
+                               max_blocks_per_seq, scale)
+    from jax.sharding import PartitionSpec as P
+
+    from ..comm.mesh import TENSOR_AXIS
+
+    kv_spec = P(None, None, None, TENSOR_AXIS, None)  # [blocks,bs,2,Hkv,D]
+    q_spec = P(None, TENSOR_AXIS, None)               # [T, H, D]
+    f = jax.shard_map(
+        lambda kvl, qq, ss, pos, bt: paged_attention(
+            kvl, qq, ss, pos, bt, block_size, max_blocks_per_seq, scale),
+        mesh=shard_mesh,
+        in_specs=(kv_spec, q_spec, P(), P(), P()),
+        out_specs=q_spec, check_vma=False)
+    return f(kv_layer, q, batch.seq_slot, batch.positions,
+             batch.block_tables)
 
 
 def _paged_attention(kv_layer, q, batch: RaggedBatch, block_size: int,
@@ -137,7 +159,8 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
                    rng: Optional[jax.Array] = None,
                    attn_impl: str = "xla",
                    quant=None,
-                   kv_host: bool = False
+                   kv_host: bool = False,
+                   shard_mesh=None,
                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """→ (last_token_logits [max_seqs, vocab], new_kv).
 
@@ -181,9 +204,13 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
         h = norm(lp["ln1"], x)
         q, k, v = _qkv_proj(cfg, ap, h, dt, cos, sin, batch.positions)
         kv_layer = _write_kv(kv_layer, k, v, batch, block_size)
-        attn = (_paged_attention_pallas if attn_impl == "pallas"
-                else _paged_attention)
-        o = attn(kv_layer, q, batch, block_size, max_blocks_per_seq, scale)
+        if attn_impl == "pallas":
+            o = _paged_attention_pallas(kv_layer, q, batch, block_size,
+                                        max_blocks_per_seq, scale,
+                                        shard_mesh=shard_mesh)
+        else:
+            o = _paged_attention(kv_layer, q, batch, block_size,
+                                 max_blocks_per_seq, scale)
         o = jnp.einsum("thk,hkd->td", o, ap["wo"].astype(dt))
         if cfg.attn_bias:
             o = o + ap["bo"].astype(dt)
