@@ -378,6 +378,12 @@ class Config(ConfigModel):
     # sparse_gradients_enabled; runtime/sparse_grads.py) — untied
     # embeddings only (tied heads produce dense vocab gradients)
     sparse_gradients: bool = False
+    # manual-reduction features (qgZ / sparse_gradients / 1-bit) cannot
+    # yet compose with pipeline or sequence parallelism, and sparse+qgZ
+    # conflict.  By default such combinations raise a ConfigError; set
+    # True to degrade to the plain (uncompressed/dense) reduction with a
+    # warning instead
+    allow_feature_degradation: bool = False
     seed: int = C.SEED_DEFAULT
     # loss reported to monitor/scheduler is averaged over data axis
     dump_state: bool = False

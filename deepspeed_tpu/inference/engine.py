@@ -611,10 +611,19 @@ class InferenceEngine:
         for uid in pending:
             slot = st.slot(uid)
             seq_toks = [int(t) for t in toks_np[:, slot]]
+            adv = steps
+            if sampling.stop_token is not None \
+                    and sampling.stop_token in seq_toks:
+                # truncate at the stop token so direct-API callers never
+                # see an over-advanced context: KV rows written = the fed
+                # token + sampled tokens before the stop
+                i = seq_toks.index(sampling.stop_token)
+                seq_toks = seq_toks[:i + 1]
+                adv = i + 1
             st.seqs[uid].tokens.extend(seq_toks)
-            # the burst wrote `steps` KV rows: the fed token + the first
-            # steps-1 sampled ones
-            st.advance(uid, steps)
+            # the burst wrote `steps` KV rows (fed token + first steps-1
+            # sampled); only the pre-stop prefix is committed
+            st.advance(uid, adv)
             self._pending[uid] = []
             out[uid] = seq_toks
         return out
@@ -642,11 +651,14 @@ class InferenceEngine:
                 for u, t in pending.items())
             burst = 1
             if decode_only and self.icfg.decode_burst > 1:
-                room = min(sampling.max_new_tokens - len(done[u])
-                           for u in pending if u in done)
+                # pending uids fed via put() outside this generate() call
+                # have no 'done' row; default=0 forces burst=1 for them
+                room = min((sampling.max_new_tokens - len(done[u])
+                            for u in pending if u in done), default=0)
                 # only burst at the full configured width: a shrinking
                 # tail would mint one compiled program per remaining-K
-                burst = self.icfg.decode_burst                     if room >= self.icfg.decode_burst else 1
+                burst = (self.icfg.decode_burst
+                         if room >= self.icfg.decode_burst else 1)
             if burst > 1:
                 outs = self.decode_burst(burst, sampling=sampling, rng=sub)
             else:

@@ -80,20 +80,34 @@ def _paged_attention_pallas(kv_layer, q, batch: RaggedBatch,
              batch.block_tables)
 
 
+# one-shot gather cap: [T, C, 2, Hkv, D] materializes T*C*2*Hkv*D
+# elements; past this many BYTES the chunked online-softmax path runs
+# instead (bench shapes at GPT-2s blew HBM: 3.2 GB gather -> 18.5 G
+# peak on a 15.75 G v5e — BENCH_r02's probe JaxRuntimeError)
+_ONE_SHOT_GATHER_BYTES = 512 * 1024 * 1024
+
+
 def _paged_attention(kv_layer, q, batch: RaggedBatch, block_size: int,
                      max_blocks_per_seq: int, scale: float):
     """Per-token attention over the owning sequence's context
     (reference kernel: blocked_flash / flash_attn_by_atoms).
 
     q: [T, H, D] → out [T, H, D].  XLA formulation: gather each token's
-    block table (bounded by max_blocks_per_seq), mask by position.  The
+    block table (bounded by max_blocks_per_seq), mask by position.  When
+    the full-context gather would exceed ``_ONE_SHOT_GATHER_BYTES`` the
+    computation streams one KV block at a time with an online-softmax
+    accumulator instead (memory ∝ T·block_size, not T·context).  The
     Pallas streaming variant (``_paged_attention_pallas``) drops in
     behind the same signature; ``InferenceEngine`` probes both.
     """
     T, H, D = q.shape
     Hkv = kv_layer.shape[3]
-    rep = H // Hkv
     C = max_blocks_per_seq * block_size
+    gather_bytes = T * C * 2 * Hkv * D * kv_layer.dtype.itemsize
+    if gather_bytes > _ONE_SHOT_GATHER_BYTES:
+        return _paged_attention_chunked(kv_layer, q, batch, block_size,
+                                        max_blocks_per_seq, scale)
+    rep = H // Hkv
 
     tables = batch.block_tables[batch.seq_slot, :max_blocks_per_seq]  # [T, nb]
     ctx = kv_layer[tables]            # [T, nb, bs, 2, Hkv, D]
@@ -108,6 +122,48 @@ def _paged_attention(kv_layer, q, batch: RaggedBatch, block_size: int,
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     o = jnp.einsum("thrc,tchd->thrd", p, v_ctx)
     return o.reshape(T, H, D)
+
+
+def _paged_attention_chunked(kv_layer, q, batch: RaggedBatch,
+                             block_size: int, max_blocks_per_seq: int,
+                             scale: float):
+    """Streaming XLA paged attention: scan over the block-table columns,
+    gathering ONE context block per step ([T, bs, 2, Hkv, D]) and folding
+    it into an online-softmax accumulator — same numerics as the
+    one-shot softmax, peak memory ∝ T·block_size."""
+    T, H, D = q.shape
+    Hkv = kv_layer.shape[3]
+    rep = H // Hkv
+    bs = block_size
+
+    tables = batch.block_tables[batch.seq_slot, :max_blocks_per_seq]  # [T, nb]
+    qg = q.reshape(T, Hkv, rep, D)
+    offs = jnp.arange(bs)
+
+    def fold(carry, j):
+        m, l, acc = carry
+        blk = tables[:, j]                          # [T] (-1 pad -> trash)
+        ctx = kv_layer[blk]                         # [T, bs, 2, Hkv, D]
+        k, v = ctx[:, :, 0], ctx[:, :, 1]           # [T, bs, Hkv, D]
+        s = jnp.einsum("thrd,tbhd->thrb", qg, k).astype(jnp.float32) * scale
+        cols = j * bs + offs[None, :]               # [1, bs]
+        valid = cols <= batch.positions[:, None]    # [T, bs]
+        s = jnp.where(valid[:, None, None, :], s, -1e30)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        w = jnp.exp(m - m_new)
+        l = l * w + p.sum(axis=-1)
+        pv = jnp.einsum("thrb,tbhd->thrd", p.astype(q.dtype), v)
+        acc = acc * w[..., None] + pv.astype(jnp.float32)
+        return (m_new, l, acc), None
+
+    init = (jnp.full((T, Hkv, rep), -jnp.inf, jnp.float32),
+            jnp.zeros((T, Hkv, rep), jnp.float32),
+            jnp.zeros((T, Hkv, rep, D), jnp.float32))
+    (m, l, acc), _ = jax.lax.scan(
+        fold, init, jnp.arange(max_blocks_per_seq, dtype=jnp.int32))
+    o = acc / jnp.maximum(l, 1e-30)[..., None]
+    return o.reshape(T, H, D).astype(q.dtype)
 
 
 

@@ -533,9 +533,9 @@ class Engine:
         if not self.config.sparse_gradients:
             return ()
         if self.config.zero_optimization.zero_quantized_gradients:
-            logger.warning("sparse_gradients + zero_quantized_gradients: "
-                           "qgZ takes the manual reduction; ignoring "
-                           "sparse_gradients")
+            self._degrade("sparse_gradients + zero_quantized_gradients: "
+                          "qgZ takes the manual reduction; "
+                          "sparse_gradients is dropped")
             return ()
         # tied embeddings feed the unembed projection: the table's grad
         # is DENSE over the vocab and row-capacity truncation would
@@ -548,11 +548,24 @@ class Engine:
             for a in a_flat)
         untied = isinstance(params, dict) and "lm_head" in params
         if has_vocab_table and not untied:
-            logger.warning("sparse_gradients: model ties embeddings (no "
-                           "lm_head leaf) — the vocab-table gradient is "
-                           "dense; ignoring sparse_gradients")
+            self._degrade("sparse_gradients: model ties embeddings (no "
+                          "lm_head leaf) — the vocab-table gradient is "
+                          "dense; sparse_gradients is dropped")
             return ()
         return self._manual_reduce_axes("sparse_gradients")
+
+    def _degrade(self, msg: str) -> None:
+        """Unsupported feature combination: hard error unless the config
+        opts into degradation (``allow_feature_degradation``) — silently
+        weaker training is worse than a loud stop (the reference composes
+        e.g. 1-bit with PP; we do not yet)."""
+        if self.config.allow_feature_degradation:
+            logger.warning(msg)
+            return
+        from ..config.config import ConfigError
+        raise ConfigError(
+            msg + " — set allow_feature_degradation=true to run anyway "
+            "with the plain reduction")
 
     def _manual_reduce_axes(self, feature: str) -> Tuple[str, ...]:
         sizes = self.topology.axis_sizes
@@ -560,8 +573,8 @@ class Engine:
             # both wrap the loss in their own shard_map (pipeline stages /
             # Ulysses all_to_all), which cannot nest inside the manual
             # region
-            logger.warning(f"{feature} is not composable with pipeline "
-                           "or sequence parallelism yet; ignoring")
+            self._degrade(f"{feature} is not composable with pipeline "
+                          "or sequence parallelism yet")
             return ()
         axes = []
         if sizes.get(DATA_AXIS, 1) > 1:
@@ -763,6 +776,16 @@ class Engine:
                                      is_leaf=lambda x: isinstance(x, P))
             from ..parallel.zero import _is_axes
             a_flat = jax.tree.leaves(self.param_axes, is_leaf=_is_axes)
+            # the three trees were flattened independently: a leaf-count
+            # drift (e.g. bare None leaves in user param_axes, which
+            # jax.tree.leaves drops) would silently mis-pair specs with
+            # gradients and apply the wrong reduction
+            if not (len(g_flat) == len(s_flat) == len(a_flat)):
+                raise ValueError(
+                    f"manual-reduction tree mismatch: {len(g_flat)} grads "
+                    f"vs {len(s_flat)} specs vs {len(a_flat)} param_axes "
+                    "leaves (param_axes must annotate every parameter "
+                    "leaf)")
             grads = jax.tree.unflatten(treedef, [
                 reduce_leaf(g, s, a, batch_tokens)
                 for g, s, a in zip(g_flat, s_flat, a_flat)])
@@ -1209,6 +1232,9 @@ class Engine:
     def eval_batch(self, batch, rng: Optional[jax.Array] = None):
         if self._eval_step_fn is None:
             fn = self.eval_fn or self.loss_fn
+            # a pipelined 1F1B loss exposes a forward-only schedule for
+            # evaluation (its primal otherwise pays full fwd+bwd cost)
+            fn = getattr(fn, "eval_fn", fn)
 
             def eval_step(master, batch, rng):
                 cparams = self._compute_params(master)

@@ -336,3 +336,49 @@ class TestDecodeBurst:
                             1: list(range(30, 55))}, sp)
         # both sequences produced tokens until context/pool limits
         assert len(out[0]) > 0 and len(out[1]) > 0
+
+
+class TestChunkedPagedAttention:
+    def test_chunked_matches_one_shot(self, monkeypatch):
+        """Past the gather-bytes cap the XLA path streams one KV block at
+        a time (online softmax); greedy decode must match the one-shot
+        gather exactly (fix for the BENCH_r02 HBM OOM at bench shapes)."""
+        from deepspeed_tpu.inference import model as im
+
+        m = tiny_model()
+        prompt = {0: [5, 17, 99, 3, 42, 7], 1: [11, 2]}
+        sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+        ref = make_fp32_engine(m, attn_impl="xla").generate(
+            {u: list(p) for u, p in prompt.items()}, sp)
+        monkeypatch.setattr(im, "_ONE_SHOT_GATHER_BYTES", 0)
+        chunked = make_fp32_engine(m, attn_impl="xla").generate(
+            {u: list(p) for u, p in prompt.items()}, sp)
+        assert ref == chunked
+
+
+class TestBurstStopToken:
+    def test_direct_burst_truncates_at_stop(self):
+        """Direct decode_burst() callers with a stop_token must not get
+        over-advanced contexts: tokens and seen_tokens stop at the stop
+        token (advisor round-2 finding)."""
+        m = tiny_model()
+        eng = make_fp32_engine(m, decode_burst=4)
+        # prefill
+        eng.put(0, [5, 17, 99])
+        while any(eng._pending.values()):
+            out = eng.step(sampling=SamplingParams(temperature=0.0))
+        first = out[0]
+        before = eng.state.seqs[0].seen_tokens
+        # find what greedy decode produces, pick token #2 as the stop
+        probe = make_fp32_engine(m, decode_burst=4)
+        ref = probe.generate({0: [5, 17, 99]},
+                             SamplingParams(temperature=0.0,
+                                            max_new_tokens=5))
+        stop = ref[0][2]          # fires mid-burst (index 1 of the burst)
+        eng.put(0, [first])
+        out = eng.decode_burst(
+            4, sampling=SamplingParams(temperature=0.0, stop_token=stop))
+        assert out[0][-1] == stop
+        i = out[0].index(stop)
+        # KV rows committed = fed token + sampled tokens before the stop
+        assert eng.state.seqs[0].seen_tokens == before + i + 1

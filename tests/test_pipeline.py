@@ -31,6 +31,21 @@ class TestPipelineParity:
         b = float(eng_dp.eval_batch({"input_ids": ids}))
         assert a == pytest.approx(b, rel=1e-3)
 
+    def test_eval_matches_dp_1f1b(self):
+        """1F1B engines evaluate through the forward-only (gpipe) path
+        (loss_fn.eval_fn); the loss must still match plain DP."""
+        m = build_model("gpt2", vocab_size=128, num_layers=4, d_model=64,
+                        num_heads=4, max_seq_len=32, seed=2)
+        eng_pp = ds.initialize(model=m, config=base_cfg(
+            mesh={"data": 2, "pipe": 4},
+            pipeline={"stages": 4, "num_microbatches": 4,
+                      "schedule": "1f1b"}))
+        eng_dp = ds.initialize(model=m, config=base_cfg(mesh={"data": 8}))
+        ids = np.random.RandomState(0).randint(0, 128, (8, 32))
+        a = float(eng_pp.eval_batch({"input_ids": ids}))
+        b = float(eng_dp.eval_batch({"input_ids": ids}))
+        assert a == pytest.approx(b, rel=1e-3)
+
     def test_training_descends(self):
         m = build_model("gpt2", vocab_size=128, num_layers=4, d_model=64,
                         num_heads=4, max_seq_len=32)

@@ -83,17 +83,24 @@ class TestEngineSparseGradients:
                                    rtol=2e-4, atol=2e-4)
         assert losses[True][-1] < losses[True][0]
 
-    def test_tied_embeddings_warned_and_disabled(self):
-        """Tied models get dense vocab grads; sparse must self-disable."""
+    def test_tied_embeddings_rejected_unless_opted_out(self):
+        """Tied models get dense vocab grads: sparse_gradients is a hard
+        ConfigError by default, and degrades loudly only under
+        allow_feature_degradation."""
+        from deepspeed_tpu.config.config import ConfigError
         from deepspeed_tpu.models import build_model
 
         m = build_model("gpt2", vocab_size=256, num_layers=2, d_model=32,
                         num_heads=4, max_seq_len=16)
-        eng = ds.initialize(model=m, config={
+        base = {
             "train_micro_batch_size_per_device": 2,
             "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
             "sparse_gradients": True,
-            "mesh": {"data": 8}, "steps_per_print": 1000})
+            "mesh": {"data": 8}, "steps_per_print": 1000}
+        with pytest.raises(ConfigError, match="ties embeddings"):
+            ds.initialize(model=m, config=dict(base))
+        eng = ds.initialize(model=m, config=dict(
+            base, allow_feature_degradation=True))
         assert eng._sparse_axes == ()
 
     def test_head_bias_leaf_not_sparse(self):
