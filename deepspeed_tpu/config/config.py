@@ -229,7 +229,22 @@ class PipelineConfig(ConfigModel):
     partition_method: str = "parameters"   # parameters | uniform | type:<regex>
     num_microbatches: int = 0              # 0 => one per pipeline stage
     activation_checkpoint_interval: int = 0
-    schedule: str = "1f1b"                 # 1f1b | gpipe | interleaved
+    # Schedules match the reference's TrainSchedule surface (schedule.py:
+    # 189): gpipe (autodiff backward) and true 1F1B (eager-grad, O(S)
+    # activation memory).  Megatron-style interleaved virtual stages are
+    # deliberately NOT offered: under the lockstep SPMD scan every tick
+    # already executes a full stage-slice of work, so interleaving buys
+    # no bubble reduction here — requesting it is a config error, not a
+    # silent fallback.
+    schedule: str = "1f1b"                 # 1f1b | gpipe
+
+    def __post_init__(self):
+        if self.schedule not in ("1f1b", "gpipe"):
+            raise ConfigError(
+                f"pipeline.schedule must be '1f1b' or 'gpipe', got "
+                f"{self.schedule!r} (interleaved virtual stages are not "
+                "supported: the SPMD lockstep schedule has no bubble for "
+                "them to shrink)")
 
 
 @dataclass

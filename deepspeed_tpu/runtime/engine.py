@@ -35,7 +35,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..comm.mesh import DATA_AXIS, FSDP_AXIS, MeshTopology
 from ..comm.collectives import init_distributed
-from ..config.config import Config, load_config
+from ..config.config import Config, ConfigError, load_config
 from ..parallel.zero import ZeroPolicy
 from ..parallel import sharding as shd
 from ..utils.logging import log_dist, logger
@@ -99,21 +99,54 @@ class Engine:
         self.config = config
         init_distributed()
         hpz = config.zero_optimization.zero_hpz_partition_size
+        mics = config.zero_optimization.mics_shard_size
         mesh_cfg = config.mesh
+        if mics > 0 and hpz > 1:
+            raise ConfigError(
+                "mics_shard_size and zero_hpz_partition_size both bound "
+                "the shard group; set only one")
+
+        def fold_fsdp(mc, group: int, knob: str):
+            """Shrink the fsdp axis to ``group`` and fold the remaining
+            degree into data replicas (copy — the user's config object
+            stays as written)."""
+            if mc.fsdp <= 0:
+                raise ConfigError(
+                    f"{knob} requires an explicit mesh.fsdp size "
+                    "(the full shard degree being bounded)")
+            if mc.fsdp % group:
+                raise ConfigError(f"{knob}={group} must divide "
+                                  f"mesh.fsdp={mc.fsdp}")
+            outer = mc.fsdp // group
+            return dataclasses.replace(
+                mc, fsdp=group,
+                data=mc.data * outer if mc.data > 0 else mc.data)
+
+        if mics > 0:
+            if topology is not None:
+                raise ConfigError(
+                    "mics_shard_size remaps the mesh and cannot be "
+                    "combined with a pre-built topology; pass mesh "
+                    "config instead")
+            # MiCS (reference: runtime/zero/mics.py:64): shard over a
+            # sub-group of mics_shard_size instead of the full DP world —
+            # params, masters AND optimizer state live within the group,
+            # replicated across groups (unlike hpZ, which keeps masters
+            # world-sharded).  Mesh formulation: fsdp shrinks to the
+            # group size, the remaining degree folds into data replicas;
+            # XLA's grad psum over data+fsdp IS the hierarchical
+            # reduce-scatter-then-all-reduce of mics.py:254.
+            # Exception: with offload_optimizer=cpu, masters/moments
+            # world-shard over data x fsdp anyway (host-DRAM
+            # minimization, zero.py master_spec) — the MiCS bound
+            # applies to the DEVICE collectives (compute-param gathers),
+            # which stay within the group either way.
+            mesh_cfg = fold_fsdp(mesh_cfg, mics, "mics_shard_size")
         if topology is None and hpz > 1 and mesh_cfg.fsdp > hpz:
             # hpZ: the gather axis shrinks to the secondary-partition size
             # (intra-slice) and the rest of the requested fsdp degree folds
             # into data; masters still shard over data x fsdp (zero.py).
-            # Work on a copy — the user's config object stays as written.
-            if mesh_cfg.fsdp % hpz:
-                raise ValueError(
-                    f"zero_hpz_partition_size={hpz} must divide "
-                    f"mesh.fsdp={mesh_cfg.fsdp}")
-            outer = mesh_cfg.fsdp // hpz
-            mesh_cfg = dataclasses.replace(
-                mesh_cfg, fsdp=hpz,
-                data=mesh_cfg.data * outer if mesh_cfg.data > 0
-                else mesh_cfg.data)
+            mesh_cfg = fold_fsdp(mesh_cfg, hpz, "zero_hpz_partition_size")
         self.topology = topology or MeshTopology.build(mesh_cfg)
         self.loss_fn = loss_fn
         self.eval_fn = eval_fn

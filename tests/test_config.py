@@ -91,3 +91,13 @@ def test_roundtrip():
     # round-trip through json
     cfg2 = load_config(json.loads(json.dumps(d2)))
     assert cfg2.mesh.fsdp == 4
+
+
+def test_pipeline_interleaved_rejected():
+    """Advertising-but-ignoring a schedule is worse than rejecting it."""
+    from deepspeed_tpu.config.config import ConfigError, load_config
+
+    import pytest
+    with pytest.raises(ConfigError, match="1f1b"):
+        load_config({"train_micro_batch_size_per_device": 1,
+                     "pipeline": {"stages": 2, "schedule": "interleaved"}})

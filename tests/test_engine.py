@@ -67,6 +67,59 @@ class TestZeroStageEquivalence:
         np.testing.assert_allclose(a, b, rtol=1e-5)
 
 
+class TestMiCS:
+    """mics_shard_size bounds the shard group (reference:
+    runtime/zero/mics.py:64): fsdp shrinks to the group size, the rest
+    folds into data replicas; numerics must match plain ZeRO."""
+
+    def test_mics_matches_full_sharding(self):
+        _, ref = run_steps(base_config(mesh={"data": 1, "fsdp": 8},
+                                       zero_optimization={"stage": 3}))
+        _, mics = run_steps(base_config(
+            mesh={"data": 1, "fsdp": 8},
+            zero_optimization={"stage": 3, "mics_shard_size": 2}))
+        np.testing.assert_allclose(mics, ref, rtol=1e-5)
+
+    def test_mics_remaps_mesh_and_master_specs(self):
+        from jax.sharding import PartitionSpec  # noqa: F401
+
+        eng, _ = run_steps(base_config(
+            mesh={"data": 1, "fsdp": 8},
+            zero_optimization={"stage": 3, "mics_shard_size": 2}), n=1)
+        assert eng.topology.axis_sizes["fsdp"] == 2
+        assert eng.topology.axis_sizes["data"] == 4
+        # masters shard within the group only: specs mention fsdp, never
+        # data (replicated across groups — the MiCS memory/comm trade)
+        leaves = jax.tree.leaves(
+            eng.master_shardings,
+            is_leaf=lambda x: hasattr(x, "spec"))
+        flat_axes = set()
+        for sh in leaves:
+            for entry in sh.spec:
+                if isinstance(entry, str):
+                    flat_axes.add(entry)
+                elif entry is not None:
+                    flat_axes.update(entry)
+        assert "fsdp" in flat_axes and "data" not in flat_axes
+
+    def test_mics_conflicts_rejected(self):
+        from deepspeed_tpu.config.config import ConfigError
+
+        with pytest.raises(ConfigError, match="only one"):
+            run_steps(base_config(
+                mesh={"data": 1, "fsdp": 8},
+                zero_optimization={"stage": 3, "mics_shard_size": 2,
+                                   "zero_hpz_partition_size": 2}), n=1)
+        with pytest.raises(ConfigError, match="divide"):
+            run_steps(base_config(
+                mesh={"data": 1, "fsdp": 8},
+                zero_optimization={"stage": 3, "mics_shard_size": 3}), n=1)
+        with pytest.raises(ConfigError, match="explicit mesh.fsdp"):
+            run_steps(base_config(
+                mesh={"data": 2, "fsdp": -1},
+                zero_optimization={"stage": 3, "mics_shard_size": 2}), n=1)
+
+
 class TestGradAccumulation:
     def test_gas_equivalence(self):
         """gas=4 with micro=1 must match gas=1 with micro=4 (same global
