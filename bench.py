@@ -31,6 +31,23 @@ Round-2 profiling notes (jax profiler, per-fusion, on the tunneled v5e):
   Then block-causal decomposition (8 q-blocks, each attending only its
   visible key prefix — upper-triangle block quadrants never computed):
   95.7k -> 105.9k tok/s (46.2% MFU, vs_baseline 0.856).
+
+Round-3 wins (hlo_stats per-fusion profile led here):
+- UNROLL THE LAYER SCAN (scan_unroll=12): the profile showed ~60 ms/step
+  of bitcast_dynamic-update-slice fusions — scan-carry writes of stacked
+  grad accumulators + remat-saved activations — NOT attention. Full
+  unroll removes them: 106.2k -> 117.9k (+11%). Partial unroll=4 is
+  WORSE than scan (97k): the DUS machinery stays but bodies replicate.
+- remat OFF (activations stored, no recompute): +3.5% -> 122.0k. With
+  the unrolled graph batch 32 fits; 40/24 are both slower.
+- Pairwise block-causal backward (dk/dv accumulate per (q,k) block pair,
+  written once per key block; pair blocks S/4): +1% over the prefix-RMW
+  form under unroll (and the RMW form's 8x fp32 prefix adds are gone).
+- Fused-QKV concat matmul: tried, REGRESSES (117.9 -> 107.1k) — the
+  concat + split backward costs more than one wider matmul saves.
+- Residual floor: vocab head ~49 ms/step (matmuls at ~178 TF/s = 90%
+  peak, lse read at HBM floor), attention elementwise ~remaining HBM
+  time. Profile: 263.6 ms/step self-time, 141 Compute + 114 HBM-bound.
 """
 
 import json
@@ -50,8 +67,8 @@ def main():
 
     seq = 1024 if on_tpu else 128
     batch = 32 if on_tpu else 2
-    model = build_model("gpt2", max_seq_len=seq, remat=True,
-                        remat_policy="xla_flash",
+    model = build_model("gpt2", max_seq_len=seq, remat=False,
+                        scan_unroll=12,
                         attention_impl="xla_flash",
                         **({} if on_tpu else
                            dict(num_layers=2, d_model=128, num_heads=4,
@@ -155,8 +172,9 @@ def serving_bench(on_tpu: bool):
     sp = SamplingParams(temperature=0.0, max_new_tokens=1 << 30)
     vocab = model.config.vocab_size
 
-    # warm the compile caches (probe + step) outside the timed region
-    eng.put(-1, list(r.randint(0, vocab, 4)))
+    # warm the compile caches (probe + the prompt-sized context bucket)
+    # outside the timed region
+    eng.put(-1, list(r.randint(0, vocab, prompt_len)))
     while eng.step(sampling=sp).get(-1) is None:
         pass
     eng.flush(-1)

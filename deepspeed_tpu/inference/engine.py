@@ -126,7 +126,7 @@ class InferenceEngine:
         self._pending: Dict[int, List[int]] = {}   # uid -> unprocessed toks
         self._ctx_exhausted: set = set()
         self._rng = jax.random.PRNGKey(0)
-        self._step_fn = None
+        self._step_fns: Dict[int, object] = {}   # per context bucket
         self._burst_fns: Dict[tuple, object] = {}
         self._steps_done = 0
 
@@ -146,7 +146,7 @@ class InferenceEngine:
                 self.params, bits=WEIGHT_QUANT_BITS[self.icfg.weight_quant],
                 quantize_embeddings=self.icfg.quantize_embeddings)
             # step/burst closures hold the old quant tree
-            self._step_fn = None
+            self._step_fns.clear()
             self._burst_fns.clear()
         self._shard_weights()
 
@@ -264,10 +264,17 @@ class InferenceEngine:
             self._kv_on_host = False
 
     # ------------------------------------------------------------------
-    def _build_step(self):
+    def _build_step(self, mbs: Optional[int] = None):
+        """Compile one SplitFuse step bounded to ``mbs`` context blocks.
+
+        Steps are compiled per power-of-two context bucket (like the
+        decode-burst prefix buckets): the XLA attention paths do work
+        proportional to the compiled block bound, so early prefill steps
+        must not pay for the engine's maximum context (the Pallas kernel
+        skips dead blocks dynamically; the dense paths cannot)."""
         cfg = self.cfg
         bs = self.icfg.kv_block_size
-        mbs = self.max_blocks_per_seq
+        mbs = mbs or self.max_blocks_per_seq
         impl = self.icfg.attn_impl
         if impl == "auto":
             impl = self._probe_attn_impl()
@@ -454,12 +461,26 @@ class InferenceEngine:
         sched = self._schedule()
         if not sched:
             return {}
-        if self._step_fn is None:
-            self._step_fn = self._build_step()
+        # context bucket: the compiled block bound covers every scheduled
+        # sequence's post-step context, rounded to a power of two so a
+        # growing context mints O(log) programs, not one per block
+        bs_blk = self.icfg.kv_block_size
+        need = 1
+        for uid, toks in sched:
+            seq = self.state.seqs.get(uid)
+            seen = seq.seen_tokens if seq else 0
+            need = max(need, -(-(seen + len(toks)) // bs_blk))
+        mbs = 1
+        while mbs < need:
+            mbs *= 2
+        mbs = min(mbs, self.max_blocks_per_seq)
+        step_fn = self._step_fns.get(mbs)
+        if step_fn is None:
+            step_fn = self._step_fns[mbs] = self._build_step(mbs)
         batch = self._stage(
             self.state.build_batch(sched, self.icfg.token_budget))
         try:
-            logits, self.state.kv = self._step_fn(
+            logits, self.state.kv = step_fn(
                 self.params, self.state.kv, batch)
         except jax.errors.JaxRuntimeError:
             # degrade to an HBM cache ONLY on the first-ever step (the
@@ -476,8 +497,9 @@ class InferenceEngine:
             # zeros — recreate it
             self.state.kv = jnp.zeros(self.state.kv.shape,
                                       self.state.kv.dtype)
-            self._step_fn = self._build_step()
-            logits, self.state.kv = self._step_fn(
+            self._step_fns.clear()
+            step_fn = self._step_fns[mbs] = self._build_step(mbs)
+            logits, self.state.kv = step_fn(
                 self.params, self.state.kv, batch)
         self._steps_done += 1
         if rng is None and sampling.temperature > 0.0:

@@ -59,6 +59,10 @@ class TransformerConfig:
     # xla (stock softmax autodiff) | xla_flash (flash-style custom VJP in
     # pure XLA, ops/xla_attention.py) | flash (Pallas kernel)
     attention_impl: str = "xla_flash"
+    # layer-scan unroll factor (lax.scan unroll=): >1 trades compile time
+    # for removing per-layer dynamic-update-slice traffic on the scan
+    # carries (profiled at ~20% of a GPT-2s step on v5e)
+    scan_unroll: int = 1
     # --- MoE (reference: deepspeed/moe; presets: mixtral) ----------------
     num_experts: int = 1                      # >1 => every layer is MoE
     moe_top_k: int = 2
@@ -311,7 +315,8 @@ def apply(cfg: TransformerConfig, params, input_ids, mask=None,
         policy = REMAT_POLICIES[cfg.remat_policy]
         body = jax.checkpoint(body, policy=policy() if policy else None)
 
-    x, metrics = jax.lax.scan(body, x, (params["blocks"], layer_rngs))
+    x, metrics = jax.lax.scan(body, x, (params["blocks"], layer_rngs),
+                              unroll=min(cfg.scan_unroll, cfg.num_layers))
     x = _norm(cfg)(params["ln_f"], x)
     if cfg.tie_embeddings:
         logits = x @ params["embed"]["table"].astype(dt).T

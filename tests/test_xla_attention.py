@@ -130,3 +130,33 @@ class TestCrossLength:
         np.testing.assert_allclose(
             np.asarray(fused_attention(q, k, v)),
             np.asarray(causal_attention(q, k, v)), atol=1e-5, rtol=1e-5)
+
+
+def test_block_causal_bwd_bf16_grads_close():
+    """bf16 gradients through the pairwise block-causal backward stay
+    close to the fp32 reference (cross-pair partials accumulate fp32)."""
+    import numpy as np
+    from deepspeed_tpu.models import layers as L
+
+    r = np.random.RandomState(0)
+    B, S, H, D = 2, 64, 4, 16
+    qf = jnp.asarray(r.randn(B, S, H, D), jnp.float32) * 0.5
+    kf = jnp.asarray(r.randn(B, S, H, D), jnp.float32) * 0.5
+    vf = jnp.asarray(r.randn(B, S, H, D), jnp.float32) * 0.5
+
+    def loss_fused(q, k, v):
+        o = fused_attention(q, k, v)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        o = L.causal_attention(q, k, v)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(qf, kf, vf)
+    g_bf = jax.grad(loss_fused, argnums=(0, 1, 2))(
+        qf.astype(jnp.bfloat16), kf.astype(jnp.bfloat16),
+        vf.astype(jnp.bfloat16))
+    for a, b in zip(g_ref, g_bf):
+        np.testing.assert_allclose(np.asarray(a),
+                                   np.asarray(b, np.float32),
+                                   rtol=0.1, atol=0.15)
