@@ -65,8 +65,30 @@ def _index_to_slices(index, shape) -> List[List[int]]:
 # save
 # --------------------------------------------------------------------------
 
+class HostShards:
+    """Host-side snapshot of one (possibly sharded) array — the payload
+    of an async save.  Captures exactly the fragments the synchronous
+    writer would emit (addressable replica-0 shards), so the on-disk
+    layout is identical whichever path wrote it."""
+
+    def __init__(self, arr):
+        self.shape = tuple(np.shape(arr))
+        if isinstance(arr, jax.Array):
+            self.dtype = arr.dtype
+            self.shards = [(shard.index, np.asarray(shard.data))
+                           for shard in arr.addressable_shards
+                           if shard.replica_id == 0]
+        else:
+            a = np.asarray(arr)
+            self.dtype = a.dtype
+            # replicated/host leaf: process 0 writes it whole
+            self.shards = ([(tuple(slice(0, d) for d in self.shape), a)]
+                           if jax.process_index() == 0 else [])
+
+
 def save_tree(tree: Any, ckpt_dir: str, extra_meta: Optional[Dict] = None) -> None:
-    """Write a pytree of (possibly sharded, possibly multi-host) jax arrays."""
+    """Write a pytree of (possibly sharded, possibly multi-host) jax
+    arrays — or of :class:`HostShards` snapshots (async path)."""
     os.makedirs(ckpt_dir, exist_ok=True)
     proc = jax.process_index()
     # re-saving into an existing tag: clear stale fragments/manifests first
@@ -80,6 +102,20 @@ def save_tree(tree: Any, ckpt_dir: str, extra_meta: Optional[Dict] = None) -> No
     entries: Dict[str, Dict] = {}
     frag_n = 0
     for key, leaf in _leaf_paths(tree):
+        if isinstance(leaf, HostShards):
+            frags = []
+            for index, data in leaf.shards:
+                fname = f"p{proc}_{frag_n}.npy"
+                frag_n += 1
+                np.save(os.path.join(ckpt_dir, fname), data)
+                frags.append({"file": fname,
+                              "index": _index_to_slices(index,
+                                                        leaf.shape)})
+            if frags:
+                entries[key] = {"shape": list(leaf.shape),
+                                "dtype": str(leaf.dtype),
+                                "fragments": frags}
+            continue
         arr = jax.numpy.asarray(leaf) if np.isscalar(leaf) else leaf
         shape = tuple(np.shape(arr))
         dtype = str(np.asarray(arr).dtype if not hasattr(arr, "dtype")
@@ -276,6 +312,92 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         with open(os.path.join(save_dir, LATEST), "w") as f:
             f.write(tag)
     log_dist(f"saved checkpoint {ckpt_dir}")
+    return ckpt_dir
+
+
+class AsyncCheckpointSaver:
+    """Background checkpoint writes (reference:
+    ``runtime/checkpoint_engine/nebula_checkpoint_engine.py`` — tier-1
+    async persistence).  The device state is snapshotted to host
+    SYNCHRONOUSLY (donated buffers die at the next step, so the copy
+    cannot be deferred), then serialization and the ``latest`` pointer
+    update run on a worker thread while training continues.  At most one
+    save is in flight; a new submit drains the previous one first."""
+
+    def __init__(self):
+        import atexit
+
+        self._thread = None
+        self._error = None
+        # the final save of a run must land even if the script never
+        # calls wait_checkpoint(): join at interpreter exit (the thread
+        # is non-daemon anyway, but the join also surfaces errors)
+        atexit.register(self._drain_silent)
+
+    def _drain_silent(self):
+        try:
+            self.wait()
+        except BaseException as e:          # best-effort at exit
+            import sys
+            print(f"async checkpoint failed at exit: {e!r}",
+                  file=sys.stderr)
+
+    def submit(self, host_state, ckpt_dir: str, extra: Dict,
+               save_dir: str, tag: str) -> None:
+        import threading
+
+        self.wait()
+
+        def work():
+            try:
+                save_tree(host_state, ckpt_dir, extra_meta=extra)
+                if jax.process_index() == 0:
+                    # written only after every fragment landed — a crash
+                    # mid-save can never point `latest` at a torn tag
+                    with open(os.path.join(save_dir, LATEST), "w") as f:
+                        f.write(tag)
+                log_dist(f"async-saved checkpoint {ckpt_dir}")
+            except BaseException as e:          # surfaced on next wait()
+                self._error = e
+
+        self._thread = threading.Thread(target=work, daemon=False,
+                                        name="async-ckpt")
+        self._thread.start()
+
+    def wait(self) -> None:
+        """Join the in-flight save; re-raises its failure, if any."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+
+def save_checkpoint_async(engine, saver: AsyncCheckpointSaver,
+                          save_dir: str, tag: Optional[str] = None,
+                          client_state: Optional[Dict] = None) -> str:
+    """Non-blocking variant of :func:`save_checkpoint` (single-host:
+    save_tree's multi-host barriers are device collectives that would
+    race the training stream from a worker thread)."""
+    if jax.process_count() > 1:
+        raise RuntimeError("async checkpoint saves are single-host; "
+                           "multi-host runs must save synchronously")
+    tag = tag or f"global_step{engine.global_steps}"
+    ckpt_dir = os.path.join(save_dir, tag)
+    extra = {
+        "global_steps": engine.global_steps,
+        "global_samples": engine.global_samples,
+        "zero_stage": engine.zero.stage,
+        "precision": engine.precision,
+        "mesh": dict(engine.topology.axis_sizes),
+        "client_state": client_state or {},
+    }
+    # host snapshot of this process's addressable shards; fragments are
+    # written from these, so the device buffers are free immediately
+    # (the next step's donation would invalidate them)
+    host_state = jax.tree.map(HostShards, engine.state)
+    saver.submit(host_state, ckpt_dir, extra, save_dir, tag)
     return ckpt_dir
 
 

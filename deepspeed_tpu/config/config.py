@@ -254,6 +254,92 @@ class TensorParallelConfig(ConfigModel):
     auto: bool = True
 
 
+# --------------------------------------------------------------------------
+# Data efficiency (reference: runtime/data_pipeline/config.py +
+# legacy curriculum_learning engine hooks runtime/engine.py:288)
+# --------------------------------------------------------------------------
+
+@dataclass
+class CurriculumLearningConfig(ConfigModel):
+    """Seqlen curriculum (reference: curriculum_scheduler.py; engine
+    truncates each batch to the scheduled difficulty).  NOTE: on TPU
+    every distinct difficulty value compiles one program — pick
+    ``difficulty_step`` in ``schedule_config`` coarse (e.g. 64+)."""
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"    # fixed_linear|fixed_root|fixed_discrete
+    schedule_config: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class RandomLTDConfig(ConfigModel):
+    """Random layerwise token dropping (reference:
+    data_routing/basic_layer.py + scheduler).  ``seq_per_step`` also
+    bounds compiled program count — each kept-token value is one
+    program."""
+    enabled: bool = False
+    min_value: int = 128                   # starting kept tokens
+    max_value: int = 0                     # 0 => the batch's full seqlen
+    require_steps: int = 1000              # steps to anneal to max_value
+    seq_per_step: int = 64
+
+
+@dataclass
+class DataRoutingConfig(ConfigModel):
+    enabled: bool = False
+    random_ltd: RandomLTDConfig = field(default_factory=RandomLTDConfig)
+
+
+@dataclass
+class DataSamplingConfig(ConfigModel):
+    enabled: bool = False
+    curriculum_learning: CurriculumLearningConfig = field(
+        default_factory=CurriculumLearningConfig)
+
+
+@dataclass
+class DataEfficiencyConfig(ConfigModel):
+    """(reference: data_efficiency config block, data_pipeline/config.py)."""
+    enabled: bool = False
+    data_sampling: DataSamplingConfig = field(
+        default_factory=DataSamplingConfig)
+    data_routing: DataRoutingConfig = field(default_factory=DataRoutingConfig)
+
+
+@dataclass
+class PLDConfig(ConfigModel):
+    """Progressive layer drop (reference: progressive_layer_drop.py;
+    theta(t) = (1-theta)·exp(-gamma·t)+theta)."""
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
+@dataclass
+class EigenvalueConfig(ConfigModel):
+    """(reference: runtime/eigenvalue.py — paces MoQ bit reduction)."""
+    enabled: bool = False
+    max_iter: int = 20
+    tol: float = 1e-2
+    stability: float = 1e-6
+
+
+@dataclass
+class QuantizeTrainingConfig(ConfigModel):
+    """MoQ quantize-aware training (reference: runtime/quantize.py
+    Quantizer — progressive fake-quant of 2-D+ weights in the forward,
+    bits halving each ``quantize_period`` until ``target_bits``;
+    optionally paced by the Hessian eigenvalue)."""
+    enabled: bool = False
+    start_bits: int = 16
+    target_bits: int = 8
+    quantize_period: int = 1000
+    quantize_groups: int = 1
+    eigenvalue: EigenvalueConfig = field(default_factory=EigenvalueConfig)
+
+
 @dataclass
 class SequenceParallelConfig(ConfigModel):
     """(reference: deepspeed/sequence/layer.py — Ulysses)."""
@@ -335,6 +421,27 @@ class WandbConfig(ConfigModel):
 
 
 @dataclass
+class CheckpointConfig(ConfigModel):
+    """(reference: checkpoint_engine config — nebula's tier-1 async
+    persistence maps to a background fragment writer here)."""
+    async_save: bool = False
+
+
+@dataclass
+class CometConfig(ConfigModel):
+    """(reference: monitor/config.py CometConfig)."""
+    enabled: bool = False
+    samples_log_interval: int = 100
+    project: Optional[str] = None
+    workspace: Optional[str] = None
+    api_key: Optional[str] = None
+    experiment_name: Optional[str] = None
+    experiment_key: Optional[str] = None
+    online: bool = True
+    mode: str = "create"                 # create | get | get_or_create
+
+
+@dataclass
 class AioConfig(ConfigModel):
     """Native async-IO layer knobs (reference: csrc/aio, op config read at
     swap_tensor/partitioned_param_swapper.py:83)."""
@@ -403,6 +510,16 @@ class Config(ConfigModel):
     # loss reported to monitor/scheduler is averaged over data axis
     dump_state: bool = False
 
+    # data efficiency family: legacy top-level curriculum (reference
+    # engine.py:288) + the nested data_efficiency block, PLD and MoQ
+    curriculum_learning: CurriculumLearningConfig = field(
+        default_factory=CurriculumLearningConfig)
+    data_efficiency: DataEfficiencyConfig = field(
+        default_factory=DataEfficiencyConfig)
+    progressive_layer_drop: PLDConfig = field(default_factory=PLDConfig)
+    quantize_training: QuantizeTrainingConfig = field(
+        default_factory=QuantizeTrainingConfig)
+
     optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
     scheduler: Optional[SchedulerConfig] = None
     fp16: FP16Config = field(default_factory=FP16Config)
@@ -420,8 +537,9 @@ class Config(ConfigModel):
     tensorboard: TensorBoardConfig = field(default_factory=TensorBoardConfig)
     csv_monitor: CSVConfig = field(default_factory=CSVConfig)
     wandb: WandbConfig = field(default_factory=WandbConfig)
-    aio: AioConfig = field(default_factory=AioConfig)
+    comet: CometConfig = field(default_factory=CometConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    aio: AioConfig = field(default_factory=AioConfig)
     data_types: DataTypesConfig = field(default_factory=DataTypesConfig)
     elasticity: ElasticityConfig = field(default_factory=ElasticityConfig)
 

@@ -62,6 +62,12 @@ class InferenceConfig:
     # keep the paged KV cache in host memory, streaming one layer per
     # scan step through HBM (over-HBM contexts; needs pinned_host)
     kv_offload: bool = False
+    # NVMe per-layer weight streaming (reference:
+    # partitioned_param_swapper.py:290 / ZeRO-Inference NVMe): directory
+    # to spill the per-layer (quantized, when weight_quant is set)
+    # payloads; the forward fetches one layer at a time via io_callback,
+    # so HBM never holds the block weights. Disables decode bursts.
+    weight_stream: Optional[str] = None
     # device-side decode bursts: run K decode iterations in ONE dispatch
     # (sampled tokens fed back on-device via lax.scan), amortizing the
     # host round trip over K tokens.  1 disables.  Sequences that hit
@@ -87,7 +93,14 @@ class InferenceEngine:
     slicing."""
 
     def __init__(self, model: Model, config: InferenceConfig = None,
-                 topology: Optional[MeshTopology] = None):
+                 topology: Optional[MeshTopology] = None,
+                 quant_tree=None):
+        """``quant_tree``: a pre-built ZeRO-Inference quantized tree (the
+        second output of ``quantization.quantize_model_params``, e.g.
+        loaded from a quantized checkpoint) — ``model.params`` must then
+        be the matching dense remainder, and ``weight_quant`` is not
+        re-applied (the >HBM big-model flow: nothing dense ever
+        materializes)."""
         self.model = model
         self.cfg: TransformerConfig = model.config
         self.icfg = config or InferenceConfig()
@@ -110,13 +123,20 @@ class InferenceEngine:
             lambda x: x.astype(self.icfg.param_dtype)
             if x.dtype == jnp.float32 else x, model.params)
         self._quant = None
-        if self.icfg.weight_quant:
+        if quant_tree is not None:
+            self._quant = quant_tree
+        elif self.icfg.weight_quant:
             from .quantization import quantize_model_params
             from ..ops.quant import WEIGHT_QUANT_BITS
             self.params, self._quant = quantize_model_params(
                 self.params, bits=WEIGHT_QUANT_BITS[self.icfg.weight_quant],
                 quantize_embeddings=self.icfg.quantize_embeddings)
+        self._stream = None
+        if self.icfg.weight_stream:
+            self._setup_weight_stream()
         self._setup_sharding()
+        if self.topology is None:
+            self._place_default_device()
         if self.icfg.kv_offload:
             if self.topology is not None:
                 logger.warning("kv_offload is single-device only; ignored "
@@ -136,6 +156,10 @@ class InferenceEngine:
         Re-applies the serving cast AND re-quantizes under weight_quant —
         the step closure captures the quantized tree, so merely assigning
         ``self.params`` would keep serving the old quantized weights."""
+        if self._stream is not None:
+            raise NotImplementedError(
+                "refresh_params under weight_stream: re-spill the store "
+                "by rebuilding the engine")
         self.params = jax.tree.map(
             lambda x: x.astype(self.icfg.param_dtype)
             if x.dtype == jnp.float32 else x, params)
@@ -227,6 +251,60 @@ class InferenceEngine:
             self.params = generic(self.params)
             self._quant = generic(self._quant)
 
+    def _setup_weight_stream(self) -> None:
+        """Spill per-layer block weights (quantized payloads under
+        weight_quant) to the NVMe store; the forward streams them back
+        one layer at a time.  HBM then holds: embeddings/head/norms, the
+        KV cache, and ONE layer's weights."""
+        if self.topology is not None:
+            raise ValueError("weight_stream is single-device (io_callback "
+                             "does not compose with SPMD meshes yet)")
+        from .weight_stream import NVMeWeightStore
+
+        store = NVMeWeightStore(self.icfg.weight_stream,
+                                self.cfg.num_layers)
+        record: Dict[str, object] = {"dense": self.params.pop("blocks")}
+        store.qmeta = None
+        if self._quant is not None and self._quant.get("blocks"):
+            qblocks = self._quant["blocks"]
+            self._quant = {**self._quant, "blocks": {}}
+            qarrays, qmeta = {}, {}
+            for gname, grp in qblocks.items():
+                qarrays[gname], qmeta[gname] = {}, {}
+                for name, qt in grp.items():
+                    a = {"data": qt.data, "scale": qt.scale}
+                    if qt.zero is not None:
+                        a["zero"] = qt.zero
+                    qarrays[gname][name] = a
+                    qmeta[gname][name] = (qt.bits, qt.shape[1:], qt.dtype)
+            record["quant"] = qarrays
+            store.qmeta = qmeta
+        store.spill(record)
+        self._stream = store
+        if self.icfg.decode_burst > 1:
+            logger.warning("weight_stream: decode bursts need resident "
+                           "weights; forcing decode_burst=1")
+            self.icfg = dataclasses.replace(self.icfg, decode_burst=1)
+
+    def _place_default_device(self) -> None:
+        """Ship weights to the serving device if they were built on
+        another backend — the ZeRO-Inference big-model flow: a model too
+        large to materialize dense in HBM is initialized/loaded and
+        group-quantized ON HOST (``jax.default_device(cpu)``), and only
+        the int8/int4 payloads ever reach the chip (reference:
+        inference/quantization — quantize-then-place)."""
+        dev = jax.devices()[0]
+
+        def to_dev(x):
+            if isinstance(x, jax.Array) and x.committed and \
+                    next(iter(x.devices())).platform != dev.platform:
+                return jax.device_put(x, dev)
+            return x
+
+        self.params = jax.tree.map(to_dev, self.params)
+        if self._quant is not None:
+            self._quant = jax.tree.map(to_dev, self._quant)
+
     def _stage(self, tree):
         """Replicate host-built batch metadata onto the mesh."""
         if self._repl is None:
@@ -279,27 +357,32 @@ class InferenceEngine:
         if impl == "auto":
             impl = self._probe_attn_impl()
 
-        quant = self._quant
         kv_host = getattr(self, "_kv_on_host", False)
         shard_mesh = self._tp_mesh
+        stream = self._stream
 
-        def step(params, kv, batch: RaggedBatch):
+        # NOTE: the quant tree is a jit ARGUMENT, never a closure —
+        # closed-over trees bake into the HLO as constants (7.5 GB of
+        # captured constants for llama3-8b int8, which killed the remote
+        # compile); as an argument it is device buffers, like params
+        def step(params, quant, kv, batch: RaggedBatch):
             return ragged_forward(cfg, params, kv, batch, bs, mbs,
                                   attn_impl=impl, quant=quant,
-                                  kv_host=kv_host, shard_mesh=shard_mesh)
+                                  kv_host=kv_host, shard_mesh=shard_mesh,
+                                  stream=stream)
 
         if kv_host:
             # pin the cache output to host memory so the persistent
             # state never round-trips through HBM between steps
             out_sh = (None, self.state.kv.sharding)
-            return jax.jit(step, donate_argnums=(1,),
+            return jax.jit(step, donate_argnums=(2,),
                            out_shardings=out_sh)
         if self._kv_nsh is not None:
             # logits replicated (one small host fetch), cache keeps its
             # head-split sharding across the donation
-            return jax.jit(step, donate_argnums=(1,),
+            return jax.jit(step, donate_argnums=(2,),
                            out_shardings=(self._repl, self._kv_nsh))
-        return jax.jit(step, donate_argnums=(1,))
+        return jax.jit(step, donate_argnums=(2,))
 
     def _probe_attn_impl(self) -> str:
         """Time one ragged forward per implementation on the real compiled
@@ -351,18 +434,20 @@ class InferenceEngine:
                 jit_kw = {}
                 if self._kv_nsh is not None:
                     jit_kw["out_shardings"] = (self._repl, self._kv_nsh)
-                f = jax.jit(partial(ragged_forward, cfg, attn_impl=impl,
-                                    block_size=bs, max_blocks_per_seq=mbs,
-                                    quant=self._quant,
-                                    shard_mesh=self._tp_mesh,
-                                    kv_host=getattr(self, "_kv_on_host",
-                                                    False)),
-                            donate_argnums=(1,), **jit_kw)
-                logits, kv = f(self.params, kv, batch)
+
+                def probe_step(params, quant, pkv, pbatch, _impl=impl):
+                    return ragged_forward(
+                        cfg, params, pkv, pbatch, bs, mbs,
+                        attn_impl=_impl, quant=quant,
+                        shard_mesh=self._tp_mesh, stream=self._stream,
+                        kv_host=getattr(self, "_kv_on_host", False))
+
+                f = jax.jit(probe_step, donate_argnums=(2,), **jit_kw)
+                logits, kv = f(self.params, self._quant, kv, batch)
                 jax.block_until_ready(logits)
                 t0 = time.perf_counter()
                 for _ in range(3):
-                    logits, kv = f(self.params, kv, batch)
+                    logits, kv = f(self.params, self._quant, kv, batch)
                 float(jnp.sum(logits))      # completion barrier
                 results[impl] = time.perf_counter() - t0
             except Exception as e:          # Mosaic unavailable/failed
@@ -481,7 +566,7 @@ class InferenceEngine:
             self.state.build_batch(sched, self.icfg.token_budget))
         try:
             logits, self.state.kv = step_fn(
-                self.params, self.state.kv, batch)
+                self.params, self._quant, self.state.kv, batch)
         except jax.errors.JaxRuntimeError:
             # degrade to an HBM cache ONLY on the first-ever step (the
             # backend compiled but cannot execute in-program host
@@ -500,7 +585,7 @@ class InferenceEngine:
             self._step_fns.clear()
             step_fn = self._step_fns[mbs] = self._build_step(mbs)
             logits, self.state.kv = step_fn(
-                self.params, self.state.kv, batch)
+                self.params, self._quant, self.state.kv, batch)
         self._steps_done += 1
         if rng is None and sampling.temperature > 0.0:
             self._rng, rng = jax.random.split(self._rng)
@@ -532,12 +617,13 @@ class InferenceEngine:
 
         cfg = self.cfg
         bs = self.icfg.kv_block_size
-        quant = self._quant
 
         def sample_fn(logits, r):
             return sample(logits, sampling, r)
 
-        def burst(params, kv, block_tables, base_ctx, token0, rng):
+        # quant is a jit argument (closure capture would bake the whole
+        # quantized model into the HLO as constants — see _build_step)
+        def burst(params, quant, kv, block_tables, base_ctx, token0, rng):
             prefix = snapshot_prefix(kv, block_tables, P, bs)
             toks, tail = decode_burst_forward(
                 cfg, params, prefix, base_ctx, token0, steps, sample_fn,
@@ -548,7 +634,7 @@ class InferenceEngine:
         jit_kw = {}
         if self._kv_nsh is not None:
             jit_kw["out_shardings"] = (self._repl, self._kv_nsh)
-        return jax.jit(burst, donate_argnums=(1,), **jit_kw)
+        return jax.jit(burst, donate_argnums=(2,), **jit_kw)
 
     def decode_burst(self, steps: Optional[int] = None,
                      sampling: SamplingParams = SamplingParams(),
@@ -569,8 +655,10 @@ class InferenceEngine:
             raise ValueError("decode_burst requires every pending request "
                              "to be a single-token continuation; use "
                              "step() for prefill")
-        if getattr(self, "_kv_on_host", False):
-            # bursts need the cache addressable on device
+        if getattr(self, "_kv_on_host", False) or self._stream is not None:
+            # bursts need the cache addressable on device and the block
+            # weights resident (streamed layers cannot feed the burst
+            # scan) — degrade to single steps
             out = self.step(rng=rng, sampling=sampling)
             return {u: [t] for u, t in out.items()}
         # cap the burst by context headroom, then reserve its KV blocks
@@ -624,7 +712,7 @@ class InferenceEngine:
         if rng is None:
             self._rng, rng = jax.random.split(self._rng)
         toks, self.state.kv = self._burst_fns[key](
-            self.params, self.state.kv,
+            self.params, self._quant, self.state.kv,
             self._stage(jnp.asarray(tables)), self._stage(jnp.asarray(base)),
             self._stage(jnp.asarray(tok0)), self._stage(rng))
         self._steps_done += steps

@@ -168,6 +168,25 @@ def _paged_attention_chunked(kv_layer, q, batch: RaggedBatch,
 
 
 
+def _stream_layer(stream, li, dt):
+    """Fetch layer ``li``'s weights from the NVMe store (host callback)
+    and dequantize any streamed quantized payloads on device."""
+    rec = stream.fetch_layer(li)
+    lp = {k: (dict(v) if isinstance(v, dict) else v)
+          for k, v in rec["dense"].items()}
+    if "quant" in rec:
+        from ..ops.quant import QuantizedTensor, dequantize_any
+        for gname, grp in rec["quant"].items():
+            g = dict(lp.get(gname, {}))
+            for name, arrs in grp.items():
+                bits, shp, odt = stream.qmeta[gname][name]
+                qt = QuantizedTensor(arrs["data"], arrs["scale"],
+                                     arrs.get("zero"), bits, shp, odt)
+                g[name] = dequantize_any(qt, dt)
+            lp[gname] = g
+    return lp
+
+
 def _qkv_proj(cfg, ap, h, dt, cos, sin, positions):
     """Shared qkv projection + biases + rotary for the serving forwards
     (ragged step and decode burst)."""
@@ -217,6 +236,7 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
                    quant=None,
                    kv_host: bool = False,
                    shard_mesh=None,
+                   stream=None,
                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """→ (last_token_logits [max_seqs, vocab], new_kv).
 
@@ -229,6 +249,10 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
     ``kv_host``: the cache lives in host memory; each scan step streams
     one layer through HBM and writes it back (ZeRO-Inference KV offload)
     so device memory holds a single layer's KV at a time.
+    ``stream``: an :class:`~.weight_stream.NVMeWeightStore` — the layer
+    scan fetches each layer's (possibly quantized) weights from NVMe via
+    ``io_callback`` so HBM holds one layer's weights at a time
+    (reference: partitioned_param_swapper.py:290 / ZeRO-Inference NVMe).
     """
     if quant is not None:
         from .quantization import merge_layer
@@ -251,7 +275,11 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
         cos, sin = L.rope_freqs(cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta)
 
     def block(x, xs):
-        lp, kv_layer, li = xs
+        if stream is None:
+            lp, kv_layer, li = xs
+        else:
+            kv_layer, li = xs
+            lp = _stream_layer(stream, li, dt)
         if kv_host:
             kv_layer = jax.device_put(kv_layer, jax.memory.Space.Device)
         if quant is not None:
@@ -281,9 +309,12 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
             return x + o + d, kv_layer
         return x + d, kv_layer
 
-    x, new_kv = jax.lax.scan(
-        block, x, (params["blocks"], kv,
-                   jnp.arange(cfg.num_layers, dtype=jnp.int32)))
+    layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+    if stream is None:
+        x, new_kv = jax.lax.scan(block, x,
+                                 (params["blocks"], kv, layer_ids))
+    else:
+        x, new_kv = jax.lax.scan(block, x, (kv, layer_ids))
 
     # logits only at each sequence's last scheduled token
     # (reference kernel: gather_for_logits / logits_gather)

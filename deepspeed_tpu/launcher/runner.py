@@ -221,9 +221,47 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--launcher", type=str, default="ssh",
                    choices=sorted(RUNNERS))
     p.add_argument("--force_multi", action="store_true")
+    # elastic agent (reference: elasticity/elastic_agent.py:32
+    # DSElasticAgent; runner.py:383 --elastic_training): when any node
+    # process dies, the whole worker group is torn down and relaunched —
+    # the training script resumes from its latest (universal) checkpoint
+    p.add_argument("--elastic_training", "--elastic", action="store_true",
+                   dest="elastic_training")
+    p.add_argument("--max_elastic_restarts", type=int, default=100)
     p.add_argument("user_script", type=str)
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p
+
+
+def _run_group(runner: MultiNodeRunner) -> int:
+    """Launch one worker group and babysit it: returns 0 when every node
+    process exits clean; on the FIRST failure the surviving processes are
+    torn down (the reference agent's stop-workers step) and the failing
+    rc is returned."""
+    import time as _time
+
+    procs = [subprocess.Popen(cmd) for _, cmd in runner.launch_cmds()]
+    try:
+        while True:
+            rcs = [p.poll() for p in procs]
+            bad = [rc for rc in rcs if rc not in (None, 0)]
+            if bad:
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                for p in procs:
+                    p.wait()
+                return bad[0]
+            if all(rc == 0 for rc in rcs):
+                return 0
+            _time.sleep(0.2)
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            p.wait()
+        raise
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -244,16 +282,39 @@ def main(argv: Optional[List[str]] = None) -> int:
     logger.info("launching on %d host(s) via %s: %s",
                 len(hosts), runner.name, list(hosts))
 
-    procs = [subprocess.Popen(cmd) for _, cmd in runner.launch_cmds()]
-    rc = 0
+    if not args.elastic_training:
+        procs = [subprocess.Popen(cmd)
+                 for _, cmd in runner.launch_cmds()]
+        rc = 0
+        try:
+            for p in procs:
+                rc = p.wait() or rc
+        except KeyboardInterrupt:
+            for p in procs:
+                p.terminate()
+            rc = 1
+        return rc
     try:
-        for p in procs:
-            rc = p.wait() or rc
+        # elastic: relaunch the worker group until it exits clean or the
+        # restart budget runs out (reference: DSElasticAgent._invoke_run
+        # monitor/restart loop); resumption happens inside the user
+        # script via its latest checkpoint
+        attempt = 0
+        while True:
+            rc = _run_group(runner)
+            if rc == 0:
+                return 0
+            attempt += 1
+            if attempt > args.max_elastic_restarts:
+                logger.error("elastic: restart budget exhausted "
+                             "(%d); giving up with rc=%d",
+                             args.max_elastic_restarts, rc)
+                return rc
+            logger.warning("elastic: worker group failed (rc=%d); "
+                           "restart %d/%d", rc, attempt,
+                           args.max_elastic_restarts)
     except KeyboardInterrupt:
-        for p in procs:
-            p.terminate()
-        rc = 1
-    return rc
+        return 1
 
 
 if __name__ == "__main__":

@@ -71,6 +71,9 @@ class TransformerConfig:
     min_capacity: int = 4
     noise_policy: Optional[str] = None        # None | Jitter | RSample
     aux_loss_coef: float = 0.01
+    # scatter (capacity, EP-shardable) | einsum (GShard dense masks) |
+    # ragged (dropless megablox grouped GEMM via lax.ragged_dot)
+    moe_dispatch: str = "scatter"
 
     def __post_init__(self):
         if self.num_kv_heads is None:
@@ -231,9 +234,11 @@ def _norm(cfg):
 
 def block_apply(cfg: TransformerConfig, lp, x, cos, sin,
                 mask=None, attention_fn: Callable = L.causal_attention,
-                rng=None):
+                rng=None, positions=None):
     """One decoder layer. lp: this layer's (unstacked) params.
-    x: [B, S, dm].  Returns (x, metrics) — metrics non-empty for MoE."""
+    x: [B, S, dm].  ``positions``: optional [B, S] original token
+    positions (random-LTD gathered subsequences keep their rotary
+    phases).  Returns (x, metrics) — metrics non-empty for MoE."""
     norm = _norm(cfg)
     act = L.ACTIVATIONS[cfg.activation]
     ap = lp["attn"]
@@ -248,8 +253,8 @@ def block_apply(cfg: TransformerConfig, lp, x, cos, sin,
         k = k + ap["bk"].astype(dt)
         v = v + ap["bv"].astype(dt)
     if cfg.position == "rope":
-        q = L.apply_rope(q, cos, sin)
-        k = L.apply_rope(k, cos, sin)
+        q = L.apply_rope(q, cos, sin, positions=positions)
+        k = L.apply_rope(k, cos, sin, positions=positions)
     o = attention_fn(q, k, v, mask=mask)
     o = jnp.einsum("bshk,hkd->bsd", o, ap["wo"].astype(dt))
     if cfg.attn_bias:
@@ -267,7 +272,8 @@ def block_apply(cfg: TransformerConfig, lp, x, cos, sin,
             lp["gate"], lp["experts"], h, top_k=cfg.moe_top_k,
             capacity_factor=cfg.capacity_factor,
             min_capacity=cfg.min_capacity, activation=act,
-            gated=cfg.gated_mlp, rng=rng, noise_policy=cfg.noise_policy)
+            gated=cfg.gated_mlp, rng=rng, noise_policy=cfg.noise_policy,
+            dispatch_mode=cfg.moe_dispatch)
     else:
         mp = lp["mlp"]
         u = h @ mp["wi"].astype(dt)
@@ -287,10 +293,19 @@ def block_apply(cfg: TransformerConfig, lp, x, cos, sin,
 
 def apply(cfg: TransformerConfig, params, input_ids, mask=None,
           attention_fn: Callable = L.causal_attention,
-          dtype=None, rng=None, with_aux: bool = False):
+          dtype=None, rng=None, with_aux: bool = False,
+          pld_theta=None, ltd_keep: Optional[int] = None):
     """Forward pass → logits [B, S, vocab] (or (logits, aux) with
     with_aux=True; aux carries MoE load-balancing metrics averaged over
-    layers)."""
+    layers).
+
+    ``pld_theta``: progressive-layer-drop theta (traced scalar; layer i
+    is dropped whole-batch with prob (i/L)(1-theta) — reference:
+    progressive_layer_drop.py consumed by the BERT forward).
+    ``ltd_keep``: random-LTD kept-token count (STATIC int — one compiled
+    program per value): a sorted random subset of positions runs through
+    the layer stack, dropped positions bypass with their embedding
+    (reference: data_routing/basic_layer.py gather/scatter)."""
     dt = dtype or params["embed"]["table"].dtype
     x = L.embed(params["embed"], input_ids).astype(dt)
     if cfg.position == "learned":
@@ -301,22 +316,52 @@ def apply(cfg: TransformerConfig, params, input_ids, mask=None,
         cos, sin = L.rope_freqs(cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta)
 
     have_rng = rng is not None
+    if (pld_theta is not None or ltd_keep is not None) and not have_rng:
+        raise ValueError("pld_theta / ltd_keep need a training rng")
+
+    positions = None
+    full_x = None
+    idx = None
+    if ltd_keep is not None and ltd_keep < x.shape[1]:
+        from ..runtime.data_pipeline import (random_ltd_scatter,
+                                             random_ltd_select)
+        rng, sel_rng = jax.random.split(rng)
+        full_x = x
+        x, idx = random_ltd_select(x, ltd_keep, sel_rng)
+        positions = idx
+        if mask is not None:
+            mask = jnp.take_along_axis(mask, idx, axis=1)
+
     layer_rngs = (jax.random.split(rng, cfg.num_layers) if have_rng
                   else jnp.zeros((cfg.num_layers, 2), jnp.uint32))
 
     def body(h, xs):
-        lp, r = xs
-        h, metrics = block_apply(cfg, lp, h, cos, sin, mask=mask,
+        lp, r, li = xs
+        y, metrics = block_apply(cfg, lp, h, cos, sin, mask=mask,
                                  attention_fn=attention_fn,
-                                 rng=r if have_rng else None)
-        return h, metrics
+                                 rng=r if have_rng else None,
+                                 positions=positions)
+        if pld_theta is not None:
+            # whole-batch per-layer coin; deeper layers drop more
+            keep_p = 1.0 - (li.astype(jnp.float32) / cfg.num_layers) \
+                * (1.0 - pld_theta)
+            drop = jax.random.bernoulli(
+                jax.random.fold_in(r, 1), 1.0 - keep_p)
+            y = jnp.where(drop, h, y)
+        return y, metrics
 
     if cfg.remat:
         policy = REMAT_POLICIES[cfg.remat_policy]
         body = jax.checkpoint(body, policy=policy() if policy else None)
 
-    x, metrics = jax.lax.scan(body, x, (params["blocks"], layer_rngs),
-                              unroll=min(cfg.scan_unroll, cfg.num_layers))
+    x, metrics = jax.lax.scan(
+        body, x,
+        (params["blocks"], layer_rngs,
+         jnp.arange(cfg.num_layers, dtype=jnp.int32)),
+        unroll=min(cfg.scan_unroll, cfg.num_layers))
+    if idx is not None:
+        # dropped positions bypass the stack with their embedding
+        x = random_ltd_scatter(full_x, x, idx)
     x = _norm(cfg)(params["ln_f"], x)
     if cfg.tie_embeddings:
         logits = x @ params["embed"]["table"].astype(dt).T
@@ -361,15 +406,23 @@ def cross_entropy_loss(logits, labels, mask=None):
 
 
 def lm_loss_fn(cfg: TransformerConfig,
-               attention_fn: Callable = L.causal_attention):
-    """Standard causal-LM loss over a batch {input_ids, [attention_mask]}."""
+               attention_fn: Callable = L.causal_attention,
+               pld: bool = False, ltd_keep: Optional[int] = None):
+    """Standard causal-LM loss over a batch {input_ids, [attention_mask]}.
+
+    ``pld``: consume the engine-injected per-row ``_pld_theta`` column
+    (progressive layer drop).  ``ltd_keep``: bake a static random-LTD
+    kept-token count; the engine swaps programs via ``with_ltd`` as the
+    schedule anneals."""
 
     def loss_fn(params, batch, rng):
         ids = batch["input_ids"]
         mask = batch.get("attention_mask")
+        theta = batch["_pld_theta"][0] if pld else None
         logits, aux = apply(cfg, params, ids, mask=mask,
                             attention_fn=attention_fn, rng=rng,
-                            with_aux=True)
+                            with_aux=True, pld_theta=theta,
+                            ltd_keep=ltd_keep)
         labels, tgt_mask = rolled_lm_targets(ids, mask)
         loss = cross_entropy_loss(logits, labels, tgt_mask)
         if "moe_aux_loss" in aux:
@@ -377,6 +430,13 @@ def lm_loss_fn(cfg: TransformerConfig,
             return loss, aux
         return loss
 
+    loss_fn.uses_pld = pld
+    loss_fn.with_ltd = lambda keep: lm_loss_fn(
+        cfg, attention_fn, pld=pld, ltd_keep=keep)
+    if pld or ltd_keep is not None:
+        # evaluation must run the clean forward: no theta column in eval
+        # batches, no token dropping skewing eval losses
+        loss_fn.base_eval = lm_loss_fn(cfg, attention_fn)
     return loss_fn
 
 
@@ -402,3 +462,31 @@ class Model:
     def apply(self, params, input_ids, **kw):
         kw.setdefault("attention_fn", self.attention_fn)
         return apply(self.config, params, input_ids, **kw)
+
+    @classmethod
+    def from_params(cls, cfg: TransformerConfig, params,
+                    param_axes=None,
+                    attention_fn: Optional[Callable] = None) -> "Model":
+        """Build a Model around EXISTING parameters without running the
+        initializer (big-model flows: pre-quantized serving trees,
+        host-loaded checkpoints — the 16 GB+ random init would otherwise
+        dominate or OOM)."""
+        m = cls.__new__(cls)
+        m.config = cfg
+        if attention_fn is None:
+            if cfg.attention_impl == "flash":
+                from ..ops.flash_attention import flash_attention
+                attention_fn = flash_attention
+            elif cfg.attention_impl == "xla_flash":
+                from ..ops.xla_attention import fused_attention
+                attention_fn = fused_attention
+            else:
+                attention_fn = L.causal_attention
+        m.params = params
+        if param_axes is None:
+            from ..parallel.sharding import infer_logical_axes
+            param_axes = infer_logical_axes(params)
+        m.param_axes = param_axes
+        m.loss_fn = lm_loss_fn(cfg, attention_fn)
+        m.attention_fn = attention_fn
+        return m

@@ -116,6 +116,37 @@ class WandbMonitor(Monitor):
         self.wandb.finish()
 
 
+class CometMonitor(Monitor):
+    """(reference: monitor/comet.py CometMonitor — Experiment wrapper
+    honoring samples_log_interval; comet_ml is an optional dependency,
+    gated by MonitorMaster exactly like wandb)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        import comet_ml  # optional; gated by caller
+
+        kw = {k: v for k, v in dict(
+            api_key=config.api_key, project_name=config.project,
+            workspace=config.workspace,
+            experiment_key=config.experiment_key or None).items()
+            if v}
+        if config.online:
+            self.experiment = comet_ml.Experiment(**kw)
+        else:
+            self.experiment = comet_ml.OfflineExperiment(**kw)
+        if config.experiment_name:
+            self.experiment.set_name(config.experiment_name)
+        self.samples_log_interval = max(1, config.samples_log_interval)
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        for name, value, step in events:
+            if step % self.samples_log_interval == 0:
+                self.experiment.log_metric(name, value, step=step)
+
+    def close(self) -> None:
+        self.experiment.end()
+
+
 class MonitorMaster(Monitor):
     """Builds every enabled writer and fans events out
     (reference: monitor/monitor.py:30)."""
@@ -131,6 +162,7 @@ class MonitorMaster(Monitor):
             (getattr(config, "csv_monitor", None), CSVMonitor),
             (getattr(config, "tensorboard", None), TensorBoardMonitor),
             (getattr(config, "wandb", None), WandbMonitor),
+            (getattr(config, "comet", None), CometMonitor),
         ]
         for sub, cls in specs:
             if sub is None or not sub.enabled:

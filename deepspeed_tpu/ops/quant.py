@@ -27,14 +27,39 @@ import jax.numpy as jnp
 import numpy as np
 
 
-class QuantizedTensor(NamedTuple):
-    """Grouped quantized representation: int data + per-group scale/zero."""
-    data: jax.Array          # int8 (packed nibbles when bits=4)
-    scale: jax.Array         # f32 [groups, 1]
-    zero: Optional[jax.Array]  # f32 [groups, 1] (None when symmetric)
-    bits: int
-    shape: Tuple[int, ...]   # original shape
-    dtype: jnp.dtype         # original dtype
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """Grouped quantized representation: int data + per-group scale/zero.
+
+    Registered as a pytree with (bits, shape, dtype) as STATIC aux data:
+    quantized trees can then cross jit boundaries as ARGUMENTS (device
+    buffers) instead of closure constants — a closed-over llama3-8b int8
+    tree baked 7.5 GB of constants into the HLO and killed the compile."""
+
+    __slots__ = ("data", "scale", "zero", "bits", "shape", "dtype")
+
+    def __init__(self, data, scale, zero, bits: int,
+                 shape: Tuple[int, ...], dtype):
+        self.data = data           # int8 (packed nibbles when bits=4)
+        self.scale = scale         # f32 [groups, 1]
+        self.zero = zero           # f32 [groups, 1] (None when symmetric)
+        self.bits = bits
+        self.shape = tuple(shape)  # original shape
+        self.dtype = dtype         # original dtype
+
+    def tree_flatten(self):
+        return (self.data, self.scale, self.zero), \
+            (self.bits, self.shape, jnp.dtype(self.dtype))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, scale, zero = children
+        bits, shape, dtype = aux
+        return cls(data, scale, zero, bits, shape, dtype)
+
+    def __repr__(self):
+        return (f"QuantizedTensor(bits={self.bits}, shape={self.shape}, "
+                f"dtype={self.dtype})")
 
 
 def _group(x: jax.Array, num_groups: int) -> jax.Array:

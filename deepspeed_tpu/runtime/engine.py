@@ -238,7 +238,8 @@ class Engine:
         self.tput = ThroughputTimer(batch_size=self.train_batch_size)
         if monitor is None and (config.tensorboard.enabled
                                 or config.csv_monitor.enabled
-                                or config.wandb.enabled):
+                                or config.wandb.enabled
+                                or config.comet.enabled):
             # reference: MonitorMaster constructed by the engine
             # (engine.py:259) from the monitor sub-configs
             from ..monitor import MonitorMaster
@@ -250,6 +251,7 @@ class Engine:
         self._warmup_step_fn = None
         self._eval_step_fn = None
         self._nvme_step_fn = None
+        self._setup_data_efficiency()
 
         log_dist(
             f"Engine: {param_count(params):,} params | precision={self.precision} "
@@ -465,6 +467,151 @@ class Engine:
             skipped=self.repl)
 
     # ------------------------------------------------------------------
+    # data-efficiency family (reference: engine.py:288,346-356 —
+    # curriculum/random-LTD/PLD/MoQ hooks driven purely by the config)
+    # ------------------------------------------------------------------
+    def _setup_data_efficiency(self) -> None:
+        cfg = self.config
+        self.curriculum = None
+        ccfg = cfg.curriculum_learning
+        de = cfg.data_efficiency
+        if de.enabled and de.data_sampling.enabled \
+                and de.data_sampling.curriculum_learning.enabled:
+            ccfg = de.data_sampling.curriculum_learning
+        if ccfg.enabled:
+            if ccfg.curriculum_type != "seqlen":
+                raise ConfigError(
+                    "only the 'seqlen' curriculum metric is engine-wired "
+                    "(metric-indexed sampling: runtime.data_pipeline."
+                    "CurriculumDataSampler on the dataloader side)")
+            from .data_pipeline import CurriculumScheduler
+            self.curriculum = CurriculumScheduler({
+                "min_difficulty": ccfg.min_difficulty,
+                "max_difficulty": ccfg.max_difficulty,
+                "schedule_type": ccfg.schedule_type,
+                "schedule_config": ccfg.schedule_config})
+
+        self.pld = None
+        if cfg.progressive_layer_drop.enabled:
+            if not getattr(self.loss_fn, "uses_pld", False):
+                raise ConfigError(
+                    "progressive_layer_drop: this loss_fn does not "
+                    "consume _pld_theta — initialize with model=")
+            from .progressive_layer_drop import ProgressiveLayerDrop
+            self.pld = ProgressiveLayerDrop(
+                cfg.progressive_layer_drop.theta,
+                cfg.progressive_layer_drop.gamma)
+
+        self._ltd_cfg = None
+        self._ltd_sched = None
+        self._ltd_keep = None
+        rl = de.data_routing.random_ltd
+        if de.enabled and de.data_routing.enabled and rl.enabled:
+            if not hasattr(self.loss_fn, "with_ltd"):
+                raise ConfigError(
+                    "random_ltd: this loss_fn has no with_ltd hook — "
+                    "initialize with model=")
+            self._ltd_base_loss = self.loss_fn
+            # max_value=0 means "the batch's seqlen" — resolved against
+            # the first batch (the scheduler needs the real target or the
+            # anneal overshoots at step 1 and silently disables LTD)
+            self._ltd_cfg = rl
+
+        self.moq = None
+        qt = cfg.quantize_training
+        if qt.enabled:
+            from .quantize import Quantizer
+            self.moq = Quantizer(
+                q_start_bits=qt.start_bits, q_target_bits=qt.target_bits,
+                q_period=qt.quantize_period, q_groups=qt.quantize_groups)
+            self._moq_bits = None
+            self._moq_eig0 = None
+            self._eig = None
+            if qt.eigenvalue.enabled:
+                from .eigenvalue import Eigenvalue
+                self._eig = Eigenvalue(max_iter=qt.eigenvalue.max_iter,
+                                       tol=qt.eigenvalue.tol,
+                                       stability=qt.eigenvalue.stability)
+
+    def _data_efficiency_pre_step(self, batch, rng):
+        """Apply the scheduled per-step transforms; returns the possibly
+        modified batch (host-side, before sharding)."""
+        step = self.global_steps
+        if self.curriculum is not None:
+            from .data_pipeline import truncate_to_difficulty
+            batch = truncate_to_difficulty(
+                batch, self.curriculum.get_difficulty(step + 1))
+        if self._ltd_cfg is not None:
+            from .data_pipeline import RandomLTDScheduler
+            S = int(np.shape(batch["input_ids"])[1])
+            max_t = min(self._ltd_cfg.max_value or S, S)
+            if self._ltd_sched is None or self._ltd_sched.max != max_t:
+                self._ltd_sched = RandomLTDScheduler(
+                    total_layers=0,
+                    start_tokens=min(self._ltd_cfg.min_value, max_t),
+                    max_tokens=max_t,
+                    schedule_steps=self._ltd_cfg.require_steps,
+                    step_size=self._ltd_cfg.seq_per_step)
+            keep = min(self._ltd_sched.kept_tokens(step), S)
+            keep_eff = None if keep >= S else keep
+            if keep_eff != self._ltd_keep:
+                self._ltd_keep = keep_eff
+                self.loss_fn = (self._ltd_base_loss if keep_eff is None
+                                else self._ltd_base_loss.with_ltd(keep_eff))
+                self._train_step_fn = self._warmup_step_fn = None
+                self._eval_step_fn = None
+                self._nvme_step_fn = None
+        if self.pld is not None:
+            # injected BEFORE the MoQ block: _measure_eigenvalue slices
+            # this batch and traces the pld-consuming loss
+            theta = self.pld.update_state(step)
+            B = int(np.shape(batch["input_ids"])[0])
+            batch = dict(batch)
+            # per-row column: survives batch sharding / the gas reshape;
+            # the loss reads element 0 of its local shard
+            batch["_pld_theta"] = np.full((B,), theta, np.float32)
+        if self.moq is not None:
+            qt = self.config.quantize_training
+            bits = self.moq.current_bits(step)
+            boundary = (step > 0 and step % self.moq.period == 0
+                        and bits > self.moq.target_bits)
+            if self._eig is not None and boundary:
+                # eigenvalue pacing (reference: eigenvalue-scheduled MoQ):
+                # growing curvature postpones the next bit reduction
+                eig = self._measure_eigenvalue(batch, rng)
+                if self._moq_eig0 is None:
+                    self._moq_eig0 = abs(eig)
+                elif abs(eig) > 1.5 * self._moq_eig0:
+                    self.moq.period *= 2
+                    logger.info(
+                        f"MoQ: |eigenvalue| grew {abs(eig):.3g} vs "
+                        f"{self._moq_eig0:.3g}; quantize_period -> "
+                        f"{self.moq.period}")
+                    bits = self.moq.current_bits(step)
+            if bits != self._moq_bits:
+                self._moq_bits = bits
+                self._train_step_fn = self._warmup_step_fn = None
+                self._eval_step_fn = None
+                self._nvme_step_fn = None
+                if hasattr(self, "_compute_params_fn"):
+                    del self._compute_params_fn
+        return batch
+
+    def _measure_eigenvalue(self, batch, rng) -> float:
+        """Dominant Hessian eigenvalue of the micro-loss at the current
+        params (host-driven power iteration; period boundaries only)."""
+        micro = jax.tree.map(lambda x: np.asarray(x)[:self.micro_batch_size],
+                             batch)
+        cparams = self._compute_params(self.state.master)
+
+        def scalar_loss(p):
+            out = self.loss_fn(p, micro, rng)
+            return out[0] if isinstance(out, tuple) else out
+
+        eig, _ = self._eig.compute_eigenvalue(scalar_loss, cparams, rng)
+        return float(eig)
+
+    # ------------------------------------------------------------------
     # the train step
     # ------------------------------------------------------------------
     def _compute_params(self, master):
@@ -501,6 +648,15 @@ class Engine:
                 "master->compute gather boundary under this config; "
                 "weight gathers stay full-precision (combine with "
                 "zero_hpz_partition_size or offload, or use stage<=2)")
+        bits = getattr(self, "_moq_bits", None)
+        if bits is not None and bits <= 8:
+            # MoQ: fake-quantize 2-D+ weights in the forward at the
+            # scheduled bit width (reference: quantize_weight_in_forward)
+            from ..compression.compress import weight_quantization
+            g = self.config.quantize_training.quantize_groups
+            out = jax.tree.map(
+                lambda w: weight_quantization(w, bits=bits, groups=g)
+                if hasattr(w, "ndim") and w.ndim >= 2 else w, out)
         return out
 
     def _qwz_gather(self, p, mspec, pspec):
@@ -1197,6 +1353,8 @@ class Engine:
         """
         if rng is None:
             rng = jax.random.PRNGKey(self.config.seed + self.global_steps)
+        if self.curriculum or self.pld or self._ltd_cfg or self.moq:
+            batch = self._data_efficiency_pre_step(batch, rng)
         if self._nvme is not None:
             return self._train_batch_nvme(batch, rng)
         step_fn = self._pick_train_step()
@@ -1204,6 +1362,12 @@ class Engine:
         self.tput.start()
         try:
             self.state, metrics = step_fn(self.state, batch, rng)
+            if self.offload_active and not self._offload_validated:
+                # dispatch is async: an unsupported host-compute path
+                # surfaces at the first blocking fetch, which would land
+                # OUTSIDE this try in the caller — force execution now so
+                # the fallback can actually fire
+                float(np.asarray(metrics["loss"]))
         except jax.errors.JaxRuntimeError as e:
             # only the *first* execution may fall back — a later failure is
             # a genuine runtime error, not a backend capability gap
@@ -1268,6 +1432,9 @@ class Engine:
             # a pipelined 1F1B loss exposes a forward-only schedule for
             # evaluation (its primal otherwise pays full fwd+bwd cost)
             fn = getattr(fn, "eval_fn", fn)
+            # PLD/random-LTD losses expose a hook-free eval variant (no
+            # theta column in eval batches, no token dropping)
+            fn = getattr(fn, "base_eval", None) or fn
 
             def eval_step(master, batch, rng):
                 cparams = self._compute_params(master)
@@ -1434,6 +1601,20 @@ class Engine:
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict] = None):
         from ..checkpoint.engine import save_checkpoint as _save
+        if self.config.checkpoint.async_save and self._nvme is None \
+                and jax.process_count() == 1:
+            # Nebula-style background persistence: snapshot shards to
+            # host now, write files on a worker thread.  Multi-host runs
+            # save synchronously: save_tree's cross-host barriers are
+            # device collectives that must not race the main thread's
+            # training collectives (divergent issue order deadlocks).
+            from ..checkpoint.engine import (AsyncCheckpointSaver,
+                                             save_checkpoint_async)
+            if not hasattr(self, "_async_saver"):
+                self._async_saver = AsyncCheckpointSaver()
+            return save_checkpoint_async(
+                self, self._async_saver, save_dir, tag=tag,
+                client_state=client_state or {})
         if self._nvme is None:
             return _save(self, save_dir, tag=tag,
                          client_state=client_state or {})
@@ -1453,8 +1634,15 @@ class Engine:
         finally:
             self.state = saved
 
+    def wait_checkpoint(self) -> None:
+        """Join an in-flight async checkpoint save (no-op otherwise);
+        re-raises a failed save's error."""
+        if hasattr(self, "_async_saver"):
+            self._async_saver.wait()
+
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):
         from ..checkpoint.engine import load_checkpoint as _load
+        self.wait_checkpoint()        # never read a half-written save
         if self._nvme is None:
             return _load(self, load_dir, tag=tag)
         return self._load_checkpoint_nvme(load_dir, tag)
@@ -1527,6 +1715,38 @@ def initialize(loss_fn: Callable = None,
     ``.sharding_rules``) — the models in ``deepspeed_tpu.models`` do.
     """
     cfg = load_config(config)
+    if (cfg.mesh.expert > 1 and model is not None
+            and getattr(getattr(model, "config", None), "moe_dispatch",
+                        None) == "ragged"):
+        # ragged_dot contracts against expert-sharded weights: GSPMD
+        # would all-gather every expert's weights per layer
+        raise ConfigError(
+            "moe_dispatch='ragged' (dropless grouped GEMM) does not "
+            "compose with expert parallelism; use the scatter dispatch "
+            "on expert meshes")
+    de_routing = cfg.data_efficiency.enabled \
+        and cfg.data_efficiency.data_routing.enabled \
+        and cfg.data_efficiency.data_routing.random_ltd.enabled
+    if (cfg.progressive_layer_drop.enabled or de_routing) \
+            and loss_fn is None:
+        # PLD / random-LTD modify the transformer forward — they need
+        # the model path (reference wires them by module surgery,
+        # engine.py:346-356; here the loss is rebuilt with the hooks)
+        if model is None or not hasattr(model, "config"):
+            raise ConfigError(
+                "progressive_layer_drop / random_ltd need model= with a "
+                "TransformerConfig (the loss must expose the layer stack)")
+        if max(cfg.mesh.pipe, cfg.pipeline.stages) > 1 \
+                or max(cfg.mesh.seq, cfg.sequence_parallel.size) > 1:
+            raise ConfigError(
+                "progressive_layer_drop / random_ltd are not composable "
+                "with pipeline or sequence parallelism yet")
+        from ..models import layers as _L
+        from ..models.transformer import lm_loss_fn
+
+        attn = getattr(model, "attention_fn", None) or _L.causal_attention
+        loss_fn = lm_loss_fn(model.config, attn,
+                             pld=cfg.progressive_layer_drop.enabled)
     if model is not None:
         params = params if params is not None else model.params
         param_axes = param_axes if param_axes is not None else getattr(

@@ -37,27 +37,63 @@ from .swap_tensor import OptimizerSwapper
 
 
 class HostAdam:
-    """Numpy AdamW/Adam mirroring :func:`optimizers.adamw` exactly
-    (bias correction, decoupled vs L2 decay) — the DeepSpeedCPUAdam
-    equivalent for NVMe-offloaded state."""
+    """Numpy host optimizer mirroring :mod:`runtime.optimizers` exactly —
+    the DeepSpeedCPUAdam/CPUAdagrad/CPULion family (reference:
+    csrc/adam/cpu_adam_impl.cpp, csrc/adagrad, csrc/lion) for
+    NVMe-offloaded state.  All variants keep the (m, v) slot pair so the
+    group swapper's on-disk layout is uniform; adagrad uses v as its
+    accumulator, lion/sgd leave v untouched."""
+
+    SUPPORTED = ("adam", "adamw", "lion", "adagrad", "sgd")
 
     def __init__(self, opt_type: str, params: Dict[str, Any]):
         t = opt_type.lower()
-        if t not in ("adam", "adamw"):
+        if t not in self.SUPPORTED:
             raise ConfigError(
-                f"offload_optimizer.device=nvme supports adam/adamw, got "
-                f"{opt_type!r} (use device=cpu for other optimizers)")
-        self.b1, self.b2 = params.get("betas", (0.9, 0.999))
-        self.eps = params.get("eps", 1e-8)
+                f"offload_optimizer.device=nvme supports "
+                f"{'/'.join(self.SUPPORTED)}, got {opt_type!r}")
+        self.type = t
+        default_betas = (0.9, 0.99) if t == "lion" else (0.9, 0.999)
+        self.b1, self.b2 = params.get("betas", default_betas)
+        self.eps = params.get(
+            "eps", 1e-10 if t == "adagrad" else 1e-8)
         default_wd = 0.01 if t == "adamw" else 0.0
         self.weight_decay = params.get("weight_decay", default_wd)
         self.adam_w_mode = params.get("adam_w_mode", t == "adamw")
         self.bias_correction = params.get("bias_correction", True)
+        self.momentum = params.get("momentum", 0.0)
+        self.nesterov = params.get("nesterov", False)
 
     def update(self, p: np.ndarray, m: np.ndarray, v: np.ndarray,
                g: np.ndarray, lr: float, step: int) -> None:
         """In-place fp32 update of (p, m, v) with gradient g."""
         g = g.astype(np.float32, copy=False)
+        if self.type == "lion":
+            # mirrors optimizers.lion: sign step on interpolated moment,
+            # decoupled decay (against the PRE-step p), moment EMA after
+            delta = lr * np.sign(self.b1 * m + (1.0 - self.b1) * g)
+            if self.weight_decay:
+                delta = delta + lr * self.weight_decay * p
+            p -= delta
+            np.multiply(m, self.b2, out=m)
+            m += (1.0 - self.b2) * g
+            return
+        if self.type == "adagrad":
+            if self.weight_decay:
+                g = g + self.weight_decay * p
+            v += np.square(g)
+            p -= lr * g / (np.sqrt(v) + self.eps)
+            return
+        if self.type == "sgd":
+            if self.weight_decay:
+                g = g + self.weight_decay * p
+            if self.momentum:
+                np.multiply(m, self.momentum, out=m)
+                m += g
+                # nesterov mirrors optimizers.sgd: d = g + mu * b_new
+                g = g + self.momentum * m if self.nesterov else m
+            p -= lr * g
+            return
         if not self.adam_w_mode and self.weight_decay:
             g = g + self.weight_decay * p
         np.multiply(m, self.b1, out=m)

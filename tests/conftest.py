@@ -55,3 +55,23 @@ def fsdp8():
     from deepspeed_tpu.comm import MeshTopology
 
     return MeshTopology.build(MeshConfig(data=1, fsdp=8))
+
+
+def pytest_addoption(parser):
+    parser.addoption("--nightly", action="store_true", default=False,
+                     help="also run tests marked nightly (slow/spawning)")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "nightly: slow tests excluded from the quick suite "
+        "(run with --nightly)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--nightly"):
+        return
+    skip = pytest.mark.skip(reason="nightly-only (pass --nightly)")
+    for item in items:
+        if "nightly" in item.keywords:
+            item.add_marker(skip)

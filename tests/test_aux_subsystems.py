@@ -67,6 +67,53 @@ class TestLauncher:
             cwd="/root/repo")
         assert "JOB_RAN" in out.stdout, out.stderr
 
+    def test_elastic_restart_resumes_from_checkpoint(self, tmp_path):
+        """--elastic_training: the agent relaunches a crashed worker
+        group; the script resumes from its 'latest' checkpoint and step
+        continuity holds (reference: elastic_agent.py:32 restart loop)."""
+        import subprocess, sys
+
+        ckpt = tmp_path / "latest"
+        log = tmp_path / "steps.log"
+        script = tmp_path / "train.py"
+        script.write_text(f"""
+import os, sys
+ckpt, log = {str(ckpt)!r}, {str(log)!r}
+start = int(open(ckpt).read()) if os.path.exists(ckpt) else 0
+for step in range(start + 1, 7):
+    with open(log, "a") as f:
+        f.write(f"{{step}}\\n")
+    with open(ckpt, "w") as f:
+        f.write(str(step))
+    if step == 3 and os.environ.get("_CRASHED") is None and \\
+            not os.path.exists(ckpt + ".crashed"):
+        open(ckpt + ".crashed", "w").write("1")
+        sys.exit(17)                      # simulated node failure
+print("DONE", flush=True)
+""")
+        out = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+             "--elastic_training", "--max_elastic_restarts", "3",
+             str(script)], capture_output=True, text=True, timeout=180,
+            cwd="/root/repo")
+        assert out.returncode == 0, (out.stdout, out.stderr)
+        assert "DONE" in out.stdout
+        steps = [int(x) for x in log.read_text().split()]
+        # crash after step 3, resume AT step 4 — no gap, no redo
+        assert steps == [1, 2, 3, 4, 5, 6], steps
+
+    def test_elastic_budget_exhausted(self, tmp_path):
+        import subprocess, sys
+
+        script = tmp_path / "always_fail.py"
+        script.write_text("import sys; sys.exit(9)")
+        out = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+             "--elastic_training", "--max_elastic_restarts", "2",
+             str(script)], capture_output=True, text=True, timeout=180,
+            cwd="/root/repo")
+        assert out.returncode == 9
+
 
 class TestElasticity:
     def test_compute_elastic_config(self):

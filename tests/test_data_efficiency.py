@@ -136,3 +136,247 @@ class TestMoQ:
         out = q.quantize(params, step=50)
         assert not np.array_equal(np.asarray(out["w"]),
                                   np.asarray(params["w"]))
+
+
+# ---------------------------------------------------------------------------
+# Engine wiring: the reference-style JSON config must DRIVE each feature
+# (reference hooks: runtime/engine.py:288,346-356)
+# ---------------------------------------------------------------------------
+
+def _engine(extra, n_layers=2, seq=32):
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model
+
+    m = build_model("gpt2", vocab_size=128, num_layers=n_layers,
+                    d_model=32, num_heads=4, max_seq_len=seq)
+    cfg = {"train_micro_batch_size_per_device": 2,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+           "mesh": {"data": 8}, "steps_per_print": 1000}
+    cfg.update(extra)
+    return ds.initialize(model=m, config=cfg)
+
+
+def _batch(eng, seq=32, seed=0):
+    ids = np.random.RandomState(seed).randint(
+        0, 128, (eng.train_batch_size, seq))
+    return {"input_ids": ids}
+
+
+class TestEngineCurriculum:
+    def test_config_truncates_early_steps(self):
+        eng = _engine({"curriculum_learning": {
+            "enabled": True, "min_difficulty": 8, "max_difficulty": 32,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4,
+                                "difficulty_step": 8}}})
+        assert eng.curriculum is not None
+        rng = jax.random.PRNGKey(0)
+        b = eng._data_efficiency_pre_step(_batch(eng), rng)
+        assert b["input_ids"].shape[1] == 8          # step 0: min
+        m = eng.train_batch(_batch(eng))             # runs truncated
+        assert np.isfinite(float(m["loss"]))
+        for _ in range(4):
+            eng.train_batch(_batch(eng))
+        b = eng._data_efficiency_pre_step(_batch(eng), rng)
+        assert b["input_ids"].shape[1] == 32         # annealed to max
+
+    def test_nested_data_efficiency_block(self):
+        eng = _engine({"data_efficiency": {"enabled": True,
+            "data_sampling": {"enabled": True, "curriculum_learning": {
+                "enabled": True, "min_difficulty": 16,
+                "max_difficulty": 32, "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 10,
+                                    "difficulty_step": 16}}}}})
+        b = eng._data_efficiency_pre_step(_batch(eng),
+                                          jax.random.PRNGKey(0))
+        assert b["input_ids"].shape[1] == 16
+
+    def test_non_seqlen_metric_rejected(self):
+        from deepspeed_tpu.config.config import ConfigError
+
+        with pytest.raises(ConfigError, match="seqlen"):
+            _engine({"curriculum_learning": {
+                "enabled": True, "curriculum_type": "vocabularyrarity"}})
+
+
+class TestEnginePLD:
+    def test_theta_decays_and_trains(self):
+        eng = _engine({"progressive_layer_drop": {
+            "enabled": True, "theta": 0.5, "gamma": 0.5}})
+        assert eng.pld is not None
+        losses = [float(eng.train_batch(_batch(eng, seed=i))["loss"])
+                  for i in range(4)]
+        assert all(np.isfinite(losses))
+        # theta decayed from 1.0 toward theta
+        assert eng.pld.current_theta < 1.0
+        assert eng.pld.current_theta >= 0.5
+
+    def test_pld_requires_model_path(self):
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.config.config import ConfigError
+
+        def loss_fn(p, b, r):
+            return jnp.sum(p["w"] ** 2)
+
+        with pytest.raises(ConfigError, match="model="):
+            ds.initialize(loss_fn=loss_fn, params={"w": jnp.ones(4)},
+                          config={"train_micro_batch_size_per_device": 1,
+                                  "progressive_layer_drop":
+                                      {"enabled": True}})
+
+    def test_apply_theta_one_is_identity(self):
+        from deepspeed_tpu.models import build_model
+        from deepspeed_tpu.models.transformer import apply
+
+        m = build_model("gpt2", vocab_size=64, num_layers=3, d_model=32,
+                        num_heads=4, max_seq_len=16)
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 16)))
+        rng = jax.random.PRNGKey(3)
+        base = apply(m.config, m.params, ids)
+        pld1 = apply(m.config, m.params, ids, rng=rng,
+                     pld_theta=jnp.float32(1.0))
+        np.testing.assert_allclose(np.asarray(base), np.asarray(pld1),
+                                   rtol=1e-6)
+        # theta=0: deep layers drop with prob (i/L); some seed must differ
+        diff = False
+        for s in range(8):
+            out = apply(m.config, m.params, ids,
+                        rng=jax.random.PRNGKey(s),
+                        pld_theta=jnp.float32(0.0))
+            diff |= not np.allclose(np.asarray(base), np.asarray(out))
+        assert diff
+
+
+class TestEngineRandomLTD:
+    def test_keep_anneals_with_schedule(self):
+        eng = _engine({"data_efficiency": {"enabled": True,
+            "data_routing": {"enabled": True, "random_ltd": {
+                "enabled": True, "min_value": 16, "max_value": 32,
+                "require_steps": 2, "seq_per_step": 16}}}})
+        assert eng._ltd_cfg is not None     # scheduler built lazily
+        m = eng.train_batch(_batch(eng, seed=0))
+        assert np.isfinite(float(m["loss"]))
+        assert eng._ltd_keep == 16                   # step 0: min_value
+        eng.train_batch(_batch(eng, seed=1))
+        eng.train_batch(_batch(eng, seed=2))
+        # annealed to the full seqlen -> LTD off (base program)
+        assert eng._ltd_keep is None
+
+    def test_ltd_full_keep_is_identity(self):
+        from deepspeed_tpu.models import build_model
+        from deepspeed_tpu.models.transformer import apply
+
+        m = build_model("llama-tiny", vocab_size=64, num_layers=2,
+                        d_model=32, num_heads=4, num_kv_heads=2, d_ff=64,
+                        max_seq_len=16)
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 16)))
+        base = apply(m.config, m.params, ids)
+        ltd = apply(m.config, m.params, ids, rng=jax.random.PRNGKey(0),
+                    ltd_keep=16)
+        np.testing.assert_allclose(np.asarray(base), np.asarray(ltd),
+                                   rtol=1e-6)
+        # partial keep: dropped rows bypass with their embedding
+        out = apply(m.config, m.params, ids, rng=jax.random.PRNGKey(0),
+                    ltd_keep=8)
+        assert out.shape == base.shape
+        assert not np.allclose(np.asarray(base), np.asarray(out))
+
+
+class TestEngineMoQ:
+    def test_bits_schedule_drives_compute_params(self):
+        eng = _engine({"quantize_training": {
+            "enabled": True, "start_bits": 16, "target_bits": 8,
+            "quantize_period": 2}})
+        assert eng.moq is not None
+        eng.train_batch(_batch(eng, seed=0))
+        assert eng._moq_bits == 16                   # pre-period: no quant
+        for i in range(3):
+            eng.train_batch(_batch(eng, seed=1 + i))
+        assert eng._moq_bits == 8
+        # fake-quant actually alters the compute params
+        plain = jax.tree.map(
+            lambda x: x.astype(eng.compute_dtype), eng.state.master)
+        q = eng._compute_params(eng.state.master)
+        changed = any(
+            not np.allclose(np.asarray(a, np.float32),
+                            np.asarray(b, np.float32))
+            for a, b in zip(jax.tree.leaves(plain), jax.tree.leaves(q))
+            if np.ndim(a) >= 2)
+        assert changed
+
+    def test_eigenvalue_paced(self):
+        eng = _engine({"quantize_training": {
+            "enabled": True, "start_bits": 16, "target_bits": 4,
+            "quantize_period": 2,
+            "eigenvalue": {"enabled": True, "max_iter": 3}}},
+            n_layers=1, seq=16)
+        for i in range(3):
+            m = eng.train_batch(_batch(eng, seq=16, seed=i))
+        assert np.isfinite(float(m["loss"]))
+        assert eng._moq_eig0 is not None             # measured at boundary
+
+
+class TestReviewRegressions:
+    def test_ltd_default_max_resolves_to_seqlen(self):
+        """max_value=0 anneals toward the BATCH seqlen, not a sentinel
+        (the 1<<30 sentinel used to overshoot at step 1 and silently
+        disable LTD)."""
+        eng = _engine({"data_efficiency": {"enabled": True,
+            "data_routing": {"enabled": True, "random_ltd": {
+                "enabled": True, "min_value": 8, "max_value": 0,
+                "require_steps": 4, "seq_per_step": 8}}}})
+        eng.train_batch(_batch(eng, seed=0))
+        assert eng._ltd_keep == 8
+        eng.train_batch(_batch(eng, seed=1))
+        assert eng._ltd_keep in (8, 16, 24)     # still annealing, not off
+
+    def test_eval_with_pld_uses_clean_forward(self):
+        eng = _engine({"progressive_layer_drop": {
+            "enabled": True, "theta": 0.5, "gamma": 0.5}})
+        eng.train_batch(_batch(eng, seed=0))
+        # no _pld_theta column in eval batches: must not KeyError, and
+        # must be deterministic (no layer drops)
+        a = float(eng.eval_batch(_batch(eng, seed=5)))
+        b = float(eng.eval_batch(_batch(eng, seed=5)))
+        assert np.isfinite(a) and a == b
+
+    def test_eval_with_ltd_uses_clean_forward(self):
+        eng = _engine({"data_efficiency": {"enabled": True,
+            "data_routing": {"enabled": True, "random_ltd": {
+                "enabled": True, "min_value": 8, "max_value": 32,
+                "require_steps": 100, "seq_per_step": 8}}}})
+        eng.train_batch(_batch(eng, seed=0))
+        assert eng._ltd_keep == 8
+        a = float(eng.eval_batch(_batch(eng, seed=5)))
+        b = float(eng.eval_batch(_batch(eng, seed=5)))
+        assert np.isfinite(a) and a == b
+
+    def test_pld_plus_eigenvalue_moq(self):
+        """PLD theta column must be present when the eigenvalue pacer
+        traces the loss at a period boundary."""
+        eng = _engine({"progressive_layer_drop": {"enabled": True},
+                       "quantize_training": {
+                           "enabled": True, "start_bits": 16,
+                           "target_bits": 4, "quantize_period": 2,
+                           "eigenvalue": {"enabled": True,
+                                          "max_iter": 2}}},
+                      n_layers=1, seq=16)
+        for i in range(3):
+            m = eng.train_batch(_batch(eng, seq=16, seed=i))
+        assert np.isfinite(float(m["loss"]))
+        assert eng._moq_eig0 is not None
+
+    def test_ragged_moe_rejected_on_expert_mesh(self):
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.config.config import ConfigError
+        from deepspeed_tpu.models import build_model
+
+        m = build_model("mixtral-tiny", vocab_size=64, num_layers=2,
+                        d_model=32, num_heads=4, num_kv_heads=2, d_ff=48,
+                        max_seq_len=16, moe_dispatch="ragged")
+        with pytest.raises(ConfigError, match="ragged"):
+            ds.initialize(model=m, config={
+                "train_micro_batch_size_per_device": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "mesh": {"data": 2, "expert": 4},
+                "steps_per_print": 1000})

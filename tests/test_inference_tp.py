@@ -102,11 +102,12 @@ def test_tp_logits_parity_prefill(devices, model):
         eng.put(0, PROMPTS[0])
     sched_ref = ref._schedule()
     b_ref = ref.state.build_batch(sched_ref, ref.icfg.token_budget)
-    lg_ref, _ = ref._build_step()(ref.params, ref.state.kv, b_ref)
+    lg_ref, _ = ref._build_step()(ref.params, ref._quant,
+                                  ref.state.kv, b_ref)
 
     sched_tp = tp._schedule()
     b_tp = tp._stage(tp.state.build_batch(sched_tp, tp.icfg.token_budget))
-    lg_tp, _ = tp._build_step()(tp.params, tp.state.kv, b_tp)
+    lg_tp, _ = tp._build_step()(tp.params, tp._quant, tp.state.kv, b_tp)
     np.testing.assert_allclose(np.asarray(lg_ref)[0], np.asarray(lg_tp)[0],
                                rtol=1e-4, atol=1e-4)
 

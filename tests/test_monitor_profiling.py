@@ -121,3 +121,55 @@ class TestEnvReport:
         assert main() == 0
         out = capsys.readouterr().out
         assert "jax" in out and "environment report" in out
+
+
+class TestCometMonitor:
+    def test_missing_dep_degrades(self):
+        """comet enabled without comet_ml: MonitorMaster warns and keeps
+        the other writers (same contract as wandb)."""
+        from deepspeed_tpu.config.config import load_config
+        from deepspeed_tpu.monitor import MonitorMaster
+
+        cfg = load_config({"train_micro_batch_size_per_device": 1,
+                           "comet": {"enabled": True}})
+        mm = MonitorMaster(cfg)
+        assert not any(type(w).__name__ == "CometMonitor"
+                       for w in mm.writers)
+
+    def test_logs_with_fake_comet(self, monkeypatch):
+        import sys
+        import types
+
+        logged = []
+
+        class FakeExperiment:
+            def __init__(self, **kw):
+                self.kw = kw
+
+            def set_name(self, n):
+                self.name = n
+
+            def log_metric(self, name, value, step=None):
+                logged.append((name, value, step))
+
+            def end(self):
+                pass
+
+        fake = types.ModuleType("comet_ml")
+        fake.Experiment = FakeExperiment
+        fake.OfflineExperiment = FakeExperiment
+        monkeypatch.setitem(sys.modules, "comet_ml", fake)
+
+        from deepspeed_tpu.config.config import load_config
+        from deepspeed_tpu.monitor import MonitorMaster
+
+        cfg = load_config({"train_micro_batch_size_per_device": 1,
+                           "comet": {"enabled": True,
+                                     "samples_log_interval": 2,
+                                     "experiment_name": "t"}})
+        mm = MonitorMaster(cfg)
+        assert mm.enabled
+        mm.write_events([("Train/loss", 1.0, 1), ("Train/loss", 2.0, 2)])
+        mm.close()
+        # interval=2: only the step-2 event lands
+        assert logged == [("Train/loss", 2.0, 2)]

@@ -253,3 +253,55 @@ class TestOptimizerOffload:
         else:
             leaf = jax.tree.leaves(eng.state.master)[0]
             assert leaf.sharding.memory_kind != "pinned_host"
+
+
+class TestHostOptimizerParity:
+    """HostAdam's numpy updates must track runtime.optimizers exactly
+    (the reference's CPU optimizer family: cpu_adam/cpu_adagrad/
+    cpu_lion)."""
+
+    @pytest.mark.parametrize("kind,params", [
+        ("adamw", {"lr": 1e-2, "weight_decay": 0.01}),
+        ("adam", {"lr": 1e-2}),
+        ("lion", {"lr": 1e-3, "weight_decay": 0.1}),
+        ("adagrad", {"lr": 1e-2}),
+        ("sgd", {"lr": 1e-2, "momentum": 0.9}),
+    ])
+    def test_matches_device_optimizer(self, kind, params):
+        import numpy as np
+
+        from deepspeed_tpu.runtime.optimizers import build_optimizer
+        from deepspeed_tpu.runtime.zero_infinity import HostAdam
+
+        r = np.random.RandomState(0)
+        p0 = r.randn(64).astype(np.float32)
+
+        # device trajectory
+        opt = build_optimizer(kind, lambda s: params["lr"], dict(params))
+        dev_p = jnp.asarray(p0)
+        st = opt.init({"w": dev_p})
+        for i in range(5):
+            g = jnp.asarray(r.randn(64).astype(np.float32))
+            upd, st = opt.update({"w": g}, st, {"w": dev_p},
+                                 jnp.asarray(i + 1, jnp.int32))
+            dev_p = dev_p + upd["w"]
+
+        # host trajectory
+        r = np.random.RandomState(0)
+        r.randn(64)                       # consume p0 draw
+        host = HostAdam(kind, dict(params))
+        p = p0.copy()
+        m = np.zeros(64, np.float32)
+        v = np.zeros(64, np.float32)
+        for i in range(5):
+            g = r.randn(64).astype(np.float32)
+            host.update(p, m, v, g, params["lr"], i + 1)
+        np.testing.assert_allclose(p, np.asarray(dev_p), rtol=2e-5,
+                                   atol=2e-6, err_msg=kind)
+
+    def test_unsupported_rejected(self):
+        from deepspeed_tpu.config.config import ConfigError
+        from deepspeed_tpu.runtime.zero_infinity import HostAdam
+
+        with pytest.raises(ConfigError, match="supports"):
+            HostAdam("lamb", {})
