@@ -92,3 +92,40 @@ class TestBackward:
             ids = r.randint(0, 128, (eng.train_batch_size, 128))
             losses.append(float(eng.train_batch({"input_ids": ids})["loss"]))
         assert losses[-1] < losses[0]
+
+
+class TestLongContextStreaming:
+    """KV streams through the grid: no VMEM cap, so the kernel must stay
+    numerically exact at sequence lengths where the old whole-KV-resident
+    variant fell back to XLA (VERDICT r2 item 5)."""
+
+    @pytest.mark.nightly
+    @pytest.mark.parametrize("S", [4096, 8192])
+    def test_long_context_numerics(self, S):
+        r = np.random.RandomState(0)
+        B, H, Hkv, D = 1, 2, 1, 64
+        q = jnp.asarray(r.randn(B, S, H, D), jnp.float32) * 0.3
+        k = jnp.asarray(r.randn(B, S, Hkv, D), jnp.float32) * 0.3
+        v = jnp.asarray(r.randn(B, S, Hkv, D), jnp.float32) * 0.3
+        o = flash_attention(q, k, v)
+        ref = causal_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    @pytest.mark.nightly
+    def test_long_context_grads(self):
+        S = 4096
+        r = np.random.RandomState(1)
+        B, H, Hkv, D = 1, 2, 2, 64
+        q = jnp.asarray(r.randn(B, S, H, D), jnp.float32) * 0.3
+        k = jnp.asarray(r.randn(B, S, Hkv, D), jnp.float32) * 0.3
+        v = jnp.asarray(r.randn(B, S, Hkv, D), jnp.float32) * 0.3
+
+        def loss(fn):
+            return lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2)
+
+        g = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss(causal_attention), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-3)
