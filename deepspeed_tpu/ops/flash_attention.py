@@ -306,10 +306,7 @@ def _bwd(q, k, v, o, lse, do, scale: float, causal: bool,
                                memory_space=pltpu.VMEM)
     qg_spec = pl.BlockSpec((1, 1, 1, block_q, D), qg_index,
                            memory_space=pltpu.VMEM)
-    def vec_index(b, h, j, t):
-        return (b, h, t // n_q, t % n_q, 0)
-
-    vg_spec = pl.BlockSpec((1, 1, 1, block_q, 1), vec_index,
+    vg_spec = pl.BlockSpec((1, 1, 1, block_q, 1), qg_index,
                            memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, block_q=block_q, block_k=block_k,
@@ -363,7 +360,10 @@ def flash_attention(q, k, v, mask: Optional[jnp.ndarray] = None,
     reference keeps an unfused python softmax path the same way)."""
     B, S, H, D = q.shape
     bq, bk = min(block_q, S), min(block_k, S)
-    if mask is not None or S % bq or S % bk or (H % k.shape[2]):
+    # cross-length attention (Sk != Sq, e.g. diffusers cross-attn) stays
+    # on the XLA path: the kernels assume one shared S
+    if (mask is not None or k.shape[1] != S or S % bq or S % bk
+            or (H % k.shape[2])):
         return causal_attention(q, k, v, mask=mask, scale=scale,
                                 causal=causal)
     scale = scale if scale is not None else 1.0 / math.sqrt(D)

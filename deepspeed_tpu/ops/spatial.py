@@ -1,0 +1,140 @@
+"""Spatial / diffusers inference ops (stable-diffusion UNet family).
+
+TPU-native analog of the reference's spatial suite
+(``csrc/spatial/csrc/opt_bias_add.cu`` — the three fused NHWC bias/add
+variants; ``ops/transformer/inference/diffusers_attention.py:34``
+DeepSpeedDiffusersAttention — fused QKV self/cross attention over H·W
+latent tokens; ``diffusers_transformer_block.py:35``
+DeepSpeedDiffusersTransformerBlock — LN → self-attn → LN → cross-attn →
+LN → GEGLU feed-forward, residuals throughout).
+
+TPU-first notes: the CUDA fused-elementwise kernels exist because torch
+would otherwise launch one kernel per add — XLA fuses the whole
+elementwise chain into its producer for free, so :func:`opt_bias_add`
+is the API-parity surface over a fusion the compiler already does.
+Latent layout stays NHWC (TPU convs are channels-last native); attention
+flattens H·W into the sequence dim and routes through the same flash /
+XLA attention impls as the language models (non-causal).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models import layers as L
+
+
+def opt_bias_add(x: jax.Array, bias: Optional[jax.Array] = None,
+                 other: Optional[jax.Array] = None,
+                 other_bias: Optional[jax.Array] = None) -> jax.Array:
+    """Fused bias/residual adds over NHWC activations.
+
+    Covers the reference's three variants (opt_bias_add.cu:24,50,81):
+    ``bias_add`` (x+b), ``bias_add_add`` (x+b+other) and
+    ``bias_add_bias_add`` (x+b + other+ob).  One jitted expression —
+    XLA emits a single fused loop either way."""
+    out = x if bias is None else x + bias
+    if other is not None:
+        out = out + (other if other_bias is None else other + other_bias)
+    return out
+
+
+def geglu(x: jax.Array, w: jax.Array,
+          bias: Optional[jax.Array] = None) -> jax.Array:
+    """GEGLU feed-forward gate (diffusers' FeedForward): the projection
+    doubles the hidden dim; half gates the other through gelu."""
+    h = x @ w
+    if bias is not None:
+        h = h + bias
+    u, g = jnp.split(h, 2, axis=-1)
+    # exact erf gelu, matching diffusers' GEGLU (not the tanh approx)
+    return u * jax.nn.gelu(g, approximate=False)
+
+
+def spatial_attention(x: jax.Array, params: Dict[str, Any],
+                      num_heads: int,
+                      context: Optional[jax.Array] = None,
+                      attention_fn=None) -> jax.Array:
+    """Self / cross attention over latent tokens
+    (reference: DeepSpeedDiffusersAttention.selfAttention_fp).
+
+    ``x``: [B, H, W, C] (NHWC latents) or [B, T, C] (pre-flattened).
+    ``context``: optional [B, Tc, Cc] text-encoder states — when given,
+    K/V project from it (cross attention).  ``params``: wq/wk/wv/wo
+    (+ optional bo).  Non-causal; flash kernel when shapes tile."""
+    spatial = x.ndim == 4
+    if spatial:
+        B, H, W, C = x.shape
+        h = x.reshape(B, H * W, C)
+    else:
+        h = x
+    B, T, C = h.shape
+    D = C // num_heads
+    kv_src = h if context is None else context
+    dt = h.dtype
+    q = (h @ params["wq"].astype(dt)).reshape(B, T, num_heads, D)
+    k = (kv_src @ params["wk"].astype(dt)).reshape(
+        B, kv_src.shape[1], num_heads, D)
+    v = (kv_src @ params["wv"].astype(dt)).reshape(
+        B, kv_src.shape[1], num_heads, D)
+    if attention_fn is None:
+        attention_fn = L.causal_attention
+    o = attention_fn(q, k, v, causal=False)
+    o = o.reshape(B, T, C) @ params["wo"].astype(dt)
+    if "bo" in params:
+        o = o + params["bo"].astype(dt)
+    return o.reshape(x.shape) if spatial else o
+
+
+def diffusers_transformer_block(x: jax.Array, params: Dict[str, Any],
+                                num_heads: int,
+                                context: Optional[jax.Array] = None,
+                                eps: float = 1e-5,
+                                attention_fn=None) -> jax.Array:
+    """One diffusers 2D transformer block over NHWC latents
+    (reference: DeepSpeedDiffusersTransformerBlock.forward):
+    LN → self-attn → LN → cross-attn (when context given) → LN → GEGLU
+    FF, residual around each.
+
+    ``params``: {"ln1","ln2","ln3": {scale, bias}, "attn1","attn2":
+    spatial_attention params, "ff": {"wi","bi","wo","bo"}}."""
+    B, H, W, C = x.shape
+    h = x.reshape(B, H * W, C)
+
+    def ln(p, v):
+        return L.layernorm(p, v, eps=eps)
+
+    h = h + spatial_attention(ln(params["ln1"], h), params["attn1"],
+                              num_heads, attention_fn=attention_fn)
+    if "attn2" in params:
+        # like the reference block, attn2 always runs: with no encoder
+        # states it degrades to self-attention
+        h = h + spatial_attention(ln(params["ln2"], h), params["attn2"],
+                                  num_heads, context=context,
+                                  attention_fn=attention_fn)
+    ff = params["ff"]
+    g = geglu(ln(params["ln3"], h), ff["wi"].astype(h.dtype),
+              ff.get("bi"))
+    h = h + (g @ ff["wo"].astype(h.dtype)
+             + (ff["bo"].astype(h.dtype) if "bo" in ff else 0.0))
+    return h.reshape(B, H, W, C)
+
+
+def nhwc_group_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
+                    num_groups: int = 32, eps: float = 1e-5,
+                    bias: Optional[jax.Array] = None,
+                    residual: Optional[jax.Array] = None) -> jax.Array:
+    """GroupNorm over NHWC latents with the fused pre-add the reference's
+    spatial kernels provide (bias/residual folded into the same pass —
+    here one fused XLA expression): the UNet ResBlock entry op."""
+    x = opt_bias_add(x, bias, residual)
+    B, H, W, C = x.shape
+    g = x.reshape(B, H, W, num_groups, C // num_groups).astype(jnp.float32)
+    mean = g.mean(axis=(1, 2, 4), keepdims=True)
+    var = g.var(axis=(1, 2, 4), keepdims=True)
+    n = ((g - mean) * jax.lax.rsqrt(var + eps)).reshape(B, H, W, C)
+    return (n * gamma + beta).astype(x.dtype)

@@ -129,3 +129,16 @@ class TestLongContextStreaming:
         for a, b in zip(g, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-3, atol=2e-3)
+
+
+def test_cross_length_falls_back():
+    """Sk != Sq (diffusers cross-attention) must take the XLA fallback —
+    the kernels assume one shared S (caught by round-3 verify)."""
+    r = np.random.RandomState(0)
+    q = jnp.asarray(r.randn(2, 256, 4, 64), jnp.float32) * 0.2
+    k = jnp.asarray(r.randn(2, 24, 4, 64), jnp.float32) * 0.2
+    v = jnp.asarray(r.randn(2, 24, 4, 64), jnp.float32) * 0.2
+    o = flash_attention(q, k, v, causal=False)
+    ref = causal_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)

@@ -1,0 +1,124 @@
+"""Spatial / diffusers inference ops (reference analogs:
+csrc/spatial opt_bias_add family, DeepSpeedDiffusersAttention,
+DeepSpeedDiffusersTransformerBlock)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops import spatial as sp
+
+
+def r(*shape, seed=0, scale=0.1):
+    return jnp.asarray(
+        np.random.RandomState(seed).randn(*shape) * scale, jnp.float32)
+
+
+class TestOptBiasAdd:
+    def test_three_variants(self):
+        x = r(2, 4, 4, 8, seed=0)
+        b = r(8, seed=1)
+        other = r(2, 4, 4, 8, seed=2)
+        ob = r(8, seed=3)
+        np.testing.assert_allclose(np.asarray(sp.opt_bias_add(x, b)),
+                                   np.asarray(x + b))
+        np.testing.assert_allclose(
+            np.asarray(sp.opt_bias_add(x, b, other)),
+            np.asarray(x + b + other), rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(
+            np.asarray(sp.opt_bias_add(x, b, other, ob)),
+            np.asarray(x + b + other + ob), rtol=1e-5, atol=1e-7)
+
+
+class TestSpatialAttention:
+    def _params(self, C, Cc=None, seed=0):
+        k = np.random.RandomState(seed)
+        mk = lambda *s: jnp.asarray(k.randn(*s) / np.sqrt(s[0]),
+                                    jnp.float32)
+        return {"wq": mk(C, C), "wk": mk(Cc or C, C), "wv": mk(Cc or C, C),
+                "wo": mk(C, C), "bo": jnp.zeros(C)}
+
+    def _naive(self, x, p, heads, context=None):
+        B, T, C = x.shape
+        D = C // heads
+        src = x if context is None else context
+        q = (x @ p["wq"]).reshape(B, T, heads, D)
+        k = (src @ p["wk"]).reshape(B, src.shape[1], heads, D)
+        v = (src @ p["wv"]).reshape(B, src.shape[1], heads, D)
+        s = jnp.einsum("bthd,bshd->bhts", q, k) / np.sqrt(D)
+        a = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhts,bshd->bthd", a, v).reshape(B, T, C)
+        return o @ p["wo"] + p["bo"]
+
+    def test_self_attention_nhwc(self):
+        x = r(2, 8, 8, 64, seed=5)
+        p = self._params(64)
+        out = sp.spatial_attention(x, p, num_heads=4)
+        ref = self._naive(x.reshape(2, 64, 64), p, 4).reshape(2, 8, 8, 64)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_cross_attention(self):
+        x = r(2, 16, 64, seed=6)
+        ctx = r(2, 10, 96, seed=7)
+        p = self._params(64, Cc=96)
+        out = sp.spatial_attention(x, p, num_heads=4, context=ctx)
+        ref = self._naive(x, p, 4, context=ctx)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+class TestTransformerBlock:
+    def test_block_runs_and_matches_composition(self):
+        C, heads = 64, 4
+        x = r(2, 8, 8, C, seed=9)
+        ctx = r(2, 12, C, seed=10)
+        k = np.random.RandomState(11)
+        mk = lambda *s: jnp.asarray(k.randn(*s) / np.sqrt(s[0]),
+                                    jnp.float32)
+        ln = lambda: {"scale": jnp.ones(C), "bias": jnp.zeros(C)}
+        attn = lambda seed: {
+            "wq": mk(C, C), "wk": mk(C, C), "wv": mk(C, C),
+            "wo": mk(C, C), "bo": jnp.zeros(C)}
+        params = {"ln1": ln(), "ln2": ln(), "ln3": ln(),
+                  "attn1": attn(0), "attn2": attn(1),
+                  "ff": {"wi": mk(C, 4 * C), "bi": jnp.zeros(4 * C),
+                         "wo": mk(2 * C, C), "bo": jnp.zeros(C)}}
+        out = sp.diffusers_transformer_block(x, params, heads, context=ctx)
+        assert out.shape == x.shape
+        assert np.all(np.isfinite(np.asarray(out)))
+        # self-attn leg matches manual residual composition
+        from deepspeed_tpu.models.layers import layernorm
+        h = x.reshape(2, 64, C)
+        h1 = h + sp.spatial_attention(layernorm(params["ln1"], h),
+                                      params["attn1"], heads)
+        no_ctx = sp.diffusers_transformer_block(x, params, heads)
+        # without context attn2 degrades to self-attention (reference
+        # block behavior), then the GEGLU ff
+        h2 = h1 + sp.spatial_attention(layernorm(params["ln2"], h1),
+                                       params["attn2"], heads)
+        g = sp.geglu(layernorm(params["ln3"], h2), params["ff"]["wi"],
+                     params["ff"]["bi"])
+        ref = (h2 + (g @ params["ff"]["wo"] + params["ff"]["bo"])
+               ).reshape(2, 8, 8, C)
+        np.testing.assert_allclose(np.asarray(no_ctx), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+class TestGroupNorm:
+    def test_matches_reference_formula(self):
+        x = r(2, 4, 4, 32, seed=12, scale=1.0)
+        gamma = r(32, seed=13) + 1.0
+        beta = r(32, seed=14)
+        res = r(2, 4, 4, 32, seed=15)
+        bias = r(32, seed=16)
+        out = sp.nhwc_group_norm(x, gamma, beta, num_groups=8,
+                                 bias=bias, residual=res)
+        xx = np.asarray(x + bias + res, np.float64).reshape(2, 4, 4, 8, 4)
+        mean = xx.mean(axis=(1, 2, 4), keepdims=True)
+        var = xx.var(axis=(1, 2, 4), keepdims=True)
+        ref = ((xx - mean) / np.sqrt(var + 1e-5)).reshape(2, 4, 4, 32)
+        ref = ref * np.asarray(gamma) + np.asarray(beta)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4,
+                                   atol=2e-4)
