@@ -52,14 +52,20 @@ Round-3 wins (hlo_stats per-fusion profile led here):
 Round-3 llama legs (measured 2026-07-31 on the v5e):
 - llama-0.7B train (seq 2048, ZeRO-3): 23.75k tok/s, 57.0% MFU.
 - llama3-8b int8 serving (8 seqs x 512-tok prompts, budget 512):
-  prompt 891 tok/s, TTFT p50 2.58 s, decode 19.2 tok/s aggregate
-  (607 ms/token EMA).  Decode is DEQUANT-BOUND: each token re-reads +
-  dequantizes all 8 GB of int8 weights (int8->bf16 materialization
-  ~3x the int8 traffic); the known fix is a mixed-input Pallas GEMM
+  first measurement prompt 891 tok/s / TTFT 2.58 s / decode 19.2 tok/s;
+  the burst profile showed the GROUPED-FLAT dequant chain dominating
+  (int8 -> f32 convert -> grouped reshape -> LAYOUT COPY -> f32 matmul
+  + a materialized scale broadcast, ~6x the int8 bytes per use).
+  Switching serving weights to the ROW-WISE weight-shaped int8 layout
+  (quant.quantize_rowwise: per-row scales, data in the weight's own
+  shape, dequant computed in bf16 so it fuses into the matmul operand)
+  gave prompt 1807 tok/s, TTFT p50 1.27 s, decode 74.6 tok/s
+  (265 ms/token EMA) — 2-4x across the board. Decode remains
+  weight-traffic-bound; the next step is a mixed-input Pallas GEMM
   (dequant in VMEM tiles), blocked on Mosaic through this tunnel.
-  Getting here at all required two structural fixes: the quant tree
-  must ride the jit as ARGUMENTS (a closure bakes 7.5 GB of HLO
-  constants -> remote compile death) and the engine must accept
+  Getting 8B serving to run at all required two structural fixes: the
+  quant tree must ride the jit as ARGUMENTS (a closure bakes 7.5 GB of
+  HLO constants -> remote compile death) and the engine must accept
   pre-built quant trees (InferenceEngine(quant_tree=...)) because a
   dense 8B init/quantize pass takes >1 h on this 1-core host.
 """
@@ -235,7 +241,7 @@ def _synthetic_int8_llama(cfg):
     import numpy as np
 
     from deepspeed_tpu.models.transformer import init_params
-    from deepspeed_tpu.ops.quant import QuantizedTensor, default_groups
+    from deepspeed_tpu.ops.quant import QuantizedTensor
 
     shapes = jax.eval_shape(lambda k: init_params(cfg, k)[0],
                             jax.random.PRNGKey(0))
@@ -275,13 +281,14 @@ def _synthetic_int8_llama(cfg):
                 dgrp, qgrp = {}, {}
                 for name, sds in grp.items():
                     if name in quantizable and len(sds.shape) >= 3:
-                        L = sds.shape[0]
-                        size = int(np.prod(sds.shape[1:]))
-                        groups = default_groups(size)
+                        # row-wise weight-shaped int8 (see
+                        # quant.quantize_rowwise): dequant fuses into the
+                        # matmul, no grouped-flat relayout
+                        L, d0 = sds.shape[0], sds.shape[1]
+                        sc = (L, d0) + (1,) * (len(sds.shape) - 2)
                         qgrp[name] = QuantizedTensor(
-                            fill_i8((L, groups, size // groups)),
-                            jax.device_put(np.full((L, groups, 1), 0.004,
-                                                   np.float32)),
+                            fill_i8(sds.shape),
+                            jax.device_put(np.full(sc, 0.004, np.float32)),
                             None, 8, tuple(sds.shape), jnp.bfloat16)
                     else:
                         dgrp[name] = (jnp.ones(sds.shape, jnp.bfloat16)
@@ -292,11 +299,10 @@ def _synthetic_int8_llama(cfg):
                     quant["blocks"][gname] = qgrp
         elif top == "embed":
             tab = sub["table"]
-            size = int(np.prod(tab.shape))
-            groups = default_groups(size)
             quant["embed"] = {"table": QuantizedTensor(
-                fill_i8((groups, size // groups)),
-                jax.device_put(np.full((groups, 1), 0.004, np.float32)),
+                fill_i8(tab.shape),
+                jax.device_put(np.full((tab.shape[0], 1), 0.004,
+                                       np.float32)),
                 None, 8, tuple(tab.shape), jnp.bfloat16)}
             dense["embed"] = {}
         else:

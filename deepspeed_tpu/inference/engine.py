@@ -444,7 +444,24 @@ class InferenceEngine:
 
                 f = jax.jit(probe_step, donate_argnums=(2,), **jit_kw)
                 logits, kv = f(self.params, self._quant, kv, batch)
-                jax.block_until_ready(logits)
+                float(jnp.sum(logits))      # compile + settle, untimed
+                # probe budget from ONE post-compile step: a path an
+                # order of magnitude behind the best-so-far (3-step
+                # totals both sides) loses without the timed loop —
+                # pathological paths (100 s/step seen on the chunked XLA
+                # path at 8B shapes) must not stall start-up for minutes
+                t_w = time.perf_counter()
+                logits, kv = f(self.params, self._quant, kv, batch)
+                float(jnp.sum(logits))
+                warm3 = (time.perf_counter() - t_w) * 3
+                best = min(results.values()) if results else None
+                if warm3 > (180.0 if best is None
+                            else max(30.0, 10 * best)):
+                    logger.info(f"paged-attention probe: {impl} at "
+                                f"{warm3 / 3:.1f}s/step — skipping "
+                                "timed loop")
+                    results[impl] = warm3
+                    continue
                 t0 = time.perf_counter()
                 for _ in range(3):
                     logits, kv = f(self.params, self._quant, kv, batch)

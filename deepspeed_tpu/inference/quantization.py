@@ -43,6 +43,12 @@ def _quantize_stacked(w: jax.Array, bits: int) -> QuantizedTensor:
     engine build), so a single layer can be dequantized without touching
     the others.  bits 4/8 = grouped int; 6/12 = emulated minifloat
     (reference: csrc/fp_quantizer FP6/FP12)."""
+    if bits == 8:
+        # row-wise weight-shaped layout: per (layer, row) scales, data in
+        # the weight's own shape — dequant fuses into the consuming
+        # matmul with no reshape/layout copy (ops/quant.quantize_rowwise)
+        from ..ops.quant import _quantize_leading
+        return _quantize_leading(w, lead_dims=2)
     groups = default_groups(w[0].size)
     if bits in MINIFLOAT_BY_BITS:
         fmt = MINIFLOAT_BY_BITS[bits]
@@ -96,6 +102,12 @@ def quantize_model_params(params: Dict[str, Any], bits: int = 8,
         if bits in MINIFLOAT_BY_BITS:
             quant["embed"] = {"table": minifloat_quantize(
                 tab, fmt=MINIFLOAT_BY_BITS[bits])}
+        elif bits == 8:
+            # row-wise like the block weights: per-vocab-row scales,
+            # weight-shaped payload, fused dequant (the table is the
+            # largest single tensor — it must not keep the slow chain)
+            from ..ops.quant import quantize_rowwise
+            quant["embed"] = {"table": quantize_rowwise(tab)}
         else:
             quant["embed"] = {"table": quantize(tab, bits=bits)}
         del dense["embed"]["table"]

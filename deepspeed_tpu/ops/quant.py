@@ -133,13 +133,47 @@ def quantize(x: jax.Array, bits: int = 8, num_groups: Optional[int] = None,
     return QuantizedTensor(q, scale, zero, bits, orig_shape, orig_dtype)
 
 
+def quantize_rowwise(x: jax.Array, bits: int = 8) -> QuantizedTensor:
+    """int8 quantization with per-FIRST-DIM scales and data kept in the
+    WEIGHT'S OWN SHAPE (no grouped-flat relayout).
+
+    This is the serving-weight layout: the grouped-flat form's
+    dequantize chain profiles as convert → reshape → LAYOUT COPY →
+    matmul on TPU (the [G, gsz] tiling never matches the matmul
+    operand's), ~6x the int8 bytes of HBM traffic per use.  Row-wise,
+    the scale broadcasts along the trailing dims and the int8→bf16
+    convert+multiply fuses into the matmul operand load."""
+    assert bits == 8, "row-wise layout is int8-only (int4 packs lanes)"
+    return _quantize_leading(x, lead_dims=1)
+
+
+def _quantize_leading(x: jax.Array, lead_dims: int) -> QuantizedTensor:
+    """Row-wise quantization generalized to ``lead_dims`` leading scale
+    dims (stacked [L, rows, ...] weights use lead_dims=2)."""
+    orig_shape, orig_dtype = tuple(x.shape), x.dtype
+    red = tuple(range(lead_dims, x.ndim))
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=red,
+                    keepdims=True) / 127.0
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -128, 127)
+    return QuantizedTensor(q.astype(jnp.int8), scale, None, 8,
+                           orig_shape, orig_dtype)
+
+
 def dequantize(qt: QuantizedTensor, dtype=None) -> jax.Array:
     """(reference: dequantize / dequantize_int4_to_half_experimental)."""
+    out_dt = dtype or qt.dtype
     q = _unpack_int4(qt.data) if qt.bits == 4 else qt.data
+    if qt.bits == 8 and qt.zero is None \
+            and tuple(q.shape) == tuple(qt.shape):
+        # row-wise layout: no reshape, scale broadcasts; computing in
+        # the output dtype lets XLA fuse convert+mul into the consumer
+        # instead of materializing an f32 copy of the whole weight
+        return q.astype(out_dt) * qt.scale.astype(out_dt)
     g = q.astype(jnp.float32) * qt.scale
     if qt.zero is not None:
         g = g + qt.zero
-    return g.reshape(qt.shape).astype(dtype or qt.dtype)
+    return g.reshape(qt.shape).astype(out_dt)
 
 
 def quantized_reduction(qts, dtype=jnp.float32) -> jax.Array:

@@ -19,7 +19,6 @@ XLA attention impls as the language models (non-causal).
 
 from __future__ import annotations
 
-import math
 from typing import Any, Dict, Optional
 
 import jax
@@ -131,10 +130,11 @@ def nhwc_group_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
     """GroupNorm over NHWC latents with the fused pre-add the reference's
     spatial kernels provide (bias/residual folded into the same pass —
     here one fused XLA expression): the UNet ResBlock entry op."""
+    out_dt = x.dtype        # before bias/residual promotion
     x = opt_bias_add(x, bias, residual)
     B, H, W, C = x.shape
     g = x.reshape(B, H, W, num_groups, C // num_groups).astype(jnp.float32)
     mean = g.mean(axis=(1, 2, 4), keepdims=True)
     var = g.var(axis=(1, 2, 4), keepdims=True)
     n = ((g - mean) * jax.lax.rsqrt(var + eps)).reshape(B, H, W, C)
-    return (n * gamma + beta).astype(x.dtype)
+    return (n * gamma + beta).astype(out_dt)

@@ -293,3 +293,27 @@ class TestMinifloatAndSelective:
         qt = quantize(w, bits=8, num_groups=4)    # 4 groups, 6 rows
         with pytest.raises(ValueError, match="align"):
             selective_dequantize(qt, jnp.asarray([0]))
+
+
+class TestRowwiseQuantize:
+    def test_roundtrip_weight_shaped(self):
+        from deepspeed_tpu.ops.quant import dequantize, quantize_rowwise
+
+        x = jax.random.normal(jax.random.PRNGKey(2), (32, 96))
+        qt = quantize_rowwise(x)
+        assert qt.data.shape == x.shape          # no grouped relayout
+        y = dequantize(qt, jnp.float32)
+        bound = np.abs(np.asarray(x)).max(1) / 127.0
+        err = np.abs(np.asarray(y - x)).max(1)
+        assert (err <= bound * 0.51).all()
+
+    def test_stacked_weights_use_rowwise(self):
+        from deepspeed_tpu.inference.quantization import (_quantize_stacked,
+                                                          layer_weight)
+
+        w = jax.random.normal(jax.random.PRNGKey(3), (3, 16, 64))
+        qt = _quantize_stacked(w, bits=8)
+        assert qt.data.shape == w.shape          # weight-shaped payload
+        y = layer_weight(qt, 1, jnp.float32)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(w[1]),
+                                   rtol=0.02, atol=0.02)
