@@ -150,8 +150,16 @@ def main():
     mfu = achieved / peak
     vs_baseline = mfu / 0.54 if on_tpu else 0.0
 
+    # free each leg's HBM before the next: the engines' donated state and
+    # compiled executables stay alive through main()'s locals otherwise
+    # (the llama train leg OOMed behind the GPT-2 engine's 2.5 GB)
+    import gc
+    del engine, loader, it, data, model
+    gc.collect()
     ttft_p50_ms, decode_tok_s = serving_bench(on_tpu)
+    gc.collect()
     llama_train = llama_train_bench(on_tpu, peak)
+    gc.collect()
     llama_serve = llama8b_serving_bench(on_tpu)
 
     print(json.dumps({

@@ -103,6 +103,7 @@ class TestTuners:
 
 
 class TestEndToEnd:
+    @pytest.mark.nightly
     def test_autotune_on_virtual_mesh(self):
         """Real search: tiny transformer, 3 candidates, real engines."""
         from deepspeed_tpu.models import build_model

@@ -284,6 +284,7 @@ class TestLayerReductionDistillation:
             np.asarray(p["embed"]["table"]),
             np.asarray(t.params["embed"]["table"]))
 
+    @pytest.mark.nightly
     def test_student_trains_and_distills(self):
         import numpy as np
         import jax.numpy as jnp

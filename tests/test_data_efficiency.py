@@ -304,6 +304,7 @@ class TestEngineMoQ:
             if np.ndim(a) >= 2)
         assert changed
 
+    @pytest.mark.nightly
     def test_eigenvalue_paced(self):
         eng = _engine({"quantize_training": {
             "enabled": True, "start_bits": 16, "target_bits": 4,

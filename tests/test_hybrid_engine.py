@@ -42,6 +42,7 @@ class TestHybridEngine:
         assert losses[-1] < l0               # training kept working
         assert len(g0) == len(g1) == 6
 
+    @pytest.mark.nightly
     def test_generation_tracks_training_weights(self):
         """After a large-LR step the served weights must be the UPDATED
         policy: greedy output matches a dense forward of compute_params."""
@@ -99,6 +100,7 @@ class TestHybridEngine:
         np.testing.assert_array_equal(np.asarray(out["other"]),
                                       np.ones(3))
 
+    @pytest.mark.nightly
     def test_quantized_serving_refreshes_with_policy(self):
         """Under weight_quant the refresh must RE-QUANTIZE: the step
         closure serves the quantized tree, not the dense params."""

@@ -107,6 +107,7 @@ class TestEngineSparseGradients:
         # a 1-D vocab leaf (lm_head bias) receives DENSE gradients
         assert not is_sparse_leaf(("vocab",))
 
+    @pytest.mark.nightly
     def test_matches_dense_under_stage2_fsdp(self):
         """Stage-2 + fsdp reduce-scatters the table grad first; the
         capacity must cover rows merged from every scattered peer."""
