@@ -50,7 +50,8 @@ Round-3 wins (hlo_stats per-fusion profile led here):
   time. Profile: 263.6 ms/step self-time, 141 Compute + 114 HBM-bound.
 
 Round-3 llama legs (measured 2026-07-31 on the v5e):
-- llama-0.7B train (seq 2048, ZeRO-3): 23.75k tok/s, 57.0% MFU.
+- llama-0.7B train (seq 2048, ZeRO-3): 24.1k tok/s, 57.9% MFU
+  (full four-leg run; 23.75k standalone).
 - llama3-8b int8 serving (8 seqs x 512-tok prompts, budget 512):
   first measurement prompt 891 tok/s / TTFT 2.58 s / decode 19.2 tok/s;
   the burst profile showed the GROUPED-FLAT dequant chain dominating
@@ -60,9 +61,14 @@ Round-3 llama legs (measured 2026-07-31 on the v5e):
   (quant.quantize_rowwise: per-row scales, data in the weight's own
   shape, dequant computed in bf16 so it fuses into the matmul operand)
   gave prompt 1807 tok/s, TTFT p50 1.27 s, decode 74.6 tok/s
-  (265 ms/token EMA) — 2-4x across the board. Decode remains
+  (265 ms/token EMA) — 2-4x across the board (full four-leg run:
+  1761 / 1.31 s / 80.9). Decode remains
   weight-traffic-bound; the next step is a mixed-input Pallas GEMM
   (dequant in VMEM tiles), blocked on Mosaic through this tunnel.
+  W8A8 (int8 x int8 -> int32 MXU dots) was probed and is NOT a win on
+  this rig: int8 dots time ~1.6x SLOWER than bf16 dots through the
+  axon path (11.4 vs 7.1 ms at 512x4096x14336), so dynamic activation
+  quantization would add error for negative throughput.
   Getting 8B serving to run at all required two structural fixes: the
   quant tree must ride the jit as ARGUMENTS (a closure bakes 7.5 GB of
   HLO constants -> remote compile death) and the engine must accept
