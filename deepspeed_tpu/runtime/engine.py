@@ -1620,9 +1620,10 @@ class Engine:
                          client_state=client_state or {})
         # ZeRO-Infinity: checkpoint the *fp32* NVMe state, not the bf16
         # working copy, so resume (on any config) is lossless — the same
-        # fragment format as every other run.
+        # fragment format as every other run.  Lazy leaves stream one
+        # swap group at a time through host RAM (state may exceed DRAM).
         from .optimizers import AdamState
-        master, m, v = self._nvme.state_trees()
+        master, m, v = self._nvme.state_trees(lazy=True)
         saved = self.state
         self.state = TrainState(
             step=saved.step, master=master,

@@ -112,6 +112,29 @@ class HostAdam:
         p -= lr * (m / c1) / denom
 
 
+class LazyNVMeLeaf:
+    """A checkpoint leaf that reads its swap group from NVMe only when
+    materialized (``np.asarray``) — the streamed >host-DRAM save path.
+    Carries .shape/.dtype so the fragment writer never has to touch the
+    payload for metadata."""
+
+    __slots__ = ("_read", "_g", "_col", "_j", "shape", "dtype")
+
+    def __init__(self, read, g: int, col: int, j: int, shape, dtype):
+        self._read = read
+        self._g, self._col, self._j = g, col, j
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+
+    def __array__(self, dtype=None, copy=None):
+        arr = self._read(self._g, self._col, self._j)
+        if dtype is not None and np.dtype(dtype) != arr.dtype:
+            return arr.astype(dtype)            # astype copies
+        # the cache owns `arr`; honor an explicit copy request so a
+        # caller's mutation can never corrupt sibling leaves
+        return arr.copy() if copy else arr
+
+
 class NVMeOptimizer:
     """Group-partitioned NVMe state store + pipelined host update."""
 
@@ -202,13 +225,37 @@ class NVMeOptimizer:
     # ------------------------------------------------------------------
     # checkpoint support: materialize / restore the full fp32 state
     #
-    # Known limit: these paths hold the whole fp32 tree in host RAM at
-    # once (training itself only ever holds one group).  Group-streamed
-    # checkpoint fragments are the planned fix for state that exceeds
-    # host DRAM.
     # ------------------------------------------------------------------
-    def state_trees(self) -> Tuple[Any, Any, Any]:
-        """(master, m, v) full trees in one pass over the swap groups."""
+    def state_trees(self, lazy: bool = False) -> Tuple[Any, Any, Any]:
+        """(master, m, v) full trees in one pass over the swap groups.
+
+        ``lazy=True`` returns trees of :class:`LazyNVMeLeaf` — each leaf
+        reads its swap group from NVMe only when ``np.asarray`` touches
+        it, with a one-group cache.  The checkpoint writer walks leaves
+        sequentially, so peak host RAM is ONE swap group instead of the
+        whole fp32 state (the >host-DRAM checkpoint path)."""
+        if lazy:
+            cache: Dict[Tuple[int, int], list] = {}
+
+            def read(g: int, col: int, j: int) -> np.ndarray:
+                # per-(group, COLUMN) reads: the checkpoint walk is
+                # column-major (all master leaves, then m, then v), so a
+                # whole-group read would fetch 3x the bytes per pass;
+                # reading one column's keys keeps total IO at 1x state
+                if (g, col) not in cache:
+                    cache.clear()                # one column-group resident
+                    cache[(g, col)] = self._read_column(g, col)
+                return cache[(g, col)][j]
+
+            cols = [[None] * len(self._leaf_meta) for _ in range(3)]
+            for g, idxs in enumerate(self.groups):
+                for col in range(3):
+                    for j, i in enumerate(idxs):
+                        shape, dtype = self._leaf_meta[i]
+                        cols[col][i] = LazyNVMeLeaf(read, g, col, j,
+                                                    shape, dtype)
+            return tuple(jax.tree_util.tree_unflatten(self._treedef, col)
+                         for col in cols)
         cols = [[None] * len(self._leaf_meta) for _ in range(3)]
         for g, idxs in enumerate(self.groups):
             parts = self.swapper.read_group(g, self._template(g))
@@ -217,6 +264,17 @@ class NVMeOptimizer:
                     col[i] = vals[j]
         return tuple(jax.tree_util.tree_unflatten(self._treedef, col)
                      for col in cols)
+
+    def _read_column(self, g: int, col: int) -> list:
+        """Read one column (0=master, 1=m, 2=v) of swap group ``g``.
+
+        The group template is the (ps, ms, vs) tuple, so its flat key
+        order is column-contiguous — the column's keys are one slice."""
+        tmpl = self._template(g)
+        keys = self.swapper._keys(g, tmpl)
+        n = len(keys) // 3
+        sw = self.swapper._swapper(g)
+        return [sw.swap_in(k) for k in keys[col * n:(col + 1) * n]]
 
     def master_tree(self) -> Any:
         return self.state_trees()[0]

@@ -305,3 +305,42 @@ class TestHostOptimizerParity:
 
         with pytest.raises(ConfigError, match="supports"):
             HostAdam("lamb", {})
+
+
+class TestLazyCheckpointLeaves:
+    def test_state_trees_lazy_streams_groups(self, tmp_path):
+        """lazy=True leaves read their swap group only when materialized
+        (one-group cache): the >host-DRAM checkpoint path never holds
+        the full fp32 state."""
+        from deepspeed_tpu.runtime.zero_infinity import (LazyNVMeLeaf,
+                                                         NVMeOptimizer)
+
+        p = {"a": jnp.ones((64, 64)), "b": jnp.full((64, 64), 2.0),
+             "c": jnp.full((32,), 3.0)}
+        opt = NVMeOptimizer(str(tmp_path), "adamw", {"lr": 1e-2},
+                            buffer_size=16_000)   # forces several groups
+        opt.initialize(p)
+        reads = []
+        orig = opt._read_column
+
+        def counting(g, col):
+            reads.append((g, col))
+            return orig(g, col)
+
+        opt._read_column = counting
+        master, m, v = opt.state_trees(lazy=True)
+        leaves = jax.tree_util.tree_leaves(
+            master, is_leaf=lambda x: isinstance(x, LazyNVMeLeaf))
+        assert all(isinstance(x, LazyNVMeLeaf) for x in leaves)
+        assert reads == []                        # nothing touched yet
+        vals = [np.asarray(x) for x in leaves]    # sequential walk
+        assert reads                         # now column-groups were read
+        # one-column-group cache + column-major walk: each (group, col)
+        # read at most once, ascending, and only column 0 so far
+        assert reads == sorted(set(reads))
+        assert all(col == 0 for _, col in reads)
+        np.testing.assert_allclose(vals[0], np.ones((64, 64)))
+        np.testing.assert_allclose(
+            np.asarray(jax.tree_util.tree_leaves(
+                v, is_leaf=lambda x: isinstance(x, LazyNVMeLeaf))[0]),
+            np.zeros((64, 64)))
