@@ -136,6 +136,15 @@ def _convert_llama(cfg: TransformerConfig, sd: Dict[str, Any],
         },
         "ln_f": {"scale": _np(sd[f"{pre}norm.weight"])},
     }
+    # qwen2: q/k/v projection biases (no o bias) — llama layout otherwise
+    if L.format(0) + "self_attn.q_proj.bias" in sd:
+        attn = params["blocks"]["attn"]
+        attn["bq"] = _stack(sd, L + "self_attn.q_proj.bias", nl,
+                            lambda b: b.reshape(H, D))
+        attn["bk"] = _stack(sd, L + "self_attn.k_proj.bias", nl,
+                            lambda b: b.reshape(Hkv, D))
+        attn["bv"] = _stack(sd, L + "self_attn.v_proj.bias", nl,
+                            lambda b: b.reshape(Hkv, D))
     if with_mlp:
         params["blocks"]["mlp"] = {
             "wg": _stack(sd, L + "mlp.gate_proj.weight", nl,
@@ -323,6 +332,54 @@ def _convert_mixtral(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
     return params
 
 
+def _convert_gptj(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
+    """GPT-J (reference container: containers/gptj.py): partial rotary +
+    parallel residual with ONE shared LayerNorm.  HF GPT-J rotates
+    INTERLEAVED (even/odd) head-dim pairs; this core rotates half-split
+    pairs — the converter permutes the rotary columns of wq/wk
+    (interleaved→half), which is score-invariant because q and k share
+    the permutation."""
+    H, D, nl = cfg.num_heads, cfg.head_dim, cfg.num_layers
+    pre = next((p for p in ("transformer.", "")
+                if f"{p}wte.weight" in sd))
+    L = pre + "h.{}."
+    R = cfg.rotary_dim
+    perm = np.concatenate([np.arange(0, R, 2), np.arange(1, R, 2),
+                           np.arange(R, D)])
+
+    def qk(w):
+        return _qkv_heads(w, H, D, True)[:, :, perm]    # [dm, H, D]
+
+    params = {
+        "embed": {"table": _np(sd[f"{pre}wte.weight"])},
+        "blocks": {
+            "attn": {
+                "wq": _stack(sd, L + "attn.q_proj.weight", nl, qk),
+                "wk": _stack(sd, L + "attn.k_proj.weight", nl, qk),
+                "wv": _stack(sd, L + "attn.v_proj.weight", nl,
+                             lambda w: _qkv_heads(w, H, D, True)),
+                "wo": _stack(sd, L + "attn.out_proj.weight", nl,
+                             lambda w: _o_heads(w, H, D, True)),
+            },
+            "mlp": {
+                "wi": _stack(sd, L + "mlp.fc_in.weight", nl,
+                             lambda w: w.T),
+                "bi": _stack(sd, L + "mlp.fc_in.bias", nl),
+                "wo": _stack(sd, L + "mlp.fc_out.weight", nl,
+                             lambda w: w.T),
+                "bo": _stack(sd, L + "mlp.fc_out.bias", nl),
+            },
+            "ln1": {"scale": _stack(sd, L + "ln_1.weight", nl),
+                    "bias": _stack(sd, L + "ln_1.bias", nl)},
+        },
+        "ln_f": {"scale": _np(sd[f"{pre}ln_f.weight"]),
+                 "bias": _np(sd[f"{pre}ln_f.bias"])},
+        "lm_head": {"kernel": _np(sd["lm_head.weight"]).T,
+                    "bias": _np(sd["lm_head.bias"])},
+    }
+    return params
+
+
 CONVERTERS: Dict[str, Callable] = {
     "gpt2": _convert_gpt2,
     "llama": _convert_llama,
@@ -332,13 +389,16 @@ CONVERTERS: Dict[str, Callable] = {
     "falcon": _convert_falcon,
     "phi": _convert_phi,
     "opt": _convert_opt,
+    "gptj": _convert_gptj,
 }
 
 
 def family_of(name_or_type: str) -> str:
     s = name_or_type.lower()
-    for fam in ("mixtral", "llama", "mistral", "qwen2", "gpt2", "falcon",
-                "phi", "opt"):
+    if "gpt-j" in s or "gptj" in s:      # canonical repo ids hyphenate
+        return "gptj"
+    for fam in ("mixtral", "llama", "mistral", "qwen2", "gpt2",
+                "falcon", "phi", "opt"):
         if fam in s:
             return fam
     raise ValueError(f"no HF converter for {name_or_type!r}; "

@@ -296,7 +296,7 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
             o = _paged_attention(kv_layer, q, batch, block_size,
                                  max_blocks_per_seq, scale)
         o = jnp.einsum("thk,hkd->td", o, ap["wo"].astype(dt))
-        if cfg.attn_bias:
+        if cfg.attn_out_bias:
             o = o + ap["bo"].astype(dt)
         if not cfg.parallel_block:
             x = x + o
@@ -431,7 +431,7 @@ def decode_burst_forward(cfg: TransformerConfig, params, prefix,
         o = o.reshape(S, H, D).astype(dt)
 
         o = jnp.einsum("thk,hkd->td", o, ap["wo"].astype(dt))
-        if cfg.attn_bias:
+        if cfg.attn_out_bias:
             o = o + ap["bo"].astype(dt)
         if not cfg.parallel_block:
             x = x + o

@@ -47,6 +47,32 @@ PRESETS: Dict[str, dict] = {
                        max_seq_len=8192, activation="silu", gated_mlp=True,
                        norm="rmsnorm", position="rope", rope_theta=500000.0,
                        tie_embeddings=False, attn_bias=False, mlp_bias=False),
+    # --- Qwen2 (llama layout + qkv biases, no o bias) --------------------
+    "qwen2-tiny": dict(vocab_size=1024, num_layers=4, d_model=256,
+                       num_heads=8, num_kv_heads=4, d_ff=688,
+                       max_seq_len=2048, activation="silu", gated_mlp=True,
+                       norm="rmsnorm", position="rope",
+                       rope_theta=1000000.0, tie_embeddings=False,
+                       attn_bias=True, attn_out_bias=False,
+                       mlp_bias=False, eps=1e-6),
+    "qwen2-7b": dict(vocab_size=152064, num_layers=28, d_model=3584,
+                     num_heads=28, num_kv_heads=4, d_ff=18944,
+                     max_seq_len=32768, activation="silu", gated_mlp=True,
+                     norm="rmsnorm", position="rope",
+                     rope_theta=1000000.0, tie_embeddings=False,
+                     attn_bias=True, attn_out_bias=False,
+                     mlp_bias=False, eps=1e-6),
+    # --- GPT-J (partial rotary + parallel residual, single shared LN) -----
+    "gptj-tiny": dict(vocab_size=1024, num_layers=4, d_model=256,
+                      num_heads=8, max_seq_len=2048, activation="gelu_new",
+                      norm="layernorm", position="rope", rope_pct=0.25,
+                      parallel_block=True, tie_embeddings=False,
+                      attn_bias=False, mlp_bias=True, head_bias=True),
+    "gptj-6b": dict(vocab_size=50400, num_layers=28, d_model=4096,
+                    num_heads=16, max_seq_len=2048, activation="gelu_new",
+                    norm="layernorm", position="rope", rope_pct=0.25,
+                    parallel_block=True, tie_embeddings=False,
+                    attn_bias=False, mlp_bias=True, head_bias=True),
     # --- Mistral (GQA + high theta) --------------------------------------
     "mistral-7b": dict(vocab_size=32000, num_layers=32, d_model=4096,
                        num_heads=32, num_kv_heads=8, d_ff=14336,

@@ -51,6 +51,9 @@ class TransformerConfig:
     parallel_block: bool = False
     tie_embeddings: bool = True
     attn_bias: bool = True
+    # o-projection bias; None follows attn_bias (qwen2: q/k/v biases
+    # but NO o bias)
+    attn_out_bias: Optional[bool] = None
     mlp_bias: bool = True
     head_bias: bool = False                   # lm_head bias (phi)
     eps: float = 1e-5
@@ -78,6 +81,8 @@ class TransformerConfig:
     def __post_init__(self):
         if self.num_kv_heads is None:
             self.num_kv_heads = self.num_heads
+        if self.attn_out_bias is None:
+            self.attn_out_bias = self.attn_bias
         if self.d_ff is None:
             if self.gated_mlp:
                 # llama sizing: 2/3 * 4d, rounded up to a multiple of 256
@@ -168,6 +173,7 @@ def init_params(cfg: TransformerConfig, key) -> Tuple[Dict, Dict]:
             p["bq"] = jnp.zeros((H, D)); a["bq"] = ("heads", "head_dim")
             p["bk"] = jnp.zeros((Hkv, D)); a["bk"] = ("kv_heads", "head_dim")
             p["bv"] = jnp.zeros((Hkv, D)); a["bv"] = ("kv_heads", "head_dim")
+        if cfg.attn_out_bias:
             p["bo"] = jnp.zeros((dm,)); a["bo"] = ("embed",)
         return p, a
 
@@ -257,7 +263,7 @@ def block_apply(cfg: TransformerConfig, lp, x, cos, sin,
         k = L.apply_rope(k, cos, sin, positions=positions)
     o = attention_fn(q, k, v, mask=mask)
     o = jnp.einsum("bshk,hkd->bsd", o, ap["wo"].astype(dt))
-    if cfg.attn_bias:
+    if cfg.attn_out_bias:
         o = o + ap["bo"].astype(dt)
 
     if not cfg.parallel_block:

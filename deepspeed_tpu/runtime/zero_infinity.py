@@ -274,7 +274,12 @@ class NVMeOptimizer:
         keys = self.swapper._keys(g, tmpl)
         n = len(keys) // 3
         sw = self.swapper._swapper(g)
-        return [sw.swap_in(k) for k in keys[col * n:(col + 1) * n]]
+        # batch the column's reads through the aio queue (a sync
+        # swap_in per leaf would serialize NVMe latency per leaf)
+        bufs = [sw.swap_in(k, async_op=True)
+                for k in keys[col * n:(col + 1) * n]]
+        sw.wait()
+        return bufs
 
     def master_tree(self) -> Any:
         return self.state_trees()[0]

@@ -110,3 +110,34 @@ class TestHFParity:
         assert family_of("tiiuae/falcon-7b") == "falcon"
         assert family_of("microsoft/phi-2") == "phi"
         assert family_of("meta-llama/Llama-3-8B") == "llama"
+
+
+class TestHFParityNewFamilies:
+    def test_qwen2_gqa_qkv_bias(self):
+        """qwen2: llama layout + q/k/v biases, no o bias."""
+        from transformers import Qwen2Config, Qwen2ForCausalLM
+        hf = Qwen2ForCausalLM(Qwen2Config(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            rope_theta=10000.0, attention_dropout=0.0,
+            rms_norm_eps=1e-6, tie_word_embeddings=False)).eval()
+        m = build_model("qwen2-tiny", vocab_size=256, num_layers=2,
+                        d_model=64, num_heads=4, num_kv_heads=2, d_ff=128,
+                        max_seq_len=64, rope_theta=10000.0)
+        assert "bq" in m.params["blocks"]["attn"]
+        assert "bo" not in m.params["blocks"]["attn"]
+        _logits_close(m, hf, IDS)
+
+    def test_gptj_partial_rotary_parallel(self):
+        """gpt-j: interleaved partial rotary (converter permutes to the
+        half-split convention) + single-LN parallel residual."""
+        from transformers import GPTJConfig, GPTJForCausalLM
+        hf = GPTJForCausalLM(GPTJConfig(
+            vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
+            n_head=4, rotary_dim=8, activation_function="gelu_new",
+            attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)).eval()
+        m = build_model("gptj-tiny", vocab_size=256, num_layers=2,
+                        d_model=64, num_heads=4, max_seq_len=64,
+                        rope_pct=0.5)        # rotary_dim 8 of head_dim 16
+        _logits_close(m, hf, IDS)

@@ -382,3 +382,23 @@ class TestBurstStopToken:
         i = out[0].index(stop)
         # KV rows committed = fed token + sampled tokens before the stop
         assert eng.state.seqs[0].seen_tokens == before + i + 1
+
+
+class TestNewFamilyServing:
+    @pytest.mark.parametrize("preset,over", [
+        ("qwen2-tiny", dict(vocab_size=128, num_layers=2, d_model=64,
+                            num_heads=4, num_kv_heads=2, d_ff=128,
+                            max_seq_len=64)),
+        ("gptj-tiny", dict(vocab_size=128, num_layers=2, d_model=64,
+                           num_heads=4, max_seq_len=64)),
+    ])
+    def test_greedy_matches_full_forward(self, preset, over):
+        m = build_model(preset, **over)
+        eng = make_fp32_engine(m)
+        prompt = [5, 17, 99, 3]
+        out = eng.generate({0: prompt}, SamplingParams(max_new_tokens=6))
+        seq = list(prompt)
+        for _ in range(6):
+            logits = apply(m.config, m.params, jnp.asarray([seq]))
+            seq.append(int(jnp.argmax(logits[0, -1])))
+        assert out[0] == seq[len(prompt):]
