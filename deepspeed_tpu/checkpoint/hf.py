@@ -380,6 +380,64 @@ def _convert_gptj(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
     return params
 
 
+def _convert_gpt_neox(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
+    """GPT-NeoX / Pythia (reference container: containers/gptneox.py):
+    parallel residual with SEPARATE input/post-attention norms, partial
+    half-split rotary, head-interleaved fused query_key_value."""
+    H, D, dm, nl = cfg.num_heads, cfg.head_dim, cfg.d_model, cfg.num_layers
+    pre = next((p for p in ("gpt_neox.", "")
+                if f"{p}embed_in.weight" in sd), "gpt_neox.")
+    L = pre + "layers.{}."
+
+    def qkv(i):
+        # fused [(H*3*D), dm], per-head q,k,v contiguous — convert each
+        # layer's tensor ONCE and split (falcon pattern)
+        w = _np(sd[L.format(i) + "attention.query_key_value.weight"])
+        w = w.reshape(H, 3, D, dm)                    # [H, 3, D, dm]
+        b = _np(sd[L.format(i) + "attention.query_key_value.bias"])
+        b = b.reshape(H, 3, D)
+        out = {}
+        for which, (wn, bn) in enumerate((("wq", "bq"), ("wk", "bk"),
+                                          ("wv", "bv"))):
+            out[wn] = np.transpose(w[:, which], (2, 0, 1))  # [dm, H, D]
+            out[bn] = b[:, which]                           # [H, D]
+        return out
+
+    def qkv_stacked():
+        outs = [qkv(i) for i in range(nl)]
+        return {k: np.stack([o[k] for o in outs]) for k in outs[0]}
+
+    params = {
+        "embed": {"table": _np(sd[f"{pre}embed_in.weight"])},
+        "blocks": {
+            "attn": {
+                **qkv_stacked(),
+                "wo": _stack(sd, L + "attention.dense.weight", nl,
+                             lambda w: _o_heads(w, H, D, True)),
+                "bo": _stack(sd, L + "attention.dense.bias", nl),
+            },
+            "mlp": {
+                "wi": _stack(sd, L + "mlp.dense_h_to_4h.weight", nl,
+                             lambda w: w.T),
+                "bi": _stack(sd, L + "mlp.dense_h_to_4h.bias", nl),
+                "wo": _stack(sd, L + "mlp.dense_4h_to_h.weight", nl,
+                             lambda w: w.T),
+                "bo": _stack(sd, L + "mlp.dense_4h_to_h.bias", nl),
+            },
+            "ln1": {"scale": _stack(sd, L + "input_layernorm.weight", nl),
+                    "bias": _stack(sd, L + "input_layernorm.bias", nl)},
+            "ln2": {"scale": _stack(
+                        sd, L + "post_attention_layernorm.weight", nl),
+                    "bias": _stack(
+                        sd, L + "post_attention_layernorm.bias", nl)},
+        },
+        "ln_f": {"scale": _np(sd[f"{pre}final_layer_norm.weight"]),
+                 "bias": _np(sd[f"{pre}final_layer_norm.bias"])},
+        "lm_head": {"kernel": _np(sd["embed_out.weight"]).T},
+    }
+    return params
+
+
 CONVERTERS: Dict[str, Callable] = {
     "gpt2": _convert_gpt2,
     "llama": _convert_llama,
@@ -390,6 +448,7 @@ CONVERTERS: Dict[str, Callable] = {
     "phi": _convert_phi,
     "opt": _convert_opt,
     "gptj": _convert_gptj,
+    "gpt_neox": _convert_gpt_neox,
 }
 
 
@@ -397,6 +456,8 @@ def family_of(name_or_type: str) -> str:
     s = name_or_type.lower()
     if "gpt-j" in s or "gptj" in s:      # canonical repo ids hyphenate
         return "gptj"
+    if "neox" in s or "pythia" in s:
+        return "gpt_neox"
     for fam in ("mixtral", "llama", "mistral", "qwen2", "gpt2",
                 "falcon", "phi", "opt"):
         if fam in s:

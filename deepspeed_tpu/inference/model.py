@@ -301,6 +301,8 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
         if not cfg.parallel_block:
             x = x + o
             h = norm(lp["ln2"], x)
+        elif cfg.parallel_separate_norms:
+            h = norm(lp["ln2"], x)   # gpt-neox: MLP norms the original x
         # parallel residual (falcon/phi): MLP reads the same ln1 output
         d = _ffn(cfg, lp, h, dt, act)
         if kv_host:
@@ -436,6 +438,8 @@ def decode_burst_forward(cfg: TransformerConfig, params, prefix,
         if not cfg.parallel_block:
             x = x + o
             h = norm(lp["ln2"], x)
+        elif cfg.parallel_separate_norms:
+            h = norm(lp["ln2"], x)   # gpt-neox: MLP norms the original x
         d = _ffn(cfg, lp, h, dt, act)
         y = (x + o + d) if cfg.parallel_block else (x + d)
         return y, tail_l

@@ -73,6 +73,21 @@ PRESETS: Dict[str, dict] = {
                     norm="layernorm", position="rope", rope_pct=0.25,
                     parallel_block=True, tie_embeddings=False,
                     attn_bias=False, mlp_bias=True, head_bias=True),
+    # --- GPT-NeoX / Pythia (parallel residual, SEPARATE norms) ------------
+    "gpt-neox-tiny": dict(vocab_size=1024, num_layers=4, d_model=256,
+                          num_heads=8, max_seq_len=2048,
+                          activation="gelu", norm="layernorm",
+                          position="rope", rope_pct=0.25,
+                          parallel_block=True, parallel_separate_norms=True,
+                          tie_embeddings=False, attn_bias=True,
+                          mlp_bias=True),
+    "pythia-1.4b": dict(vocab_size=50304, num_layers=24, d_model=2048,
+                        num_heads=16, max_seq_len=2048,
+                        activation="gelu", norm="layernorm",
+                        position="rope", rope_pct=0.25,
+                        parallel_block=True, parallel_separate_norms=True,
+                        tie_embeddings=False, attn_bias=True,
+                        mlp_bias=True),
     # --- Mistral (GQA + high theta) --------------------------------------
     "mistral-7b": dict(vocab_size=32000, num_layers=32, d_model=4096,
                        num_heads=32, num_kv_heads=8, d_ff=14336,

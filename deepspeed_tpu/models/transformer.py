@@ -47,8 +47,11 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     rope_pct: float = 1.0                     # partial rotary (phi: 0.4)
     # parallel residual: x + attn(ln(x)) + mlp(ln(x)), one shared norm
-    # (falcon, phi)
+    # (falcon, phi, gpt-j)
     parallel_block: bool = False
+    # gpt-neox/pythia: parallel residual but TWO norms — the MLP reads
+    # ln2(x) instead of the attention's ln1(x)
+    parallel_separate_norms: bool = False
     tie_embeddings: bool = True
     attn_bias: bool = True
     # o-projection bias; None follows attn_bias (qwen2: q/k/v biases
@@ -210,7 +213,7 @@ def init_params(cfg: TransformerConfig, key) -> Tuple[Dict, Dict]:
     norm_init = L.layernorm_init if cfg.norm == "layernorm" else L.rmsnorm_init
     blk_p["ln1"], blk_a["ln1"] = stack_init(
         lambda k: norm_init(dm), keys[4])
-    if not cfg.parallel_block:               # parallel residual: one norm
+    if not cfg.parallel_block or cfg.parallel_separate_norms:
         blk_p["ln2"], blk_a["ln2"] = stack_init(
             lambda k: norm_init(dm), keys[5])
 
@@ -268,6 +271,9 @@ def block_apply(cfg: TransformerConfig, lp, x, cos, sin,
 
     if not cfg.parallel_block:
         x = x + o
+        h = norm(lp["ln2"], x)
+    elif cfg.parallel_separate_norms:
+        # gpt-neox: the MLP reads its own norm of the ORIGINAL x
         h = norm(lp["ln2"], x)
     # parallel residual (falcon/phi): the MLP reads the same ln1 output
     metrics: Dict[str, Any] = {}

@@ -141,3 +141,18 @@ class TestHFParityNewFamilies:
                         d_model=64, num_heads=4, max_seq_len=64,
                         rope_pct=0.5)        # rotary_dim 8 of head_dim 16
         _logits_close(m, hf, IDS)
+
+    def test_gpt_neox_separate_norm_parallel(self):
+        """gpt-neox/pythia: parallel residual with SEPARATE ln1/ln2
+        (attn reads ln1(x), mlp reads ln2(x)) + fused head-interleaved
+        query_key_value + partial half-split rotary."""
+        from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+        hf = GPTNeoXForCausalLM(GPTNeoXConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=256,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, rotary_pct=0.25,
+            use_parallel_residual=True, attention_dropout=0.0,
+            hidden_dropout=0.0, layer_norm_eps=1e-5)).eval()
+        m = build_model("gpt-neox-tiny", vocab_size=256, num_layers=2,
+                        d_model=64, num_heads=4, max_seq_len=64)
+        _logits_close(m, hf, IDS)

@@ -391,6 +391,8 @@ class TestNewFamilyServing:
                             max_seq_len=64)),
         ("gptj-tiny", dict(vocab_size=128, num_layers=2, d_model=64,
                            num_heads=4, max_seq_len=64)),
+        ("gpt-neox-tiny", dict(vocab_size=128, num_layers=2, d_model=64,
+                               num_heads=4, max_seq_len=64)),
     ])
     def test_greedy_matches_full_forward(self, preset, over):
         m = build_model(preset, **over)
