@@ -2,6 +2,8 @@ from .engine import InferenceConfig, InferenceEngine
 from .sampler import SamplingParams, sample
 from .ragged.state import KVCacheConfig, StateManager, RaggedBatch
 from .ragged.allocator import BlockedAllocator
+from .weight_stream import NVMeWeightStore
 
 __all__ = ["InferenceConfig", "InferenceEngine", "SamplingParams", "sample",
-           "KVCacheConfig", "StateManager", "RaggedBatch", "BlockedAllocator"]
+           "KVCacheConfig", "StateManager", "RaggedBatch",
+           "BlockedAllocator", "NVMeWeightStore"]

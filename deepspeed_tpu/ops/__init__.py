@@ -8,6 +8,8 @@ from .sparse_attention import (BigBirdSparsityConfig,
                                VariableSparsityConfig,
                                block_sparse_attention,
                                make_block_sparse_attention)
+from .spatial import (diffusers_transformer_block, geglu,
+                      nhwc_group_norm, opt_bias_add, spatial_attention)
 from .xla_attention import fused_attention
 
 __all__ = [
@@ -18,4 +20,6 @@ __all__ = [
     "DenseSparsityConfig", "FixedSparsityConfig",
     "VariableSparsityConfig", "block_sparse_attention",
     "make_block_sparse_attention",
+    "diffusers_transformer_block", "geglu", "nhwc_group_norm",
+    "opt_bias_add", "spatial_attention",
 ]
