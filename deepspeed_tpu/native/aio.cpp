@@ -9,13 +9,33 @@
 // Requests are split into block_size chunks fanned across the pool, so a
 // single large tensor read/write saturates multiple NVMe queues exactly
 // like the reference's parallel pread/pwrite (csrc/aio/py_lib
-// deepspeed_py_aio_handle.cpp).  Each request opens its file once; the fd
-// is shared by all of its chunks and closed when the last chunk retires.
-// I/O goes through the page cache (no O_DIRECT: numpy source buffers
-// carry no alignment guarantee).
+// deepspeed_py_aio_handle.cpp).  Each request opens its file once; the
+// fds are shared by all of its chunks and closed when the last chunk
+// retires.
+//
+// The reference handle's knobs are consumed with these semantics
+// (csrc/aio/py_lib/deepspeed_py_aio_handle.cpp):
+//   block_size    — chunk granularity (parallelism unit).
+//   queue_depth   — max chunks in flight; submission applies
+//                   backpressure beyond it (libaio iodepth analog).
+//   single_submit — one op per request instead of chunking (the
+//                   reference's non-batched submit mode).
+//   overlap_events— when false, each submit drains before returning
+//                   (no submit/complete overlap).
+//   use_odirect   — page-cache bypass: 4096-aligned spans go through an
+//                   O_DIRECT fd via pooled aligned bounce buffers
+//                   (numpy callers guarantee no alignment); unaligned
+//                   head/tail spans use a buffered fd.  Filesystems
+//                   without O_DIRECT (tmpfs) fall back silently;
+//                   aio_odirect_ops reports what actually happened.
+
+#ifndef _GNU_SOURCE
+#define _GNU_SOURCE  // O_DIRECT
+#endif
 
 #include <atomic>
 #include <condition_variable>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <fcntl.h>
@@ -31,14 +51,18 @@
 
 namespace {
 
-// One submitted read/write; owns the fd for all its chunks.
+constexpr long kAlign = 4096;
+
+// One submitted read/write; owns the fds for all its chunks.
 struct Request {
-  int fd = -1;
+  int fd = -1;         // buffered
+  int fd_direct = -1;  // O_DIRECT (or -1: unsupported / disabled)
   Request() = default;
   Request(const Request &) = delete;
   Request &operator=(const Request &) = delete;
   ~Request() {
     if (fd >= 0) close(fd);
+    if (fd_direct >= 0) close(fd_direct);
   }
 };
 
@@ -48,14 +72,24 @@ struct Task {
   long nbytes;
   long offset;
   bool write;
+  bool direct;  // aligned span eligible for the O_DIRECT fd
 };
 
 class AioPool {
 public:
-  AioPool(int num_threads, long block_size)
-      : block_size_(block_size), stop_(false), pending_(0), errors_(0) {
+  AioPool(int num_threads, long block_size, int queue_depth,
+          int single_submit, int overlap_events, int use_odirect)
+      : block_size_(block_size), queue_depth_(queue_depth),
+        single_submit_(single_submit != 0),
+        overlap_events_(overlap_events != 0),
+        use_odirect_(use_odirect != 0), stop_(false), pending_(0),
+        errors_(0), odirect_ops_(0), tasks_total_(0) {
     if (num_threads < 1) num_threads = 1;
     if (block_size_ < 1) block_size_ = 1 << 20;
+    // O_DIRECT chunks must stay 4096-multiples
+    if (use_odirect_ && block_size_ % kAlign)
+      block_size_ = ((block_size_ / kAlign) + 1) * kAlign;
+    if (queue_depth_ < 1) queue_depth_ = 1 << 20;  // effectively unbounded
     for (int i = 0; i < num_threads; ++i)
       workers_.emplace_back([this] { worker(); });
   }
@@ -72,30 +106,64 @@ public:
   void submit(const char *path, char *buf, long nbytes, long offset,
               bool write, bool trunc = false) {
     int flags = write ? (O_WRONLY | O_CREAT) : O_RDONLY;
-    int fd = open(path, flags, 0644);
-    if (fd < 0) {
+    auto req = std::make_shared<Request>();
+    req->fd = open(path, flags, 0644);
+    if (req->fd < 0) {
       errors_.fetch_add(1);
       return;
     }
+    // single_submit runs each request as ONE buffered op (no chunking);
+    // opening a direct fd it can never use would waste a syscall pair
+    if (use_odirect_ && !single_submit_)
+      req->fd_direct = open(path, flags | O_DIRECT, 0644);  // may fail: ok
     // opt-in for full-file rewrites: a smaller rewrite must not leave a
     // stale tail from a previous, larger request (a reader trusting file
     // size would see old data).  Never implicit — partial-write users of
     // the public handle rely on surrounding bytes surviving.
     if (write && trunc) {
-      if (ftruncate(fd, offset + nbytes) != 0) errors_.fetch_add(1);
+      if (ftruncate(req->fd, offset + nbytes) != 0) errors_.fetch_add(1);
     }
-    auto req = std::make_shared<Request>();
-    req->fd = fd;
-    // split into block-sized chunks for parallelism
-    long done = 0;
+    long end = offset + nbytes;
+    // the file span [offset, end) splits into an unaligned head, an
+    // aligned body (O_DIRECT-eligible, chunked), and an unaligned tail
+    long body_lo = offset, body_hi = end;
+    if (req->fd_direct >= 0) {
+      body_lo = (offset + kAlign - 1) / kAlign * kAlign;
+      body_hi = end / kAlign * kAlign;
+      if (body_hi <= body_lo) { body_lo = body_hi = offset; }
+    }
     std::unique_lock<std::mutex> lk(mu_);
-    while (done < nbytes) {
-      long n = std::min(block_size_, nbytes - done);
-      queue_.push_back(Task{req, buf + done, n, offset + done, write});
+    auto push = [&](long off, long len, bool direct) {
+      if (len <= 0) return;
+      // queue_depth backpressure (libaio iodepth analog)
+      space_cv_.wait(lk, [this] {
+        return (long)queue_.size() < queue_depth_;
+      });
+      queue_.push_back(
+          Task{req, buf + (off - offset), len, off, write, direct});
       pending_.fetch_add(1);
-      done += n;
+      tasks_total_.fetch_add(1);
+      cv_.notify_one();
+    };
+    if (single_submit_ || req->fd_direct < 0) {
+      // one op per request (single_submit) / plain chunking (no direct)
+      if (single_submit_) {
+        push(offset, nbytes, false);
+      } else {
+        for (long done = 0; done < nbytes; done += block_size_)
+          push(offset + done, std::min(block_size_, nbytes - done), false);
+      }
+    } else {
+      push(offset, body_lo - offset, false);            // head
+      for (long off = body_lo; off < body_hi; off += block_size_)
+        push(off, std::min(block_size_, body_hi - off), true);
+      push(body_hi, end - body_hi, false);              // tail
     }
-    cv_.notify_all();
+    lk.unlock();
+    if (!overlap_events_) {
+      std::unique_lock<std::mutex> dlk(done_mu_);
+      done_cv_.wait(dlk, [this] { return pending_.load() == 0; });
+    }
   }
 
   int wait() {
@@ -105,9 +173,12 @@ public:
   }
 
   int pending() const { return pending_.load(); }
+  long odirect_ops() const { return odirect_ops_.load(); }
+  long tasks_total() const { return tasks_total_.load(); }
 
 private:
   void worker() {
+    AlignedBuf bounce;
     for (;;) {
       Task t;
       {
@@ -116,9 +187,10 @@ private:
         if (stop_ && queue_.empty()) return;
         t = std::move(queue_.front());
         queue_.pop_front();
+        space_cv_.notify_one();
       }
-      if (!run_one(t)) errors_.fetch_add(1);
-      t.req.reset();  // close fd as soon as the last chunk retires
+      if (!run_one(t, bounce)) errors_.fetch_add(1);
+      t.req.reset();  // close fds as soon as the last chunk retires
       if (pending_.fetch_sub(1) == 1) {
         std::unique_lock<std::mutex> lk(done_mu_);
         done_cv_.notify_all();
@@ -126,28 +198,76 @@ private:
     }
   }
 
-  bool run_one(const Task &t) {
+  // per-worker reusable aligned bounce buffer for O_DIRECT chunks
+  struct AlignedBuf {
+    char *p = nullptr;
+    long cap = 0;
+    ~AlignedBuf() { free(p); }
+    char *get(long n) {
+      if (n > cap) {
+        free(p);
+        if (posix_memalign(reinterpret_cast<void **>(&p), kAlign, n))
+          p = nullptr;
+        cap = p ? n : 0;
+      }
+      return p;
+    }
+  };
+
+  bool run_one(const Task &t, AlignedBuf &bounce) {
+    int fd = t.req->fd;
+    char *src = t.buf;
+    if (t.direct && t.req->fd_direct >= 0) {
+      // aligned file span; the USER buffer may still be unaligned, so
+      // stage through the worker's aligned bounce buffer
+      char *b = bounce.get(t.nbytes);
+      if (b != nullptr) {
+        fd = t.req->fd_direct;
+        src = b;
+        if (t.write) memcpy(b, t.buf, t.nbytes);
+        odirect_ops_.fetch_add(1);
+      }
+    }
     long done = 0;
     while (done < t.nbytes) {
       ssize_t n = t.write
-          ? pwrite(t.req->fd, t.buf + done, t.nbytes - done, t.offset + done)
-          : pread(t.req->fd, t.buf + done, t.nbytes - done, t.offset + done);
-      if (n <= 0) return false;
+          ? pwrite(fd, src + done, t.nbytes - done, t.offset + done)
+          : pread(fd, src + done, t.nbytes - done, t.offset + done);
+      if (n <= 0) {
+        if (fd == t.req->fd_direct) {
+          // e.g. EINVAL from a filesystem that accepted the open but
+          // rejects direct I/O — retry the whole chunk buffered
+          fd = t.req->fd;
+          src = t.buf;
+          odirect_ops_.fetch_sub(1);
+          done = 0;
+          continue;
+        }
+        return false;
+      }
       done += n;
     }
+    if (!t.write && src != t.buf) memcpy(t.buf, src, t.nbytes);
     return true;
   }
 
   long block_size_;
+  long queue_depth_;
+  bool single_submit_;
+  bool overlap_events_;
+  bool use_odirect_;
   bool stop_;
   std::vector<std::thread> workers_;
   std::deque<Task> queue_;
   std::mutex mu_;
   std::condition_variable cv_;
+  std::condition_variable space_cv_;
   std::mutex done_mu_;
   std::condition_variable done_cv_;
   std::atomic<int> pending_;
   std::atomic<int> errors_;
+  std::atomic<long> odirect_ops_;
+  std::atomic<long> tasks_total_;
 };
 
 }  // namespace
@@ -155,7 +275,14 @@ private:
 extern "C" {
 
 void *aio_create(int num_threads, long block_size) {
-  return new AioPool(num_threads, block_size);
+  return new AioPool(num_threads, block_size, 0, 0, 1, 0);
+}
+
+// full-knob constructor (reference: aio_handle ctor py_ds_aio.cpp:15)
+void *aio_create2(int num_threads, long block_size, int queue_depth,
+                  int single_submit, int overlap_events, int use_odirect) {
+  return new AioPool(num_threads, block_size, queue_depth, single_submit,
+                     overlap_events, use_odirect);
 }
 
 void aio_destroy(void *h) { delete static_cast<AioPool *>(h); }
@@ -185,6 +312,14 @@ void aio_pwrite_trunc(void *h, const char *path, const void *buf, long nbytes,
 int aio_wait(void *h) { return static_cast<AioPool *>(h)->wait(); }
 
 int aio_pending(void *h) { return static_cast<AioPool *>(h)->pending(); }
+
+// observability: chunks that actually went through O_DIRECT / total chunks
+long aio_odirect_ops(void *h) {
+  return static_cast<AioPool *>(h)->odirect_ops();
+}
+long aio_tasks_total(void *h) {
+  return static_cast<AioPool *>(h)->tasks_total();
+}
 
 // synchronous helpers (reference: aio_read/aio_write free functions)
 int aio_sync_pread(void *h, const char *path, void *buf, long nbytes,

@@ -20,18 +20,24 @@ from .builder import AsyncIOBuilder
 class AsyncIOHandle:
     """Chunked, threaded file I/O for numpy buffers.
 
-    ``queue_depth``/``single_submit``/``overlap_events`` exist for config
-    parity with the reference handle only: the pool here is thread-based
-    pread/pwrite (its submission queue is unbounded and always
-    overlapped), so they change nothing and are merely recorded.
-    """
+    All reference-handle knobs are consumed (semantics in
+    ``native/aio.cpp``): ``queue_depth`` bounds in-flight chunks
+    (submission backpressure), ``single_submit`` disables chunking (and
+    therefore O_DIRECT — a whole unaligned request is buffered),
+    ``overlap_events=False`` drains each submit before returning, and
+    ``use_odirect`` routes 4096-aligned spans through O_DIRECT with
+    pooled aligned bounce buffers (page-cache bypass — the path that
+    scales on a real NVMe mount; tmpfs et al. fall back silently,
+    ``odirect_ops()`` reports what actually happened)."""
 
     def __init__(self, block_size: int = 1 << 20, queue_depth: int = 128,
                  thread_count: int = 4, single_submit: bool = False,
-                 overlap_events: bool = True):
+                 overlap_events: bool = True, use_odirect: bool = False):
         lib = AsyncIOBuilder().load()
-        lib.aio_create.restype = ctypes.c_void_p
-        lib.aio_create.argtypes = [ctypes.c_int, ctypes.c_long]
+        lib.aio_create2.restype = ctypes.c_void_p
+        lib.aio_create2.argtypes = [ctypes.c_int, ctypes.c_long,
+                                    ctypes.c_int, ctypes.c_int,
+                                    ctypes.c_int, ctypes.c_int]
         lib.aio_destroy.argtypes = [ctypes.c_void_p]
         for fn in ("aio_pread", "aio_pwrite", "aio_pwrite_trunc"):
             getattr(lib, fn).argtypes = [
@@ -41,13 +47,41 @@ class AsyncIOHandle:
         lib.aio_wait.restype = ctypes.c_int
         lib.aio_pending.argtypes = [ctypes.c_void_p]
         lib.aio_pending.restype = ctypes.c_int
+        lib.aio_odirect_ops.argtypes = [ctypes.c_void_p]
+        lib.aio_odirect_ops.restype = ctypes.c_long
+        lib.aio_tasks_total.argtypes = [ctypes.c_void_p]
+        lib.aio_tasks_total.restype = ctypes.c_long
         self._lib = lib
-        self._h = lib.aio_create(thread_count, block_size)
+        self._h = lib.aio_create2(thread_count, block_size, queue_depth,
+                                  int(single_submit), int(overlap_events),
+                                  int(use_odirect))
         self.block_size = block_size
         self.queue_depth = queue_depth
         self.thread_count = thread_count
         self.single_submit = single_submit
         self.overlap_events = overlap_events
+        self.use_odirect = use_odirect
+
+    @classmethod
+    def from_config(cls, aio_cfg, **overrides) -> "AsyncIOHandle":
+        """Build from a :class:`~deepspeed_tpu.config.config.AioConfig`
+        (the reference reads the same block at
+        partitioned_param_swapper.py:83)."""
+        kw = dict(block_size=aio_cfg.block_size,
+                  queue_depth=aio_cfg.queue_depth,
+                  thread_count=aio_cfg.thread_count,
+                  single_submit=aio_cfg.single_submit,
+                  overlap_events=aio_cfg.overlap_events,
+                  use_odirect=getattr(aio_cfg, "use_odirect", False))
+        kw.update(overrides)
+        return cls(**kw)
+
+    def odirect_ops(self) -> int:
+        """Chunks that actually went through O_DIRECT so far."""
+        return int(self._lib.aio_odirect_ops(self._h))
+
+    def tasks_total(self) -> int:
+        return int(self._lib.aio_tasks_total(self._h))
 
     def __del__(self):
         h = getattr(self, "_h", None)
