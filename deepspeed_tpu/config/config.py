@@ -271,6 +271,11 @@ class CurriculumLearningConfig(ConfigModel):
     max_difficulty: int = 1024
     schedule_type: str = "fixed_linear"    # fixed_linear|fixed_root|fixed_discrete
     schedule_config: Dict[str, Any] = field(default_factory=dict)
+    # Any curriculum_type other than "seqlen" names a DataAnalyzer metric:
+    # this points at the analyzer save dir holding
+    # <curriculum_type>/sample_to_metric.npy (reference: the
+    # index_to_sample/index_to_metric paths in data_sampling config)
+    data_analyzer_path: str = ""
 
 
 @dataclass
@@ -444,12 +449,19 @@ class CometConfig(ConfigModel):
 @dataclass
 class AioConfig(ConfigModel):
     """Native async-IO layer knobs (reference: csrc/aio, op config read at
-    swap_tensor/partitioned_param_swapper.py:83)."""
+    swap_tensor/partitioned_param_swapper.py:83).  All knobs are consumed
+    by the native pool (ops/aio.py AsyncIOHandle)."""
     block_size: int = 1048576
     queue_depth: int = 128
-    thread_count: int = 1
+    # our pool threads are plain pread/pwrite workers (cheap), not libaio
+    # contexts — default matches AsyncIOHandle's longstanding 4, so
+    # config-driven pools don't serialize chunk fan-out
+    thread_count: int = 4
     single_submit: bool = False
     overlap_events: bool = True
+    # page-cache bypass for 4096-aligned spans (falls back silently on
+    # filesystems without O_DIRECT, e.g. tmpfs)
+    use_odirect: bool = False
 
 
 @dataclass

@@ -68,7 +68,10 @@ class ZeroPolicy:
                     rules: Optional[Dict[str, Sequence[str]]] = None) -> "ZeroPolicy":
         return cls(stage=zcfg.stage, topology=topology, rules=rules,
                    param_persistence_threshold=zcfg.param_persistence_threshold,
-                   offload=zcfg.offload_optimizer.device == "cpu",
+                   # cpu: host-DRAM minimization; nvme: per-rank swap
+                   # fragments (each process stores/updates only its own
+                   # data x fsdp shard — stage3.py:614 per-rank swap)
+                   offload=zcfg.offload_optimizer.device in ("cpu", "nvme"),
                    hpz=zcfg.zero_hpz_partition_size > 1)
 
     # ---- spec builders ---------------------------------------------------

@@ -107,15 +107,31 @@ class CurriculumDataSampler:
         self.order = np.argsort(self.metric, kind="stable")
         self.sched = scheduler
         self.batch_size = batch_size
-        self.rng = np.random.RandomState(seed)
+        self.seed = seed
+
+    @classmethod
+    def from_analyzer(cls, save_path: str, metric: str,
+                      scheduler: CurriculumScheduler, batch_size: int,
+                      seed: int = 0) -> "CurriculumDataSampler":
+        """Build from an offline :class:`~deepspeed_tpu.runtime.
+        data_analyzer.DataAnalyzer` index dir (reference: the
+        DeepSpeedDataSampler consuming index_to_sample_path files)."""
+        from .data_analyzer import load_metric
+        idx = load_metric(save_path, metric)
+        return cls(np.asarray(idx["sample_to_metric"]), scheduler,
+                   batch_size, seed=seed)
 
     def batch_indices(self, step: int) -> np.ndarray:
+        """Stateless in ``step``: the same (seed, step) always yields the
+        same batch, so epoch replay / checkpoint resume reproduce the
+        original data order (like the loader's epoch-seeded shuffle)."""
         difficulty = self.sched.get_difficulty(step)
         eligible_n = int(np.searchsorted(
             self.metric[self.order], difficulty, side="right"))
         eligible = self.order[:max(eligible_n, self.batch_size)]
-        return self.rng.choice(eligible, size=self.batch_size,
-                               replace=len(eligible) < self.batch_size)
+        rng = np.random.RandomState(self.seed + step)
+        return rng.choice(eligible, size=self.batch_size,
+                          replace=len(eligible) < self.batch_size)
 
 
 class DataAnalyzer:

@@ -25,7 +25,8 @@ class DataLoader:
     def __init__(self, data: Dict[str, Any], batch_size: int,
                  shuffle: bool = True, seed: int = 0,
                  drop_last: bool = True,
-                 batch_fn: Optional[Callable[[Dict, int], Dict]] = None):
+                 batch_fn: Optional[Callable[[Dict, int], Dict]] = None,
+                 sampler: Optional[Any] = None):
         self.data = {k: np.asarray(v) for k, v in data.items()}
         sizes = {k: len(v) for k, v in self.data.items()}
         if len(set(sizes.values())) != 1:
@@ -36,6 +37,11 @@ class DataLoader:
         self.seed = seed
         self.drop_last = drop_last
         self.batch_fn = batch_fn
+        # difficulty-indexed sampling (reference: DeepSpeedDataSampler via
+        # deepspeed_io): any object with batch_indices(step) -> global ids
+        # overrides the epoch shuffle, e.g. data_pipeline.
+        # CurriculumDataSampler / engine.curriculum_sampler
+        self.sampler = sampler
         self.epoch = 0
         tail = self.n % batch_size
         if not drop_last and tail and tail % jax.process_count():
@@ -55,9 +61,11 @@ class DataLoader:
         self.epoch = epoch
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
-        order = np.arange(self.n)
-        if self.shuffle:
-            np.random.RandomState(self.seed + self.epoch).shuffle(order)
+        order = None
+        if self.sampler is None:
+            order = np.arange(self.n)
+            if self.shuffle:
+                np.random.RandomState(self.seed + self.epoch).shuffle(order)
         # process-sharded: each host reads its interleaved slice of every
         # global batch (rank striding like the reference sampler)
         pc, pi = jax.process_count(), jax.process_index()
@@ -69,7 +77,12 @@ class DataLoader:
             # torch convention: drop_last=False yields the short final
             # batch.  SPMD training wants drop_last=True (the default) —
             # shard_batch requires batch % mesh data axes == 0.
-            sel = order[step * self.batch_size:(step + 1) * self.batch_size]
+            if self.sampler is not None:
+                sel = np.asarray(self.sampler.batch_indices(
+                    step + self.epoch * len(self)))
+            else:
+                sel = order[step * self.batch_size:
+                            (step + 1) * self.batch_size]
             if pc > 1:
                 sel = sel[pi::pc]
             batch = {k: v[sel] for k, v in self.data.items()}

@@ -89,7 +89,8 @@ class Engine:
                  param_axes: Any = None,
                  sharding_rules: Optional[Dict] = None,
                  eval_fn: Optional[Callable] = None,
-                 monitor=None):
+                 monitor=None,
+                 model: Any = None):
         """``loss_fn(params, batch, rng) -> loss`` or ``(loss, aux_dict)``.
 
         ``params`` is a pytree of arrays (any dtype; cast to fp32 master).
@@ -173,7 +174,18 @@ class Engine:
             from .zero_infinity import NVMeOptimizer
             self._nvme = NVMeOptimizer(
                 off_opt.nvme_path, config.optimizer.type,
-                config.optimizer.params, buffer_size=off_opt.buffer_size)
+                config.optimizer.params, buffer_size=off_opt.buffer_size,
+                aio_config=config.aio)
+        # ZeRO-Infinity param streaming: offload_param=nvme + a
+        # stacked-layer model => per-layer NVMe parameter streaming
+        # (reference: partitioned_param_swapper.py:290 / stage3.py:614)
+        self._model = model
+        self._stream = None
+        self._stream_params = (
+            self._nvme is not None
+            and config.zero_optimization.offload_param.device == "nvme"
+            and model is not None and hasattr(model, "config")
+            and isinstance(params, dict) and "blocks" in params)
         self._build_shardings(params)
         self._qgz_axes = self._qgz_manual_axes()
         self._sparse_axes = self._sparse_manual_axes(params)
@@ -290,7 +302,18 @@ class Engine:
             self.master_specs = self.param_specs
             self.master_shardings = self.param_shardings
             offp = self.config.zero_optimization.offload_param.device
+            if self._stream_params:
+                # per-layer NVMe param streaming: the working copy never
+                # stages anywhere whole — layers stream through HBM
+                # (param_stream.py); shardings stay plain device specs
+                return
             if offp in ("cpu", "nvme"):
+                if offp == "nvme":
+                    logger.warning(
+                        "offload_param.device=nvme without a stacked-"
+                        "layer model: staging the full bf16 working copy "
+                        "in host DRAM; pass model= (models.transformer) "
+                        "to stream parameters per layer instead")
                 if self._host_memory_supported():
                     multi = self.topology.mesh.size > 1
                     self.master_shardings = jax.tree.map(
@@ -298,12 +321,6 @@ class Engine:
                         else sh.with_memory_kind("pinned_host"),
                         self.master_shardings)
                     self.offload_active = True
-                    if offp == "nvme":
-                        logger.warning(
-                            "offload_param.device=nvme: the bf16 working "
-                            "copy stages in host DRAM (fp32 masters are on "
-                            "NVMe); per-layer NVMe param streaming is not "
-                            "implemented yet")
                 else:
                     logger.warning(
                         "offload_param requested but this backend has no "
@@ -321,11 +338,14 @@ class Engine:
                 # LAMB trust ratios need whole-tensor norms; the offload
                 # update runs per-shard inside shard_map, which would
                 # silently compute per-shard ratios.
-                logger.warning(
-                    "optimizer offload is not supported with LAMB "
-                    "(per-tensor trust ratios); keeping optimizer state "
-                    "in device memory")
-            elif self._host_memory_supported():
+                raise ConfigError(
+                    "optimizer offload is not supported with LAMB: trust "
+                    "ratios need whole-tensor parameter/update norms, but "
+                    "the offloaded update runs per-shard inside shard_map "
+                    "and would silently compute per-shard ratios. Use "
+                    "adam/adamw/lion/adagrad/sgd with offload, or drop "
+                    "offload_optimizer/offload_param for LAMB.")
+            if self._host_memory_supported():
                 # Per-leaf placement: only sharded leaves move to host DRAM.
                 # Under multi-device SPMD, fully-replicated leaves (tiny
                 # params the mesh can't divide) stay in HBM — the
@@ -430,7 +450,19 @@ class Engine:
     def _init_state_nvme(self, params) -> TrainState:
         """ZeRO-Infinity init: fp32 master + zero moments written straight
         to NVMe (never materialized in HBM); the device keeps only the
-        bf16 working copy in the compute layout."""
+        bf16 working copy in the compute layout — or, with param
+        streaming, only the RESIDENT (non-layer) leaves."""
+        if self._stream_params:
+            from .param_stream import StreamedInfinityTrainer
+            self._stream = StreamedInfinityTrainer(self, self._model,
+                                                   params)
+            self.opt_shardings = ()
+            return TrainState(
+                step=jnp.zeros((), jnp.int32),
+                master=self._stream.resident,
+                opt_state=(),
+                loss_scale=self.scaler.init(),
+                skipped=jnp.zeros((), jnp.int32))
         dev_sh = jax.tree.map(
             lambda sh: NamedSharding(self.topology.mesh, sh.spec),
             self.master_shardings)
@@ -449,7 +481,15 @@ class Engine:
                     str(e).splitlines()[0][:120])
                 self.offload_active = False
                 self.master_shardings = dev_sh
-        self._nvme.initialize(params)
+        # multi-host: masters partition into per-process fragments along
+        # the GRADIENT layout — the layout step grads arrive in, so every
+        # process's update reads only addressable shards (reference:
+        # per-rank swap, stage3.py:614)
+        self._nvme_grad_sh = jax.tree.map(
+            lambda sp: NamedSharding(self.topology.mesh, sp),
+            self.grad_specs, is_leaf=lambda x: isinstance(x, P))
+        self._nvme_reshard_fn = None
+        self._nvme.initialize(params, shardings=self._nvme_grad_sh)
         self.opt_shardings = ()
         return TrainState(
             step=jnp.zeros((), jnp.int32),
@@ -473,23 +513,49 @@ class Engine:
     def _setup_data_efficiency(self) -> None:
         cfg = self.config
         self.curriculum = None
+        self.curriculum_sampler = None
         ccfg = cfg.curriculum_learning
         de = cfg.data_efficiency
         if de.enabled and de.data_sampling.enabled \
                 and de.data_sampling.curriculum_learning.enabled:
             ccfg = de.data_sampling.curriculum_learning
         if ccfg.enabled:
-            if ccfg.curriculum_type != "seqlen":
-                raise ConfigError(
-                    "only the 'seqlen' curriculum metric is engine-wired "
-                    "(metric-indexed sampling: runtime.data_pipeline."
-                    "CurriculumDataSampler on the dataloader side)")
-            from .data_pipeline import CurriculumScheduler
-            self.curriculum = CurriculumScheduler({
-                "min_difficulty": ccfg.min_difficulty,
-                "max_difficulty": ccfg.max_difficulty,
-                "schedule_type": ccfg.schedule_type,
-                "schedule_config": ccfg.schedule_config})
+            from .data_pipeline import (CurriculumDataSampler,
+                                        CurriculumScheduler)
+
+            def sched():
+                return CurriculumScheduler({
+                    "min_difficulty": ccfg.min_difficulty,
+                    "max_difficulty": ccfg.max_difficulty,
+                    "schedule_type": ccfg.schedule_type,
+                    "schedule_config": ccfg.schedule_config})
+
+            if ccfg.curriculum_type == "seqlen":
+                # batch-shape curriculum: the engine truncates each batch
+                # in _data_efficiency_pre_step
+                self.curriculum = sched()
+            else:
+                # metric-indexed curriculum: any DataAnalyzer metric drives
+                # *sampling order* (reference: data_sampler.py consuming
+                # index files produced by data_analyzer.py) — consumed via
+                # curriculum_dataloader()/curriculum_sampler
+                if not ccfg.data_analyzer_path:
+                    raise ConfigError(
+                        f"curriculum_type={ccfg.curriculum_type!r}: a "
+                        "metric curriculum needs data_analyzer_path "
+                        "pointing at a DataAnalyzer save dir containing "
+                        f"{ccfg.curriculum_type}/sample_to_metric.npy")
+                try:
+                    self.curriculum_sampler = CurriculumDataSampler\
+                        .from_analyzer(
+                            ccfg.data_analyzer_path, ccfg.curriculum_type,
+                            sched(), self.train_batch_size, seed=cfg.seed)
+                except FileNotFoundError as e:
+                    raise ConfigError(
+                        f"curriculum_type={ccfg.curriculum_type!r}: no "
+                        f"analyzer index under "
+                        f"{ccfg.data_analyzer_path!r} ({e}); run "
+                        "runtime.data_analyzer.DataAnalyzer first") from e
 
         self.pld = None
         if cfg.progressive_layer_drop.enabled:
@@ -596,6 +662,20 @@ class Engine:
                 if hasattr(self, "_compute_params_fn"):
                     del self._compute_params_fn
         return batch
+
+    def curriculum_dataloader(self, data, **kwargs):
+        """Build a :class:`~deepspeed_tpu.runtime.dataloader.DataLoader`
+        whose sampling order follows the configured metric curriculum
+        (reference: engine.deepspeed_io attaching DeepSpeedDataSampler).
+        Only valid when a non-seqlen ``curriculum_type`` is configured."""
+        if self.curriculum_sampler is None:
+            raise ConfigError(
+                "curriculum_dataloader() needs a metric curriculum "
+                "(curriculum_learning with curriculum_type != 'seqlen' "
+                "and data_analyzer_path set)")
+        from .dataloader import DataLoader
+        return DataLoader(data, self.train_batch_size,
+                          sampler=self.curriculum_sampler, **kwargs)
 
     def _measure_eigenvalue(self, batch, rng) -> float:
         """Dominant Hessian eigenvalue of the micro-loss at the current
@@ -1300,6 +1380,11 @@ class Engine:
         return jax.jit(nvme_step, in_shardings=(state_sh, None, None))
 
     def _train_batch_nvme(self, batch, rng) -> Dict[str, Any]:
+        if self._stream is not None:
+            # per-layer param streaming: the host loop IS the step
+            self.tput.start()
+            metrics = self._stream.train_batch(batch, rng)
+            return self._finish_step(batch, rng, metrics)
         if self._nvme_step_fn is None:
             self._nvme_step_fn = self._build_nvme_step()
         batch = self.shard_batch(batch)
@@ -1320,14 +1405,18 @@ class Engine:
         if finite_b:
             flat_grads = jax.tree_util.tree_leaves(grads)
             new_master = self._nvme.step(flat_grads, lr, step_next)
-            flat_sh = jax.tree_util.tree_leaves(
-                self.master_shardings,
-                is_leaf=lambda x: isinstance(x, jax.sharding.Sharding))
-            dev_leaves = [
-                jax.device_put(m.astype(self.compute_dtype), sh)
-                for m, sh in zip(new_master, flat_sh)]
-            master = jax.tree_util.tree_unflatten(
-                jax.tree_util.tree_structure(self.state.master), dev_leaves)
+            if self._nvme._multi:
+                master = self._assemble_nvme_master(new_master)
+            else:
+                flat_sh = jax.tree_util.tree_leaves(
+                    self.master_shardings,
+                    is_leaf=lambda x: isinstance(x, jax.sharding.Sharding))
+                dev_leaves = [
+                    jax.device_put(m.astype(self.compute_dtype), sh)
+                    for m, sh in zip(new_master, flat_sh)]
+                master = jax.tree_util.tree_unflatten(
+                    jax.tree_util.tree_structure(self.state.master),
+                    dev_leaves)
             new_step = jnp.asarray(step_next, jnp.int32)
             skipped = self.state.skipped
         else:
@@ -1340,6 +1429,31 @@ class Engine:
         metrics = dict(metrics)
         metrics["lr"] = jnp.float32(lr)
         return self._finish_step(batch, rng, metrics)
+
+    def _assemble_nvme_master(self, frag_leaves):
+        """Multi-host: build the device working copy from this process's
+        updated master fragments — per-device buffers in the gradient
+        layout, then one jitted reshard (XLA collectives over ICI) into
+        the compute layout."""
+        dt = self.compute_dtype
+        flat_sh = jax.tree_util.tree_leaves(
+            self._nvme_grad_sh,
+            is_leaf=lambda x: isinstance(x, jax.sharding.Sharding))
+        arrs = []
+        for i, (frags, sh) in enumerate(zip(frag_leaves, flat_sh)):
+            shape = self._nvme._leaf_meta[i][0]
+            imap = sh.devices_indices_map(shape)
+            fragmap = dict(zip(self._nvme._frags[i], frags))
+            bufs = [jax.device_put(fragmap[tuple(imap[d])].astype(dt), d)
+                    for d in sh.addressable_devices]
+            arrs.append(jax.make_array_from_single_device_arrays(
+                shape, sh, bufs))
+        tree = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(self.state.master), arrs)
+        if self._nvme_reshard_fn is None:
+            self._nvme_reshard_fn = jax.jit(
+                lambda t: t, out_shardings=self.master_shardings)
+        return self._nvme_reshard_fn(tree)
 
     # ------------------------------------------------------------------
     # public API (reference: engine.train_batch / forward+backward+step)
@@ -1427,6 +1541,9 @@ class Engine:
         return metrics
 
     def eval_batch(self, batch, rng: Optional[jax.Array] = None):
+        if self._stream is not None:
+            return np.asarray(self._stream.eval_batch(
+                batch, rng if rng is not None else jax.random.PRNGKey(0)))
         if self._eval_step_fn is None:
             fn = self.eval_fn or self.loss_fn
             # a pipelined 1F1B loss exposes a forward-only schedule for
@@ -1460,6 +1577,11 @@ class Engine:
         """Engine flops-profiler hook (reference: engine.py:288,1850 —
         module-hook profiler; here: compiled-HLO cost analysis + the step
         wall time already measured, no extra execution)."""
+        if self._stream is not None:
+            logger.warning("flops_profiler: param-streamed steps run as "
+                           "many per-layer programs; HLO cost analysis "
+                           "of the monolithic step is unavailable")
+            return
         from ..profiling import FlopsProfiler, analyze_fn
 
         stats = analyze_fn(self._train_step_fn or self._nvme_step_fn,
@@ -1576,6 +1698,12 @@ class Engine:
     @property
     def compute_params(self):
         """Current params in compute dtype (jitted gather+cast, cached)."""
+        if self._stream is not None:
+            raise ConfigError(
+                "compute_params is unavailable under param streaming "
+                "(offload_param.device=nvme): the full compute tree "
+                "never materializes — stream layers via "
+                "engine._stream or load a checkpoint instead")
         if not hasattr(self, "_compute_params_fn"):
             self._compute_params_fn = jax.jit(
                 self._compute_params, in_shardings=(self.master_shardings,))
@@ -1623,7 +1751,8 @@ class Engine:
         # fragment format as every other run.  Lazy leaves stream one
         # swap group at a time through host RAM (state may exceed DRAM).
         from .optimizers import AdamState
-        master, m, v = self._nvme.state_trees(lazy=True)
+        source = self._stream if self._stream is not None else self._nvme
+        master, m, v = source.state_trees(lazy=True)
         saved = self.state
         self.state = TrainState(
             step=saved.step, master=master,
@@ -1668,25 +1797,32 @@ class Engine:
         f32 = lambda tree: jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(np.shape(x), np.float32), tree)
         scalar = lambda dt: jax.ShapeDtypeStruct((), dt)
+        master_tpl = (self._stream.master_template()
+                      if self._stream is not None
+                      else f32(self.state.master))
         template = TrainState(
             step=scalar(np.int32),
-            master=f32(self.state.master),
-            opt_state=AdamState(m=f32(self.state.master),
-                                v=f32(self.state.master)),
+            master=master_tpl,
+            opt_state=AdamState(m=master_tpl, v=master_tpl),
             loss_scale=LossScaleState(scalar(np.float32), scalar(np.int32),
                                       scalar(np.int32)),
             skipped=scalar(np.int32))
         host, meta = load_tree_host(template, ckpt_dir)
-        self._nvme.restore(host.master, host.opt_state.m, host.opt_state.v)
-
-        flat = jax.tree_util.tree_leaves(host.master)
-        flat_sh = jax.tree_util.tree_leaves(
-            self.master_shardings,
-            is_leaf=lambda x: isinstance(x, jax.sharding.Sharding))
-        dev_leaves = [jax.device_put(m.astype(self.compute_dtype), sh)
-                      for m, sh in zip(flat, flat_sh)]
-        master = jax.tree_util.tree_unflatten(
-            jax.tree_util.tree_structure(self.state.master), dev_leaves)
+        if self._stream is not None:
+            self._stream.restore(host.master, host.opt_state.m,
+                                 host.opt_state.v)
+            master = self._stream.resident
+        else:
+            self._nvme.restore(host.master, host.opt_state.m,
+                               host.opt_state.v)
+            flat = jax.tree_util.tree_leaves(host.master)
+            flat_sh = jax.tree_util.tree_leaves(
+                self.master_shardings,
+                is_leaf=lambda x: isinstance(x, jax.sharding.Sharding))
+            dev_leaves = [jax.device_put(m.astype(self.compute_dtype), sh)
+                          for m, sh in zip(flat, flat_sh)]
+            master = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(self.state.master), dev_leaves)
         self.state = TrainState(
             step=jnp.asarray(host.step, jnp.int32),
             master=master, opt_state=(),
@@ -1791,4 +1927,4 @@ def initialize(loss_fn: Callable = None,
         raise ValueError("initialize() needs loss_fn+params or model=")
     return Engine(loss_fn=loss_fn, params=params, config=cfg,
                   topology=topology, param_axes=param_axes,
-                  sharding_rules=sharding_rules, **kwargs)
+                  sharding_rules=sharding_rules, model=model, **kwargs)

@@ -81,12 +81,22 @@ class OptimizerSwapper:
     """
 
     def __init__(self, swap_dir: str, num_groups: int,
-                 aio: Optional[AsyncIOHandle] = None):
+                 aio: Optional[AsyncIOHandle] = None,
+                 aio_config=None):
         # Two swappers (own aio pools) alternate over even/odd groups, so
         # waiting on group g's reads never drains the in-flight prefetch
         # of group g+1 — true double buffering.
-        self._swappers = (TensorSwapper(swap_dir, aio),
-                          TensorSwapper(swap_dir))
+        if aio is None and aio_config is not None:
+            # engine-config-driven pools (reference: aio block read at
+            # partitioned_param_swapper.py:83)
+            self._swappers = (
+                TensorSwapper(swap_dir,
+                              AsyncIOHandle.from_config(aio_config)),
+                TensorSwapper(swap_dir,
+                              AsyncIOHandle.from_config(aio_config)))
+        else:
+            self._swappers = (TensorSwapper(swap_dir, aio),
+                              TensorSwapper(swap_dir))
         self.num_groups = num_groups
         self._buffers: Dict[int, Any] = {}
 

@@ -140,17 +140,11 @@ class NVMeOptimizer:
 
     def __init__(self, nvme_path: str, opt_type: str,
                  opt_params: Dict[str, Any],
-                 buffer_size: int = 100_000_000):
+                 buffer_size: int = 100_000_000,
+                 aio_config=None):
         if not nvme_path:
             raise ConfigError(
                 "offload_optimizer.device=nvme requires nvme_path")
-        if jax.process_count() > 1:
-            # the host update consumes globally-assembled arrays
-            # (np.asarray of sharded grads), which a multi-controller run
-            # cannot fetch; per-host local-shard swapping is future work
-            raise ConfigError(
-                "offload_optimizer.device=nvme is single-controller only "
-                "for now (use device=cpu on multi-host runs)")
         # namespace by process + a per-engine token so two runs (or two
         # engines) sharing one NVMe mount never overwrite each other's
         # state (the reference swapper namespaces by rank the same way)
@@ -164,21 +158,70 @@ class NVMeOptimizer:
             self, shutil.rmtree, self.dir, True)
         self.adam = HostAdam(opt_type, opt_params)
         self.buffer_size = max(int(buffer_size), 1)
+        self.aio_config = aio_config
         self.groups: List[List[int]] = []      # leaf indices per group
         self.swapper: Optional[OptimizerSwapper] = None
         self._treedef = None
         self._leaf_meta: List[Tuple[tuple, Any]] = []
+        # optional ResidencyMeter (param_stream.py) accounting the host
+        # bytes of the in-flight swap group
+        self.meter = None
+        # multi-host: per-leaf addressable fragments (reference: per-rank
+        # swap files in stage3.py:614 — every process swaps only the
+        # shards its own devices hold)
+        self._multi = jax.process_count() > 1
+        self._frags: List[List[tuple]] = []        # leaf -> [shard index]
+        self._save_owned: List[List[bool]] = []    # leaf -> [this proc saves]
+        self._shardings: Optional[List[Any]] = None
 
     # ------------------------------------------------------------------
-    def initialize(self, params: Any) -> None:
+    def initialize(self, params: Any, shardings: Any = None) -> None:
         """Partition leaves into ~buffer_size groups; write fp32 master +
-        zero moments to NVMe (the zero.Init-time partitioning analog)."""
+        zero moments to NVMe (the zero.Init-time partitioning analog).
+
+        Multi-host: ``shardings`` (a matching tree of NamedShardings —
+        the layout the step's gradients arrive in) is required; each
+        process stores only the fragments its own devices address
+        (reference: per-rank swap files, stage3.py:614), deduplicating
+        replicas within the process."""
         leaves, self._treedef = jax.tree_util.tree_flatten(params)
         self._leaf_meta = [(tuple(np.shape(x)), np.float32) for x in leaves]
+        if self._multi:
+            if shardings is None:
+                raise ConfigError(
+                    "multi-host NVMe state needs the gradient shardings "
+                    "(engine wires these automatically)")
+            self._shardings = jax.tree_util.tree_leaves(
+                shardings,
+                is_leaf=lambda x: isinstance(x, jax.sharding.Sharding))
+            self._frags, self._save_owned = [], []
+            my_devs = {d.id for d in jax.local_devices()}
+            for (shape, _), sh in zip(self._leaf_meta, self._shardings):
+                imap = sh.devices_indices_map(shape)
+                by_idx: Dict[tuple, List[int]] = {}
+                for d, idx in imap.items():
+                    by_idx.setdefault(tuple(idx), []).append(d.id)
+                frags, owned = [], []
+                for idx in sorted(by_idx,
+                                  key=lambda t: min(by_idx[t])):
+                    holders = by_idx[idx]
+                    if not my_devs.intersection(holders):
+                        continue
+                    frags.append(idx)
+                    # exactly one process saves each fragment: the one
+                    # owning the globally-lowest device holding it
+                    owned.append(min(holders) in my_devs)
+                self._frags.append(frags)
+                self._save_owned.append(owned)
+        leaf_bytes = [
+            (sum(int(np.prod(self._frag_shape(i, k)) or 1) * 4
+                 for k in range(len(self._frags[i]))) if self._multi
+             else int(np.prod(self._leaf_meta[i][0]) or 1) * 4)
+            for i in range(len(leaves))]
         self.groups = []
         cur, cur_bytes = [], 0
         for i, leaf in enumerate(leaves):
-            nbytes = int(np.prod(np.shape(leaf)) or 1) * 4
+            nbytes = leaf_bytes[i]
             if cur and cur_bytes + nbytes > self.buffer_size:
                 self.groups.append(cur)
                 cur, cur_bytes = [], 0
@@ -186,41 +229,154 @@ class NVMeOptimizer:
             cur_bytes += nbytes
         if cur:
             self.groups.append(cur)
-        self.swapper = OptimizerSwapper(self.dir, len(self.groups))
+        self.swapper = OptimizerSwapper(self.dir, len(self.groups),
+                                        aio_config=self.aio_config)
         for g, idxs in enumerate(self.groups):
-            ps = [np.asarray(leaves[i], np.float32) for i in idxs]
-            ms = [np.zeros_like(p) for p in ps]
-            vs = [np.zeros_like(p) for p in ps]
+            ps = [self._leaf_payload(leaves[i], i) for i in idxs]
+            ms = [jax.tree.map(np.zeros_like, p) for p in ps]
+            vs = [jax.tree.map(np.zeros_like, p) for p in ps]
             self.swapper.write_group(g, (ps, ms, vs))
         log_dist(f"ZeRO-Infinity: {len(leaves)} leaves in "
-                 f"{len(self.groups)} NVMe swap groups under {self.dir}")
+                 f"{len(self.groups)} NVMe swap groups under {self.dir}"
+                 + (" (per-process shard fragments)" if self._multi
+                    else ""))
+
+    def _frag_shape(self, i: int, k: int) -> tuple:
+        shape = self._leaf_meta[i][0]
+        idx = self._frags[i][k]
+        return tuple(
+            (sl.stop if sl.stop is not None else dim)
+            - (sl.start if sl.start is not None else 0)
+            for sl, dim in zip(idx, shape)) if idx else shape
+
+    @staticmethod
+    def _covering_slice(shard_idx, frag_idx):
+        """If ``shard_idx`` covers ``frag_idx``, return the relative
+        slices of the fragment within the shard; else None."""
+        rel = []
+        for ss, fs in zip(shard_idx, frag_idx):
+            s0 = ss.start or 0
+            f0 = fs.start or 0
+            if f0 < s0 or (ss.stop is not None and fs.stop is not None
+                           and fs.stop > ss.stop):
+                return None
+            rel.append(slice(f0 - s0, None if fs.stop is None
+                             else fs.stop - s0))
+        return tuple(rel)
+
+    def _leaf_payload(self, leaf, i: int):
+        """fp32 host payload of one leaf: the whole array (single-host)
+        or the list of this process's fragments (multi-host).  A device
+        leaf in a DIFFERENT layout than the fragment partition (e.g.
+        replicated params at init) is served by slicing any addressable
+        shard that covers the fragment."""
+        if not self._multi:
+            return np.asarray(leaf, np.float32)
+        out = []
+        for idx in self._frags[i]:
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                data = None
+                for sh in leaf.addressable_shards:
+                    if tuple(sh.index) == idx:
+                        data = np.asarray(sh.data, np.float32)
+                        break
+                if data is None:
+                    for sh in leaf.addressable_shards:
+                        rel = self._covering_slice(tuple(sh.index), idx)
+                        if rel is not None:
+                            data = np.asarray(sh.data,
+                                              np.float32)[rel]
+                            break
+                if data is None:
+                    raise ValueError(
+                        f"leaf {i}: no addressable shard matches or "
+                        f"covers fragment {idx}")
+                out.append(data)
+            else:
+                out.append(np.asarray(leaf, np.float32)[idx]
+                           if idx else np.asarray(leaf, np.float32))
+        return out
 
     def _template(self, g: int):
-        shapes = [self._leaf_meta[i] for i in self.groups[g]]
-        mk = lambda: [jax.ShapeDtypeStruct(s, d) for s, d in shapes]
+        if self._multi:
+            mk = lambda: [
+                [jax.ShapeDtypeStruct(self._frag_shape(i, k), np.float32)
+                 for k in range(len(self._frags[i]))]
+                for i in self.groups[g]]
+        else:
+            shapes = [self._leaf_meta[i] for i in self.groups[g]]
+            mk = lambda: [jax.ShapeDtypeStruct(s, d) for s, d in shapes]
         return (mk(), mk(), mk())
 
     # ------------------------------------------------------------------
     def step(self, grad_leaves: Sequence[Any], lr: float,
-             step_num: int) -> List[np.ndarray]:
+             step_num: int,
+             consume: Optional[Callable[[int, np.ndarray], None]] = None
+             ) -> Optional[List[np.ndarray]]:
         """One optimizer step over all groups with double-buffered
-        prefetch.  ``grad_leaves``: flat leaves (device arrays; fetched
-        lazily per group).  Returns flat fp32 master leaves."""
+        prefetch.  ``grad_leaves``: flat leaves (device arrays or lazy
+        readers; fetched per group).  Returns flat fp32 master leaves —
+        unless ``consume`` is given, in which case each fresh master leaf
+        is handed to ``consume(leaf_index, p_new)`` and released (the
+        param-streaming path: the full fp32 tree never materializes)."""
         assert self.swapper is not None, "initialize() first"
-        new_leaves: List[Optional[np.ndarray]] = [None] * len(self._leaf_meta)
+        new_leaves: List[Optional[np.ndarray]] = \
+            None if consume else [None] * len(self._leaf_meta)
         G = len(self.groups)
+
+        def group_bytes(g):
+            return 3 * sum(int(np.prod(self._leaf_meta[i][0]) or 1) * 4
+                           for i in self.groups[g])
+
         if G:
             self.swapper.prefetch_group(0, self._template(0))
         for g, idxs in enumerate(self.groups):
             if g + 1 < G:       # overlap: next group's read behind update
                 self.swapper.prefetch_group(g + 1, self._template(g + 1))
+            if self.meter is not None:
+                self.meter.alloc(group_bytes(g)
+                                 + (group_bytes(g + 1) if g + 1 < G else 0))
             ps, ms, vs = self.swapper.read_group(g, self._template(g))
             for j, i in enumerate(idxs):
-                gnp = np.asarray(grad_leaves[i], np.float32)
-                self.adam.update(ps[j], ms[j], vs[j], gnp, lr, step_num)
-                new_leaves[i] = ps[j]
+                if self._multi:
+                    gmap = self._grad_frags(grad_leaves[i], i)
+                    for k, idx in enumerate(self._frags[i]):
+                        self.adam.update(ps[j][k], ms[j][k], vs[j][k],
+                                         gmap[idx], lr, step_num)
+                else:
+                    gnp = np.asarray(grad_leaves[i], np.float32)
+                    self.adam.update(ps[j], ms[j], vs[j], gnp, lr,
+                                     step_num)
+                if consume is not None:
+                    consume(i, ps[j])
+                else:
+                    new_leaves[i] = ps[j]
             self.swapper.write_group(g, (ps, ms, vs))
+            if self.meter is not None:
+                self.meter.free(group_bytes(g)
+                                + (group_bytes(g + 1) if g + 1 < G else 0))
         return new_leaves  # type: ignore[return-value]
+
+    def _grad_frags(self, g, i: int) -> Dict[tuple, np.ndarray]:
+        """This process's gradient fragments for leaf i, keyed by shard
+        index.  A jax array must carry the layout the masters were
+        partitioned by (the engine guarantees this; a mismatch is a hard
+        error, not silent corruption)."""
+        if isinstance(g, jax.Array) and not g.is_fully_addressable:
+            by_idx: Dict[tuple, Any] = {}
+            for sh in g.addressable_shards:
+                by_idx.setdefault(tuple(sh.index), sh.data)
+            out = {}
+            for idx in self._frags[i]:
+                if idx not in by_idx:
+                    raise ValueError(
+                        f"leaf {i}: gradient sharding does not match the "
+                        f"NVMe fragment layout (missing index {idx}); "
+                        "the grad layout changed after initialize()")
+                out[idx] = np.asarray(by_idx[idx], np.float32)
+            return out
+        arr = np.asarray(g, np.float32)
+        return {idx: (arr[idx] if idx else arr) for idx in self._frags[i]}
 
     # ------------------------------------------------------------------
     # checkpoint support: materialize / restore the full fp32 state
@@ -233,7 +389,15 @@ class NVMeOptimizer:
         reads its swap group from NVMe only when ``np.asarray`` touches
         it, with a one-group cache.  The checkpoint writer walks leaves
         sequentially, so peak host RAM is ONE swap group instead of the
-        whole fp32 state (the >host-DRAM checkpoint path)."""
+        whole fp32 state (the >host-DRAM checkpoint path).
+
+        Multi-host: no process can materialize a full leaf — returns
+        trees of :class:`~deepspeed_tpu.checkpoint.engine.HostShards`
+        snapshots carrying only this process's save-owned fragments
+        (read from NVMe lazily), which is exactly what the fragment
+        checkpoint writer consumes."""
+        if self._multi:
+            return self._state_trees_multi()
         if lazy:
             cache: Dict[Tuple[int, int], list] = {}
 
@@ -281,18 +445,55 @@ class NVMeOptimizer:
         sw.wait()
         return bufs
 
+    def _frag_key(self, g: int, col: int, j: int, k: int) -> str:
+        """Swap key of one fragment — the (ps, ms, vs) template's flat
+        path ``[col][j][k]`` under group g (matches OptimizerSwapper's
+        keystr-derived keys)."""
+        return f"g{g}[{col}][{j}][{k}]"
+
+    def _state_trees_multi(self) -> Tuple[Any, Any, Any]:
+        from ..checkpoint.engine import HostShards
+        cols = [[None] * len(self._leaf_meta) for _ in range(3)]
+        for g, idxs in enumerate(self.groups):
+            for col in range(3):
+                for j, i in enumerate(idxs):
+                    hs = HostShards.__new__(HostShards)
+                    hs.shape = self._leaf_meta[i][0]
+                    hs.dtype = np.dtype(np.float32)
+                    hs.shards = self._owned_shard_iter(g, col, j, i)
+                    cols[col][i] = hs
+        return tuple(jax.tree_util.tree_unflatten(self._treedef, col)
+                     for col in cols)
+
+    def _owned_shard_iter(self, g: int, col: int, j: int, i: int):
+        """Lazily yield (index, fragment) for the save-owned fragments of
+        leaf i — each fragment is read from NVMe only when the writer
+        reaches it (peak host RAM: one fragment)."""
+        shape = self._leaf_meta[i][0]
+        for k, idx in enumerate(self._frags[i]):
+            if not self._save_owned[i][k]:
+                continue
+            sw = self.swapper._swapper(g)
+            data = sw.swap_in(self._frag_key(g, col, j, k))
+            full = tuple(slice(0, d) for d in shape)
+            yield (idx if idx else full, data)
+
     def master_tree(self) -> Any:
         return self.state_trees()[0]
 
     def restore(self, master: Any, m: Any = None, v: Any = None) -> None:
-        """Overwrite NVMe state from full trees (checkpoint load)."""
+        """Overwrite NVMe state from full trees (checkpoint load).
+        Multi-host: each process slices out and stores only its own
+        fragments of the (host-assembled) full leaves."""
         p_leaves = jax.tree_util.tree_leaves(master)
         m_leaves = jax.tree_util.tree_leaves(m) if m is not None else None
         v_leaves = jax.tree_util.tree_leaves(v) if v is not None else None
         for g, idxs in enumerate(self.groups):
-            ps = [np.asarray(p_leaves[i], np.float32) for i in idxs]
-            ms = ([np.asarray(m_leaves[i], np.float32) for i in idxs]
-                  if m_leaves else [np.zeros_like(p) for p in ps])
-            vs = ([np.asarray(v_leaves[i], np.float32) for i in idxs]
-                  if v_leaves else [np.zeros_like(p) for p in ps])
+            ps = [self._leaf_payload(p_leaves[i], i) for i in idxs]
+            ms = ([self._leaf_payload(m_leaves[i], i) for i in idxs]
+                  if m_leaves else
+                  [jax.tree.map(np.zeros_like, p) for p in ps])
+            vs = ([self._leaf_payload(v_leaves[i], i) for i in idxs]
+                  if v_leaves else
+                  [jax.tree.map(np.zeros_like, p) for p in ps])
             self.swapper.write_group(g, (ps, ms, vs))

@@ -191,12 +191,60 @@ class TestEngineCurriculum:
                                           jax.random.PRNGKey(0))
         assert b["input_ids"].shape[1] == 16
 
-    def test_non_seqlen_metric_rejected(self):
+    def test_metric_curriculum_needs_analyzer_path(self):
         from deepspeed_tpu.config.config import ConfigError
 
-        with pytest.raises(ConfigError, match="seqlen"):
+        with pytest.raises(ConfigError, match="data_analyzer_path"):
             _engine({"curriculum_learning": {
                 "enabled": True, "curriculum_type": "vocabularyrarity"}})
+
+    def test_metric_curriculum_drives_sampling(self, tmp_path):
+        """An arbitrary offline DataAnalyzer metric drives the sampling
+        order end-to-end (reference: data_sampler.py consuming
+        data_analyzer.py index files)."""
+        from deepspeed_tpu.runtime.data_analyzer import DataAnalyzer
+
+        # corpus whose metric == fraction of rare tokens; easy first
+        r = np.random.RandomState(0)
+        n, seq = 64, 32
+        ids = r.randint(0, 64, (n, seq))
+        rare_frac = np.linspace(0.0, 1.0, n)
+        for i in range(n):
+            k = int(rare_frac[i] * seq)
+            ids[i, :k] = r.randint(64, 128, k)
+        samples = [{"input_ids": ids[i]} for i in range(n)]
+        DataAnalyzer(samples, {"vocabularyrarity": lambda s: float(
+            (s["input_ids"] >= 64).mean())}, str(tmp_path)).run()
+
+        eng = _engine({"curriculum_learning": {
+            "enabled": True, "curriculum_type": "vocabularyrarity",
+            "data_analyzer_path": str(tmp_path),
+            "min_difficulty": 0, "max_difficulty": 1,
+            "schedule_type": "fixed_discrete",
+            "schedule_config": {"difficulty": [0, 1], "max_step": [3]}}})
+        assert eng.curriculum is None            # no seqlen truncation
+        assert eng.curriculum_sampler is not None
+        loader = eng.curriculum_dataloader({"input_ids": ids})
+        batches = list(loader)
+        # early steps draw from the easiest pool (padded to batch_size
+        # with the next-easiest when too few clear the bound): the rare-
+        # token fraction must sit far below the corpus mean (~0.5)
+        early = batches[0]["input_ids"]
+        assert (early >= 64).mean() < 0.25, \
+            "early batch must come from the easy end of the corpus"
+        m = eng.train_batch(batches[0])
+        assert np.isfinite(float(m["loss"]))
+
+    def test_metric_curriculum_missing_index_errors(self, tmp_path):
+        from deepspeed_tpu.config.config import ConfigError
+
+        with pytest.raises(ConfigError, match="analyzer index"):
+            _engine({"curriculum_learning": {
+                "enabled": True, "curriculum_type": "nosuchmetric",
+                "data_analyzer_path": str(tmp_path),
+                "schedule_type": "fixed_discrete",
+                "schedule_config": {"difficulty": [0, 1],
+                                    "max_step": [3]}}})
 
 
 class TestEnginePLD:
