@@ -58,6 +58,12 @@ class InferenceConfig:
     # "int8" | "int4": group-quantized weights, one layer dequantized at
     # a time inside the forward (2-4x smaller resident model)
     weight_quant: Optional[str] = None
+    # mixed-input GEMM (int8 weight x bf16 act, dequant in VMEM —
+    # ops/mixed_gemm.py; reference: cuda_linear fp6 GEMM): "auto" races
+    # it against the fused-dequant XLA path once post-compile (like
+    # attn_impl); "on"/"off" force.  Only engages for row-wise int8
+    # quant trees.
+    mixed_gemm: str = "auto"
     quantize_embeddings: bool = False
     # keep the paged KV cache in host memory, streaming one layer per
     # scan step through HBM (over-HBM contexts; needs pinned_host)
@@ -356,6 +362,8 @@ class InferenceEngine:
         impl = self.icfg.attn_impl
         if impl == "auto":
             impl = self._probe_attn_impl()
+        mixed = self._resolve_mixed_gemm(impl)
+        self._mixed_gemm_active = mixed
 
         kv_host = getattr(self, "_kv_on_host", False)
         shard_mesh = self._tp_mesh
@@ -369,7 +377,7 @@ class InferenceEngine:
             return ragged_forward(cfg, params, kv, batch, bs, mbs,
                                   attn_impl=impl, quant=quant,
                                   kv_host=kv_host, shard_mesh=shard_mesh,
-                                  stream=stream)
+                                  stream=stream, mixed_gemm=mixed)
 
         if kv_host:
             # pin the cache output to host memory so the persistent
@@ -384,26 +392,26 @@ class InferenceEngine:
                            out_shardings=(self._repl, self._kv_nsh))
         return jax.jit(step, donate_argnums=(2,))
 
-    def _probe_attn_impl(self) -> str:
-        """Time one ragged forward per implementation on the real compiled
-        shapes and keep the winner (the Pallas streaming kernel wins on
-        bare-metal TPUs; the XLA gather path wins on CPU meshes and some
-        virtualized/tunneled chips where Mosaic underperforms).  Results
-        are memoized per (backend, shape signature) for the process."""
+    def _probe_key(self, what: str):
+        cfg = self.cfg
+        topo_sig = (None if self.topology is None else
+                    tuple(sorted(self.topology.axis_sizes.items())))
+        return (what, jax.default_backend(), cfg.num_layers, cfg.d_model,
+                cfg.num_heads, cfg.num_kv_heads, self.icfg.token_budget,
+                self.icfg.max_seqs, self.icfg.kv_block_size,
+                self.icfg.num_kv_blocks, self.max_blocks_per_seq,
+                topo_sig, self._tp_mesh is not None)
+
+    def _probe_variants(self, label: str, variants):
+        """Race full ragged steps, one per variant (name -> extra
+        ragged_forward kwargs), on the real compiled shapes; returns
+        {name: seconds-per-3-steps} for whatever survived."""
         import time
 
         cfg, bs, mbs = self.cfg, self.icfg.kv_block_size, \
             self.max_blocks_per_seq
         T, ms = self.icfg.token_budget, self.icfg.max_seqs
         nb = self.icfg.num_kv_blocks
-        topo_sig = (None if self.topology is None else
-                    tuple(sorted(self.topology.axis_sizes.items())))
-        key = (jax.default_backend(), cfg.num_layers, cfg.d_model,
-               cfg.num_heads, cfg.num_kv_heads, T, ms, bs, nb, mbs,
-               topo_sig, self._tp_mesh is not None)
-        cached = _PROBE_CACHE.get(key)
-        if cached is not None:
-            return cached
         # synthetic batch on the compiled shapes — does NOT touch the
         # state manager (no slot/block allocation).  Representative work:
         # every slot at FULL context (tables fully populated, positions at
@@ -429,18 +437,19 @@ class InferenceEngine:
         # threading the cache through — never two full KV pools live at
         # once, matching the real step's memory profile
         kv = self.state.kv
-        for impl in ("xla", "pallas"):
+        for name, extra in variants.items():
             try:
                 jit_kw = {}
                 if self._kv_nsh is not None:
                     jit_kw["out_shardings"] = (self._repl, self._kv_nsh)
 
-                def probe_step(params, quant, pkv, pbatch, _impl=impl):
+                def probe_step(params, quant, pkv, pbatch, _extra=extra):
                     return ragged_forward(
                         cfg, params, pkv, pbatch, bs, mbs,
-                        attn_impl=_impl, quant=quant,
+                        quant=quant,
                         shard_mesh=self._tp_mesh, stream=self._stream,
-                        kv_host=getattr(self, "_kv_on_host", False))
+                        kv_host=getattr(self, "_kv_on_host", False),
+                        **_extra)
 
                 f = jax.jit(probe_step, donate_argnums=(2,), **jit_kw)
                 logits, kv = f(self.params, self._quant, kv, batch)
@@ -457,32 +466,85 @@ class InferenceEngine:
                 best = min(results.values()) if results else None
                 if warm3 > (180.0 if best is None
                             else max(30.0, 10 * best)):
-                    logger.info(f"paged-attention probe: {impl} at "
+                    logger.info(f"{label} probe: {name} at "
                                 f"{warm3 / 3:.1f}s/step — skipping "
                                 "timed loop")
-                    results[impl] = warm3
+                    results[name] = warm3
                     continue
                 t0 = time.perf_counter()
                 for _ in range(3):
                     logits, kv = f(self.params, self._quant, kv, batch)
                 float(jnp.sum(logits))      # completion barrier
-                results[impl] = time.perf_counter() - t0
+                results[name] = time.perf_counter() - t0
             except Exception as e:          # Mosaic unavailable/failed
-                logger.warning(f"paged-attention probe: {impl} failed "
+                logger.warning(f"{label} probe: {name} failed "
                                f"({type(e).__name__}); skipping")
         # restore a pristine zero cache (the probe wrote its fake token)
         self.state.kv = self._kv_zeros()
         if getattr(self, "_kv_on_host", False):
             self.state.kv = jax.device_put(self.state.kv,
                                            jax.memory.Space.Host)
-        best = min(results, key=results.get) if results else "xla"
         if results:
             logger.info(
-                f"paged-attention probe: {best} "
+                f"{label} probe: {min(results, key=results.get)} "
                 f"({ {k: round(v * 1e3, 1) for k, v in results.items()} }"
                 " ms/3 steps)")
+        return results
+
+    def _probe_attn_impl(self) -> str:
+        """Time one ragged forward per implementation on the real compiled
+        shapes and keep the winner (the Pallas streaming kernel wins on
+        bare-metal TPUs; the XLA gather path wins on CPU meshes and some
+        virtualized/tunneled chips where Mosaic underperforms).  Results
+        are memoized per (backend, shape signature) for the process."""
+        key = self._probe_key("attn")
+        cached = _PROBE_CACHE.get(key)
+        if cached is not None:
+            return cached
+        results = self._probe_variants(
+            "paged-attention",
+            {"xla": {"attn_impl": "xla"}, "pallas": {"attn_impl": "pallas"}})
+        best = min(results, key=results.get) if results else "xla"
         _PROBE_CACHE[key] = best
         return best
+
+    def _quant_is_rowwise(self) -> bool:
+        """The mixed-input kernel consumes only the row-wise int8
+        symmetric layout (payload in the weight's own shape)."""
+        from ..ops.quant import QuantizedTensor
+        if self._quant is None:
+            return False
+        leaves = [x for x in jax.tree.leaves(
+            self._quant, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+            if isinstance(x, QuantizedTensor)]
+        return bool(leaves) and all(
+            q.bits == 8 and q.zero is None
+            and tuple(q.data.shape) == tuple(q.shape) for q in leaves)
+
+    def _resolve_mixed_gemm(self, attn_impl: str) -> bool:
+        """Resolve the mixed_gemm config to a bool for this build
+        (reference analog: the cuda_linear kernel selection)."""
+        mode = self.icfg.mixed_gemm
+        if mode == "on" and self._stream is not None:
+            raise ValueError(
+                "mixed_gemm='on' does not compose with weight_stream "
+                "(streamed payloads dequantize on fetch); use 'auto'")
+        if mode == "off" or not self._quant_is_rowwise() \
+                or self._stream is not None:
+            return False
+        if mode == "on":
+            return True
+        key = self._probe_key("mixed_gemm_" + attn_impl)
+        cached = _PROBE_CACHE.get(key)
+        if cached is None:
+            results = self._probe_variants(
+                "mixed-gemm",
+                {"dequant": {"attn_impl": attn_impl, "mixed_gemm": False},
+                 "mixed": {"attn_impl": attn_impl, "mixed_gemm": True}})
+            cached = (min(results, key=results.get) == "mixed"
+                      if results else False)
+            _PROBE_CACHE[key] = cached
+        return cached
 
     # ------------------------------------------------------------------
     # request API (reference: engine_v2.put :107)
@@ -644,7 +706,8 @@ class InferenceEngine:
             prefix = snapshot_prefix(kv, block_tables, P, bs)
             toks, tail = decode_burst_forward(
                 cfg, params, prefix, base_ctx, token0, steps, sample_fn,
-                rng, quant=quant)
+                rng, quant=quant,
+                mixed_gemm=getattr(self, "_mixed_gemm_active", False))
             kv = scatter_tail(kv, tail, block_tables, base_ctx, bs)
             return toks, kv
 

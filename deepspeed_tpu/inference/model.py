@@ -23,6 +23,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..models import layers as L
 from ..models.transformer import TransformerConfig, _norm
@@ -187,12 +188,27 @@ def _stream_layer(stream, li, dt):
     return lp
 
 
+def _mm(x, w, dt, contract_dims: int = 1):
+    """``x @ w`` where ``w`` is dense — or a row-wise QuantizedTensor,
+    routed through the mixed-input VMEM-dequant kernel
+    (ops/mixed_gemm.py; reference: cuda_linear fp6_linear.cu)."""
+    from ..ops.quant import QuantizedTensor
+    if isinstance(w, QuantizedTensor):
+        from ..ops.mixed_gemm import mixed_matmul
+        return mixed_matmul(x, w, contract_dims=contract_dims,
+                            out_dtype=dt)
+    wshape = w.shape
+    K = int(np.prod(wshape[:contract_dims]))
+    y = x.reshape(-1, K) @ w.reshape(K, -1).astype(dt)
+    return y.reshape(*x.shape[:-1], *wshape[contract_dims:])
+
+
 def _qkv_proj(cfg, ap, h, dt, cos, sin, positions):
     """Shared qkv projection + biases + rotary for the serving forwards
     (ragged step and decode burst)."""
-    q = jnp.einsum("td,dhk->thk", h, ap["wq"].astype(dt))
-    k = jnp.einsum("td,dhk->thk", h, ap["wk"].astype(dt))
-    v = jnp.einsum("td,dhk->thk", h, ap["wv"].astype(dt))
+    q = _mm(h, ap["wq"], dt)
+    k = _mm(h, ap["wk"], dt)
+    v = _mm(h, ap["wv"], dt)
     if cfg.attn_bias:
         q = q + ap["bq"].astype(dt)
         k = k + ap["bk"].astype(dt)
@@ -216,14 +232,14 @@ def _ffn(cfg, lp, h, dt, act):
                          activation=act, gated=cfg.gated_mlp)
         return d[0]
     mp = lp["mlp"]
-    u = h @ mp["wi"].astype(dt)
+    u = _mm(h, mp["wi"], dt)
     if cfg.mlp_bias:
         u = u + mp["bi"].astype(dt)
     if cfg.gated_mlp:
-        u = act(h @ mp["wg"].astype(dt)) * u
+        u = act(_mm(h, mp["wg"], dt)) * u
     else:
         u = act(u)
-    d = u @ mp["wo"].astype(dt)
+    d = _mm(u, mp["wo"], dt)
     if cfg.mlp_bias:
         d = d + mp["bo"].astype(dt)
     return d
@@ -237,6 +253,7 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
                    kv_host: bool = False,
                    shard_mesh=None,
                    stream=None,
+                   mixed_gemm: bool = False,
                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """→ (last_token_logits [max_seqs, vocab], new_kv).
 
@@ -283,7 +300,8 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
         if kv_host:
             kv_layer = jax.device_put(kv_layer, jax.memory.Space.Device)
         if quant is not None:
-            lp = merge_layer(lp, quant["blocks"], li, dt)
+            lp = merge_layer(lp, quant["blocks"], li, dt,
+                             mixed=mixed_gemm)
         ap = lp["attn"]
         h = norm(lp["ln1"], x)
         q, k, v = _qkv_proj(cfg, ap, h, dt, cos, sin, batch.positions)
@@ -295,7 +313,8 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
         else:
             o = _paged_attention(kv_layer, q, batch, block_size,
                                  max_blocks_per_seq, scale)
-        o = jnp.einsum("thk,hkd->td", o, ap["wo"].astype(dt))
+        o = _mm(o.reshape(o.shape[0], -1), ap["wo"], dt,
+                contract_dims=2)
         if cfg.attn_out_bias:
             o = o + ap["bo"].astype(dt)
         if not cfg.parallel_block:
@@ -352,7 +371,7 @@ def snapshot_prefix(kv, block_tables, P: int, block_size: int):
 
 def decode_burst_forward(cfg: TransformerConfig, params, prefix,
                          base_ctx, token0, steps: int, sample_fn,
-                         rng, quant=None):
+                         rng, quant=None, mixed_gemm: bool = False):
     """Run ``steps`` decode iterations entirely on device.
 
     prefix: [L, S, P, 2, Hkv, D] dense read-only context (closure-sized
@@ -391,7 +410,8 @@ def decode_burst_forward(cfg: TransformerConfig, params, prefix,
         """x: [S, dm]; tail_l: [S, K, 2, Hkv, D] this layer's in-burst
         KV.  Returns (y, tail_l with slot j written)."""
         if quant is not None:
-            lp = merge_layer(lp, quant["blocks"], li, dt)
+            lp = merge_layer(lp, quant["blocks"], li, dt,
+                             mixed=mixed_gemm)
         ap = lp["attn"]
         h = norm(lp["ln1"], x)
         q, k, v = _qkv_proj(cfg, ap, h, dt, cos, sin, pos)
@@ -432,7 +452,8 @@ def decode_burst_forward(cfg: TransformerConfig, params, prefix,
             jnp.maximum(denom, 1e-30)[..., None]
         o = o.reshape(S, H, D).astype(dt)
 
-        o = jnp.einsum("thk,hkd->td", o, ap["wo"].astype(dt))
+        o = _mm(o.reshape(o.shape[0], -1), ap["wo"], dt,
+                contract_dims=2)
         if cfg.attn_out_bias:
             o = o + ap["bo"].astype(dt)
         if not cfg.parallel_block:

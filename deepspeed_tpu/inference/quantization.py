@@ -65,12 +65,17 @@ def _quantize_stacked(w: jax.Array, bits: int) -> QuantizedTensor:
         bits=bits, shape=(w.shape[0],) + qts[0].shape, dtype=qts[0].dtype)
 
 
+def layer_qt(qt: QuantizedTensor, i) -> QuantizedTensor:
+    """Layer ``i``'s slice of a stacked QuantizedTensor, still quantized
+    (the mixed-input GEMM consumes this directly — ops/mixed_gemm.py)."""
+    return QuantizedTensor(qt.data[i], qt.scale[i],
+                           None if qt.zero is None else qt.zero[i],
+                           qt.bits, qt.shape[1:], qt.dtype)
+
+
 def layer_weight(qt: QuantizedTensor, i, dt) -> jax.Array:
     """Dequantize layer ``i`` of a stacked QuantizedTensor."""
-    row = QuantizedTensor(qt.data[i], qt.scale[i],
-                          None if qt.zero is None else qt.zero[i],
-                          qt.bits, qt.shape[1:], qt.dtype)
-    return dequantize_any(row, dt)
+    return dequantize_any(layer_qt(qt, i), dt)
 
 
 def quantize_model_params(params: Dict[str, Any], bits: int = 8,
@@ -115,13 +120,18 @@ def quantize_model_params(params: Dict[str, Any], bits: int = 8,
 
 
 def merge_layer(lp: Dict[str, Any], quant_blocks: Dict[str, Any], i,
-                dt) -> Dict[str, Any]:
+                dt, mixed: bool = False) -> Dict[str, Any]:
     """Reassemble one layer's full param dict: the scanned dense slice
-    plus this layer's dequantized weights."""
+    plus this layer's quantized weights — dequantized here, or (with
+    ``mixed=True``) left as row-wise QuantizedTensors for the
+    mixed-input GEMM (dequant happens in VMEM inside the kernel)."""
     out = dict(lp)
     for group_name, qgroup in quant_blocks.items():
         g = dict(out.get(group_name, {}))
         for name, qt in qgroup.items():
-            g[name] = layer_weight(qt, i, dt)
+            if mixed and qt.bits == 8 and qt.zero is None:
+                g[name] = layer_qt(qt, i)
+            else:
+                g[name] = layer_weight(qt, i, dt)
         out[group_name] = g
     return out

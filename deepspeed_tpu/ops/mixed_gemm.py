@@ -1,0 +1,146 @@
+"""Mixed-input GEMM: int8 weights x bf16 activations, dequant in VMEM.
+
+TPU-native analog of the reference's mixed-input serving GEMMs
+(``inference/v2/kernels/core_ops/cuda_linear/include/
+weight_prepacking.cuh`` + ``fp6_linear.cu`` — FP6xFP16 GEMM that
+dequantizes weight fragments in registers between the global-memory load
+and the tensor-core MMA, so the weight read is quantized-sized).  Here
+the quantized weight tile is DMA'd into VMEM int8-sized and widened to
+bf16 *inside the kernel* right before the MXU dot — HBM traffic for the
+weight is 1 byte/element instead of 2 (bf16) or 4 (the dequant-then-
+matmul fallback when XLA fails to fuse).
+
+Consumes the row-wise serving layout directly
+(:func:`deepspeed_tpu.ops.quant.quantize_rowwise`: int8 payload in the
+weight's own shape, fp32 scale per contraction row) — no repacking.
+
+Like the flash kernel (ops/flash_attention.py), this is interpret-tested
+everywhere and probe-gated at runtime: on this rig Mosaic kernels are
+crippled through the axon tunnel (see ops/flash_attention.py:27), so the
+serving engine times kernel-vs-XLA once post-compile and keeps the
+winner.  The kernel exists for bare-metal TPUs where the weight-
+bandwidth floor is the decode bound.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _mixed_kernel(x_ref, d_ref, s_ref, o_ref, acc_ref):
+    """One (bm, bn) output tile; grid dim 2 walks the K blocks."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # dequant IN VMEM: int8 tile -> bf16, scaled per contraction row.
+    # bf16 keeps the MXU on its native input width; the f32 accumulator
+    # carries the precision.
+    w = d_ref[...].astype(jnp.bfloat16) * s_ref[...].astype(jnp.bfloat16)
+    acc_ref[...] += jax.lax.dot(
+        x_ref[...].astype(jnp.bfloat16), w,
+        preferred_element_type=jnp.float32)
+
+    @pl.when(k == pl.num_programs(2) - 1)
+    def _flush():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_m", "block_n",
+                                             "block_k", "interpret",
+                                             "out_dtype"))
+def mixed_matmul_2d(x: jax.Array, data: jax.Array, scale: jax.Array,
+                    *, block_m: int = 0, block_n: int = 512,
+                    block_k: int = 512, out_dtype=jnp.bfloat16,
+                    interpret: bool = False) -> jax.Array:
+    """``x [M, K] @ (int8 data [K, N] * scale [K, 1]) -> [M, N]``.
+
+    M is padded up to a lane-friendly multiple internally (decode bursts
+    are small); K and N must divide by the K/N blocks (serving dims are
+    powers-of-two times 128 — assert rather than silently pad the
+    contraction).
+    """
+    M, K = x.shape
+    K2, N = data.shape
+    assert K == K2 and scale.shape[0] == K, (x.shape, data.shape,
+                                             scale.shape)
+    if block_m <= 0:
+        block_m = min(128, max(8, 1 << (max(M - 1, 1)).bit_length()))
+    bk = min(block_k, K)
+    bn = min(block_n, N)
+    if K % bk or N % bn:
+        raise ValueError(f"K={K}/N={N} must divide block_k={bk}/"
+                         f"block_n={bn}")
+    Mp = -(-M // block_m) * block_m
+    if Mp != M:
+        x = jnp.pad(x, ((0, Mp - M), (0, 0)))
+    scale2 = scale.reshape(K, 1)
+
+    out = pl.pallas_call(
+        _mixed_kernel,
+        grid=(Mp // block_m, N // bn, K // bk),
+        in_specs=[
+            pl.BlockSpec((block_m, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+            pl.BlockSpec((bk, 1), lambda i, j, k: (k, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_m, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((Mp, N), out_dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, bn), jnp.float32)],
+        interpret=interpret,
+    )(x, data, scale2)
+    return out[:M] if Mp != M else out
+
+
+def mixed_matmul(x: jax.Array, qt, *, contract_dims: int = 1,
+                 interpret: bool = False, out_dtype=None) -> jax.Array:
+    """``x @ dequant(qt)`` through the mixed-input kernel.
+
+    ``x``: [..., K]; ``qt``: a row-wise :class:`~deepspeed_tpu.ops.quant.
+    QuantizedTensor` whose payload's first ``contract_dims`` dims flatten
+    into the contraction (K) and the rest into N — e.g. an attention
+    output projection [H, Dh, d] uses ``contract_dims=2``.  Scales on a
+    coarser leading granularity than K (per-head for [H, Dh, d])
+    broadcast down to rows.
+    """
+    assert qt.bits == 8 and qt.zero is None, \
+        "mixed_matmul consumes the row-wise int8 symmetric layout"
+    if jax.default_backend() != "tpu":
+        interpret = True        # CPU/virtual meshes: no Mosaic lowering
+    wshape = tuple(qt.shape)
+    K = int(np.prod(wshape[:contract_dims]))
+    N = int(np.prod(wshape[contract_dims:]))
+    lead = x.shape[:-1]
+    M = int(np.prod(lead)) if lead else 1
+    assert x.shape[-1] == K, (x.shape, wshape, contract_dims)
+    s = qt.scale.reshape(-1)
+    if s.size != K:
+        assert K % s.size == 0, (qt.scale.shape, K)
+        # leading-dim scales are constant over their trailing rows
+        s = jnp.broadcast_to(s[:, None], (s.size, K // s.size))
+    out_dtype = out_dtype or x.dtype
+    y = mixed_matmul_2d(x.reshape(M, K), qt.data.reshape(K, N),
+                        s.reshape(K, 1), out_dtype=out_dtype,
+                        interpret=interpret)
+    return y.reshape(*lead, *wshape[contract_dims:])
+
+
+def dequant_matmul_reference(x: jax.Array, qt, out_dtype=None) -> jax.Array:
+    """The XLA fallback this kernel races in the probe: bf16 fused
+    dequantize (ops/quant.dequantize row-wise fast path) then matmul."""
+    from .quant import dequantize
+    out_dtype = out_dtype or x.dtype
+    w = dequantize(qt, jnp.bfloat16)
+    wshape = tuple(qt.shape)
+    K = wshape[0]
+    y = x.reshape(-1, K) @ w.reshape(K, -1)
+    return y.astype(out_dtype).reshape(*x.shape[:-1], *wshape[1:])

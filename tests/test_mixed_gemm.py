@@ -1,0 +1,76 @@
+"""Mixed-input int8xbf16 GEMM numerics (interpret mode; the kernel is
+probe-gated on real hardware like the flash kernel — reference analog:
+inference/v2/kernels/core_ops/cuda_linear fp6_linear dequant-in-register
+GEMM)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.mixed_gemm import (dequant_matmul_reference,
+                                          mixed_matmul, mixed_matmul_2d)
+from deepspeed_tpu.ops.quant import dequantize, quantize_rowwise
+
+
+def _qt(shape, seed=0):
+    w = jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
+    return quantize_rowwise(w.astype(jnp.bfloat16))
+
+
+class TestMixedGemm:
+    @pytest.mark.parametrize("M,K,N", [
+        (1, 512, 512),          # single-token decode
+        (8, 1024, 512),         # decode burst
+        (200, 512, 1024),       # ragged prefill (M padded internally)
+    ])
+    def test_matches_dequant_matmul(self, M, K, N):
+        qt = _qt((K, N))
+        x = jax.random.normal(jax.random.PRNGKey(1), (M, K),
+                              jnp.bfloat16)
+        got = mixed_matmul_2d(x, qt.data, qt.scale, interpret=True,
+                              out_dtype=jnp.float32)
+        want = (x.astype(jnp.float32)
+                @ dequantize(qt, jnp.bfloat16).astype(jnp.float32))
+        # identical math up to bf16 rounding of the x*w products
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_trailing_dims_collapse(self):
+        """qkv-style [K, H, Dh] weights consume the row-wise layout
+        directly — no repack."""
+        qt = _qt((256, 4, 64))
+        x = jax.random.normal(jax.random.PRNGKey(2), (16, 256),
+                              jnp.bfloat16)
+        got = mixed_matmul(x, qt, interpret=True)
+        want = dequant_matmul_reference(x, qt)
+        assert got.shape == (16, 4, 64)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=3e-2, atol=3e-2)
+
+    def test_batched_leading_dims(self):
+        qt = _qt((512, 256))
+        x = jax.random.normal(jax.random.PRNGKey(3), (2, 5, 512),
+                              jnp.bfloat16)
+        got = mixed_matmul(x, qt, interpret=True)
+        assert got.shape == (2, 5, 256)
+        want = dequant_matmul_reference(x, qt)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=3e-2, atol=3e-2)
+
+    def test_rejects_non_rowwise(self):
+        from deepspeed_tpu.ops.quant import quantize
+        w = jax.random.normal(jax.random.PRNGKey(0), (256, 256))
+        qt = quantize(w, bits=8, num_groups=16,
+                      symmetric=False)        # grouped-flat, has zeros
+        x = jnp.ones((4, 256), jnp.bfloat16)
+        with pytest.raises(AssertionError):
+            mixed_matmul(x, qt, interpret=True)
+
+    def test_block_divisibility_guard(self):
+        qt = _qt((768, 512))                  # 768 % block_k(512) != 0
+        x = jnp.ones((4, 768), jnp.bfloat16)
+        with pytest.raises(ValueError, match="divide"):
+            mixed_matmul_2d(x, qt.data, qt.scale, interpret=True)

@@ -61,6 +61,36 @@ class TestQuantizedServing:
         if wq == "int8":
             assert out_q == out_fp
 
+    def test_mixed_gemm_serving_matches_dequant(self):
+        """mixed_gemm='on' routes all six projection matmuls through the
+        VMEM-dequant kernel (interpret off-TPU) and must reproduce the
+        fused-dequant greedy decode exactly on a tiny model."""
+        m = tiny_model()
+        eng_d = make_engine(m, kv_dtype=jnp.float32,
+                            param_dtype=jnp.float32, weight_quant="int8",
+                            mixed_gemm="off")
+        eng_m = make_engine(m, kv_dtype=jnp.float32,
+                            param_dtype=jnp.float32, weight_quant="int8",
+                            mixed_gemm="on")
+        assert eng_m._quant_is_rowwise()
+        prompt = list(np.random.RandomState(1).randint(1, 128, 12))
+        out_d = eng_d.generate({1: prompt}, GREEDY)[1]
+        out_m = eng_m.generate({1: prompt}, GREEDY)[1]
+        assert eng_m._mixed_gemm_active
+        assert out_m == out_d
+
+    def test_mixed_gemm_rejected_for_grouped_layouts(self):
+        """int4 (grouped/packed) trees must not engage the kernel even
+        when forced on."""
+        m = tiny_model()
+        eng = make_engine(m, kv_dtype=jnp.float32,
+                          param_dtype=jnp.float32, weight_quant="int4",
+                          mixed_gemm="on")
+        prompt = list(np.random.RandomState(2).randint(1, 128, 8))
+        out = eng.generate({1: prompt}, GREEDY)[1]
+        assert len(out) == GREEDY.max_new_tokens
+        assert not eng._mixed_gemm_active
+
     def test_quantized_embeddings_serving_runs(self):
         m = tiny_model()
         eng = make_engine(m, weight_quant="int8",
