@@ -15,10 +15,12 @@ Artifacts: /tmp/decode8b_trace (xplane), /tmp/decode8b_hlo_stats.tsv
 
 import glob
 import json
+import os
 import sys
 import time
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 import numpy as np
 
