@@ -273,6 +273,7 @@ class TestParamStreaming:
         np.testing.assert_allclose(runs["stream"], runs["plain"],
                                    rtol=2e-3, atol=2e-4)
 
+    @pytest.mark.nightly
     def test_streamed_gas_matches(self, tmp_path):
         """Gradient accumulation streams per micro-batch and still
         tracks the plain run."""
@@ -289,6 +290,7 @@ class TestParamStreaming:
         np.testing.assert_allclose(runs["stream"], runs["plain"],
                                    rtol=2e-3, atol=2e-4)
 
+    @pytest.mark.nightly
     def test_streamed_checkpoint_roundtrip(self, tmp_path):
         """Streamed checkpoints use the plain stacked fragment layout:
         save -> fresh streamed engine -> load -> identical next losses,
@@ -322,6 +324,7 @@ class TestParamStreaming:
         loss = float(eng.eval_batch(self._batch(eng)))
         assert np.isfinite(loss)
 
+    @pytest.mark.nightly
     def test_streamed_bf16_trains(self, tmp_path):
         """bf16 compute: fp32 grads hit the store with the right dtype
         and the loss decreases over a few steps."""
