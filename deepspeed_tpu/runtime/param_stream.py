@@ -122,6 +122,56 @@ class StreamedInfinityTrainer:
         self._layer_sh = jax.tree.map(
             lambda sp: NamedSharding(mesh, P(*list(sp)[1:])),
             blk_specs, is_leaf=lambda x: isinstance(x, P))
+        self._res_sh = jax.tree.map(
+            lambda sp: NamedSharding(mesh, sp),
+            {k: engine.param_specs[k] for k in resident},
+            is_leaf=lambda x: isinstance(x, P))
+
+        # ---- multi-host: per-process fragment maps -----------------------
+        # every process stores/streams only the shard fragments its own
+        # devices address (reference: per-rank swap, stage3.py:614);
+        # layers all share one per-leaf fragment map
+        self._multi = jax.process_count() > 1
+        from .zero_infinity import fragment_shape, shard_fragments
+        if self._multi:
+            self._lfrags, self._lowned = [], []
+            for s, sh in zip(jax.tree.leaves(self._layer_tpl),
+                             jax.tree.leaves(
+                                 self._layer_sh,
+                                 is_leaf=lambda x: isinstance(
+                                     x, jax.sharding.Sharding))):
+                f, o = shard_fragments(s.shape, sh)
+                self._lfrags.append(f)
+                self._lowned.append(o)
+            self._rfrags, self._rowned = [], []
+            for s, sh in zip(jax.tree.leaves(self._res_grad_tpl),
+                             jax.tree.leaves(
+                                 self._res_sh,
+                                 is_leaf=lambda x: isinstance(
+                                     x, jax.sharding.Sharding))):
+                f, o = shard_fragments(s.shape, sh)
+                self._rfrags.append(f)
+                self._rowned.append(o)
+
+        def frag_tpl(tpl_tree, frags, dtype=None):
+            """Store template: per-leaf list of fragment SDS."""
+            out = []
+            for j, s in enumerate(jax.tree.leaves(tpl_tree)):
+                out.append([jax.ShapeDtypeStruct(
+                    fragment_shape(s.shape, idx), dtype or s.dtype)
+                    for idx in frags[j]])
+            return out
+
+        if self._multi:
+            self._pstore_tpl = frag_tpl(self._layer_tpl, self._lfrags)
+            self._lgrad_tpl = frag_tpl(self._layer_tpl, self._lfrags,
+                                       np.float32)
+            self._rgrad_tpl = frag_tpl(self._res_grad_tpl, self._rfrags,
+                                       np.float32)
+        else:
+            self._pstore_tpl = self._layer_tpl
+            self._lgrad_tpl = self._layer_grad_tpl
+            self._rgrad_tpl = self._res_grad_tpl
 
         # ---- NVMe stores -------------------------------------------------
         # bf16 working copies, one swap group per layer
@@ -133,13 +183,17 @@ class StreamedInfinityTrainer:
                                         self.L + 1, aio_config=aio_cfg)
         # fp32 master + moments live in the engine's NVMeOptimizer,
         # initialized over the UNSTACKED tree (per-layer leaves => swap
-        # groups align with layers instead of one giant stacked leaf)
+        # groups align with layers instead of one giant stacked leaf);
+        # multi-host: partitioned into per-rank fragments along the SAME
+        # layouts the trainer spills grads in
         self._opt = engine._nvme
         self._opt.meter = self.meter
         unstacked = {"layers": [jax.tree.map(lambda x: x[l], blocks)
                                 for l in range(self.L)],
                      "resident": resident}
-        self._opt.initialize(unstacked)
+        unstacked_sh = {"layers": [self._layer_sh] * self.L,
+                        "resident": self._res_sh} if self._multi else None
+        self._opt.initialize(unstacked, shardings=unstacked_sh)
         # flat-leaf index map of the unstacked tree: leaf i -> (kind, l, j)
         leaves, self._udef = jax.tree_util.tree_flatten(unstacked)
         self._leafmap: List[Tuple[str, int, int]] = []
@@ -156,14 +210,23 @@ class StreamedInfinityTrainer:
         # spill bf16 per-layer working copies; resident stays on device
         dt = engine.compute_dtype
         for l in range(self.L):
-            lp = jax.tree.map(lambda x: np.asarray(x[l]).astype(dt), blocks)
+            if self._multi:
+                lp = [[np.asarray(x[l])[idx].astype(dt) if idx
+                       else np.asarray(x[l]).astype(dt)
+                       for idx in self._lfrags[j]]
+                      for j, x in enumerate(jax.tree.leaves(blocks))]
+            else:
+                lp = jax.tree.map(lambda x: np.asarray(x[l]).astype(dt),
+                                  blocks)
             self._pstore.write_group(l, lp)
         self.resident = jax.tree.map(
-            lambda x, sp: jax.device_put(
-                np.asarray(x).astype(dt), NamedSharding(mesh, sp)),
-            resident, {k: engine.param_specs[k] for k in resident})
+            lambda x, sh: jax.device_put(np.asarray(x).astype(dt), sh),
+            resident, self._res_sh)
         self._res_bytes = _tree_bytes(resident)
         self._layer_bytes = _tree_bytes(self._layer_tpl)
+        # this process's actual host buffer per layer fetch (== the full
+        # layer single-host; only the local fragments multi-host)
+        self._pstore_bytes = _tree_bytes(self._pstore_tpl)
         self._fns: Dict[Any, Any] = {}
         self._cos_sin = None
         log_dist(
@@ -203,10 +266,6 @@ class StreamedInfinityTrainer:
             raise ConfigError(
                 "offload_param.device=nvme (per-layer param streaming) "
                 f"does not compose with: {', '.join(bad)}")
-        if jax.process_count() > 1:
-            raise ConfigError(
-                "offload_param.device=nvme param streaming is "
-                "single-controller for now")
 
     # ------------------------------------------------------------------
     # jitted per-layer programs (cached per batch signature)
@@ -280,16 +339,36 @@ class StreamedInfinityTrainer:
             (d_res,) = vjp(dx)
             return jax.tree.map(lambda g: g.astype(jnp.float32), d_res)
 
+        # multi-host: pin output layouts so spilled grads land in the
+        # SAME shardings the NVMe fragment maps were built from (single
+        # host leaves XLA free — validated layouts, no relayout risk)
+        jkw: Dict[str, Dict[str, Any]] = {k: {} for k in (
+            "embed", "layer", "head_loss", "head_bwd", "layer_bwd",
+            "embed_bwd")}
+        if self._multi:
+            from ..comm.mesh import DATA_AXIS, FSDP_AXIS
+            mesh = self.eng.topology.mesh
+            x_sh = NamedSharding(mesh, P((DATA_AXIS, FSDP_AXIS)))
+            repl = NamedSharding(mesh, P())
+            jkw["embed"] = {"out_shardings": x_sh}
+            jkw["layer"] = {"out_shardings": x_sh}
+            jkw["head_loss"] = {"out_shardings": repl}
+            jkw["head_bwd"] = {"out_shardings": (repl, self._res_sh,
+                                                 x_sh)}
+            jkw["layer_bwd"] = {"out_shardings": (self._layer_sh, x_sh)}
+            jkw["embed_bwd"] = {"out_shardings": self._res_sh}
         fns = dict(
-            embed=jax.jit(embed_f),
+            embed=jax.jit(embed_f, **jkw["embed"]),
             # NOTE: no donation on the layer forward — the caller keeps
             # x alive as the activation checkpoint
-            layer=jax.jit(layer_f),
+            layer=jax.jit(layer_f, **jkw["layer"]),
             head_loss=jax.jit(
-                lambda r, x, ids, mask: head_f(r, x, ids, mask, 1.0)[1]),
-            head_bwd=jax.jit(head_bwd),
-            layer_bwd=jax.jit(layer_bwd, donate_argnums=(5,)),
-            embed_bwd=jax.jit(embed_bwd),
+                lambda r, x, ids, mask: head_f(r, x, ids, mask, 1.0)[1],
+                **jkw["head_loss"]),
+            head_bwd=jax.jit(head_bwd, **jkw["head_bwd"]),
+            layer_bwd=jax.jit(layer_bwd, donate_argnums=(5,),
+                              **jkw["layer_bwd"]),
+            embed_bwd=jax.jit(embed_bwd, **jkw["embed_bwd"]),
         )
         self._fns[key] = fns
         return fns
@@ -314,15 +393,39 @@ class StreamedInfinityTrainer:
 
     def _fetch_layer(self, l: int):
         """Blocking read of layer l's bf16 params (prefetched when the
-        sweep is in order), placed onto the mesh."""
-        host = self._pstore.read_group(l, self._layer_tpl)
-        self.meter.alloc(self._layer_bytes)
-        dev = jax.tree.map(jax.device_put, host, self._layer_sh)
+        sweep is in order), placed onto the mesh.  Multi-host: each
+        process uploads only its own fragments; the global arrays are
+        assembled from per-device buffers."""
+        host = self._pstore.read_group(l, self._pstore_tpl)
+        self.meter.alloc(self._pstore_bytes)
+        if self._multi:
+            flat_sh = jax.tree.leaves(
+                self._layer_sh,
+                is_leaf=lambda x: isinstance(x, jax.sharding.Sharding))
+            tpl_flat = jax.tree.leaves(self._layer_tpl)
+            leaves = [self._assemble(host[j], tpl_flat[j].shape,
+                                     flat_sh[j], self._lfrags[j],
+                                     tpl_flat[j].dtype)
+                      for j in range(len(tpl_flat))]
+            dev = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(self._layer_tpl), leaves)
+        else:
+            dev = jax.tree.map(jax.device_put, host, self._layer_sh)
         # hold the host buffers (and their meter count) until the async
         # device transfer has actually consumed them
         jax.block_until_ready(dev)
-        self.meter.free(self._layer_bytes)
+        self.meter.free(self._pstore_bytes)
         return dev
+
+    @staticmethod
+    def _assemble(frag_list, shape, sharding, frags, dtype):
+        fragmap = dict(zip(frags, frag_list))
+        imap = sharding.devices_indices_map(tuple(shape))
+        bufs = [jax.device_put(
+            np.asarray(fragmap[tuple(imap[d])]).astype(dtype), d)
+            for d in sharding.addressable_devices]
+        return jax.make_array_from_single_device_arrays(
+            tuple(shape), sharding, bufs)
 
     def train_batch(self, batch, rng) -> Dict[str, Any]:
         eng = self.eng
@@ -364,6 +467,15 @@ class StreamedInfinityTrainer:
             sq_norm += sq
             finite = finite and ok
 
+        if self._multi:
+            # each process summed only its save-owned fragments; the
+            # global grad norm / overflow flag need the cross-process sum
+            from jax.experimental import multihost_utils
+            g = multihost_utils.process_allgather(
+                np.asarray([sq_norm, 0.0 if finite else 1.0],
+                           np.float64))
+            sq_norm = float(np.asarray(g)[..., 0].sum())
+            finite = float(np.asarray(g)[..., 1].sum()) == 0.0
         gnorm = float(np.sqrt(sq_norm))
         metrics: Dict[str, Any] = {
             "loss": jnp.float32(float(np.mean(losses))),
@@ -404,26 +516,31 @@ class StreamedInfinityTrainer:
         acts = [None] * L
         x = fns["embed"](self.resident, ids)
         if L:
-            self._pstore.prefetch_group(0, self._layer_tpl)
+            self._pstore.prefetch_group(0, self._pstore_tpl)
         for l in range(L):
             lp = self._fetch_layer(l)
             if l + 1 < L:
-                self._pstore.prefetch_group(l + 1, self._layer_tpl)
+                self._pstore.prefetch_group(l + 1, self._pstore_tpl)
             acts[l] = x
             x = fns["layer"](lp, x, cos, sin, mask)
             del lp
         loss, d_res, d_x = fns["head_bwd"](self.resident, x, ids, mask,
                                            jnp.float32(scale))
-        res_grads = jax.tree.map(np.asarray, d_res)
+        if self._multi:
+            from .zero_infinity import dedup_addressable_frags
+            res_grads = [dedup_addressable_frags(g, self._rfrags[j])
+                         for j, g in enumerate(jax.tree.leaves(d_res))]
+        else:
+            res_grads = jax.tree.map(np.asarray, d_res)
         # ---- backward sweep (reverse order, prefetch l-1) ----------------
         sq = 0.0
         finite = True
         if L:
-            self._pstore.prefetch_group(L - 1, self._layer_tpl)
+            self._pstore.prefetch_group(L - 1, self._pstore_tpl)
         for l in range(L - 1, -1, -1):
             lp = self._fetch_layer(l)
             if l - 1 >= 0:
-                self._pstore.prefetch_group(l - 1, self._layer_tpl)
+                self._pstore.prefetch_group(l - 1, self._pstore_tpl)
             d_lp, d_x = fns["layer_bwd"](lp, acts[l], cos, sin, mask, d_x)
             acts[l] = None
             del lp
@@ -431,16 +548,28 @@ class StreamedInfinityTrainer:
             sq += s
             finite = finite and f
         d_res2 = fns["embed_bwd"](self.resident, ids, d_x)
-        for k in d_res2:
-            res_grads[k] = jax.tree.map(
-                lambda a, b: a + np.asarray(b), res_grads[k], d_res2[k])
+        if self._multi:
+            from .zero_infinity import dedup_addressable_frags
+            for j, g in enumerate(jax.tree.leaves(d_res2)):
+                add = dedup_addressable_frags(g, self._rfrags[j])
+                res_grads[j] = [a + b
+                                for a, b in zip(res_grads[j], add)]
+        else:
+            for k in d_res2:
+                res_grads[k] = jax.tree.map(
+                    lambda a, b: a + np.asarray(b), res_grads[k],
+                    d_res2[k])
         s, f = self._spill_resident_grads(res_grads, denom, mb, last, gas)
         return float(np.asarray(loss)), sq + s, finite and f
 
     def _accum_spill(self, group: int, tpl, new_host, denom: float,
-                     mb: int, last: bool, gas: int) -> Tuple[float, bool]:
+                     mb: int, last: bool, gas: int,
+                     owned=None) -> Tuple[float, bool]:
         """Write (or accumulate into) a grad-store group; on the last
-        micro-batch compute the sq-norm/finite stats of the (sum/gas)."""
+        micro-batch compute the sq-norm/finite stats of the (sum/gas).
+        ``owned``: multi-host save-ownership flags per leaf fragment —
+        replica fragments are excluded so the cross-process norm sum
+        counts each region exactly once."""
         nbytes = _tree_bytes(tpl)
         self.meter.alloc(nbytes)
         try:
@@ -456,7 +585,12 @@ class StreamedInfinityTrainer:
                 self.meter.free(nbytes)
             sq, finite = 0.0, True
             if last:
-                for g in jax.tree.leaves(new_host):
+                leaves = jax.tree.leaves(new_host)
+                flags = ([True] * len(leaves) if owned is None
+                         else [o for sub in owned for o in sub])
+                for g, own in zip(leaves, flags):
+                    if not own:
+                        continue
                     ga = g / gas
                     s = float(np.sum(ga.astype(np.float64) ** 2))
                     sq += s
@@ -467,13 +601,20 @@ class StreamedInfinityTrainer:
             self.meter.free(nbytes)
 
     def _spill_layer_grads(self, l: int, d_lp, denom, mb, last, gas):
+        if self._multi:
+            from .zero_infinity import dedup_addressable_frags
+            host = [dedup_addressable_frags(g, self._lfrags[j])
+                    for j, g in enumerate(jax.tree.leaves(d_lp))]
+            return self._accum_spill(l, self._lgrad_tpl, host, denom,
+                                     mb, last, gas, owned=self._lowned)
         host = jax.tree.map(np.asarray, d_lp)
-        return self._accum_spill(l, self._layer_grad_tpl, host, denom,
+        return self._accum_spill(l, self._lgrad_tpl, host, denom,
                                  mb, last, gas)
 
     def _spill_resident_grads(self, res_grads, denom, mb, last, gas):
-        return self._accum_spill(self.L, self._res_grad_tpl, res_grads,
-                                 denom, mb, last, gas)
+        return self._accum_spill(
+            self.L, self._rgrad_tpl, res_grads, denom, mb, last, gas,
+            owned=self._rowned if self._multi else None)
 
     # ------------------------------------------------------------------
     # update sweep
@@ -492,21 +633,39 @@ class StreamedInfinityTrainer:
             def __init__(self, i):
                 self.i = i
 
-            def __array__(self, dtype=None, copy=None):
+            def _group(self):
                 kind, l, j = trainer._leafmap[self.i]
                 gkey = l if kind == "layer" else trainer.L
                 if gkey not in _LazyGrad._cache:
-                    tpl = (trainer._layer_grad_tpl if kind == "layer"
-                           else trainer._res_grad_tpl)
+                    tpl = (trainer._lgrad_tpl if kind == "layer"
+                           else trainer._rgrad_tpl)
                     _LazyGrad._cache.clear()
                     trainer.meter.free(_LazyGrad._cache_bytes)
                     arr = trainer._gstore.read_group(gkey, tpl)
-                    _LazyGrad._cache[gkey] = jax.tree.leaves(arr)
+                    _LazyGrad._cache[gkey] = arr
                     _LazyGrad._cache_bytes = _tree_bytes(tpl)
                     trainer.meter.alloc(_LazyGrad._cache_bytes)
-                g = _LazyGrad._cache[gkey][j] * grad_scale
+                return _LazyGrad._cache[gkey], kind, j
+
+            def __array__(self, dtype=None, copy=None):
+                if trainer._multi:
+                    # fragments never materialize a full leaf; the
+                    # optimizer consumes them via frag_map()
+                    raise TypeError(
+                        "multi-host lazy grads are fragment-only")
+                arr, kind, j = self._group()
+                g = jax.tree.leaves(arr)[j] * grad_scale
                 return g.astype(dtype) if dtype is not None and \
                     np.dtype(dtype) != g.dtype else g
+
+            def frag_map(self):
+                """Multi-host: this leaf's grad fragments keyed by shard
+                index (the NVMeOptimizer fragment contract)."""
+                arr, kind, j = self._group()
+                frags = (trainer._lfrags if kind == "layer"
+                         else trainer._rfrags)[j]
+                return {idx: arr[j][k] * grad_scale
+                        for k, idx in enumerate(frags)}
 
         grads = [_LazyGrad(i) for i in range(len(self._leafmap))]
         dt = self.eng.compute_dtype
@@ -515,31 +674,48 @@ class StreamedInfinityTrainer:
         n_layer_leaves = len(jax.tree.leaves(self._layer_tpl))
         n_res_leaves = len(jax.tree.leaves(self._res_grad_tpl))
 
-        def consume(i: int, p_new: np.ndarray) -> None:
+        def cast(p):
+            if isinstance(p, list):            # multi-host fragment list
+                return [f.astype(dt) for f in p]
+            return p.astype(dt)
+
+        def consume(i: int, p_new) -> None:
             kind, l, j = self._leafmap[i]
             if kind == "layer":
                 lay = staging.setdefault(l, {})
-                lay[j] = p_new.astype(dt)
+                lay[j] = cast(p_new)
                 if len(lay) == n_layer_leaves:
                     flat = [lay[j2] for j2 in range(n_layer_leaves)]
-                    tree = jax.tree.unflatten(
-                        jax.tree.structure(self._layer_tpl), flat)
+                    tree = (flat if self._multi else
+                            jax.tree.unflatten(
+                                jax.tree.structure(self._layer_tpl),
+                                flat))
                     self._pstore.write_group(l, tree)
                     del staging[l]
             else:
-                new_resident[j] = p_new.astype(dt)
+                new_resident[j] = cast(p_new)
 
         self._opt.step(grads, lr, step_num, consume=consume)
         _LazyGrad._cache.clear()
         self.meter.free(_LazyGrad._cache_bytes)
         assert not staging and len(new_resident) == n_res_leaves
         flat = [new_resident[j] for j in range(n_res_leaves)]
-        res = jax.tree.unflatten(
-            jax.tree.structure(self._res_grad_tpl), flat)
-        mesh = self.eng.topology.mesh
-        self.resident = jax.tree.map(
-            lambda a, sp: jax.device_put(a, NamedSharding(mesh, sp)),
-            res, {k: self.eng.param_specs[k] for k in res})
+        if self._multi:
+            flat_sh = jax.tree.leaves(
+                self._res_sh,
+                is_leaf=lambda x: isinstance(x, jax.sharding.Sharding))
+            tpl_flat = jax.tree.leaves(self._res_grad_tpl)
+            leaves = [self._assemble(flat[j], tpl_flat[j].shape,
+                                     flat_sh[j], self._rfrags[j], dt)
+                      for j in range(n_res_leaves)]
+        else:
+            flat_sh = jax.tree.leaves(
+                self._res_sh,
+                is_leaf=lambda x: isinstance(x, jax.sharding.Sharding))
+            leaves = [jax.device_put(flat[j], flat_sh[j])
+                      for j in range(n_res_leaves)]
+        self.resident = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(self._res_grad_tpl), leaves)
 
     # ------------------------------------------------------------------
     # eval / checkpoint surface
@@ -555,11 +731,11 @@ class StreamedInfinityTrainer:
             {"m": np.asarray(mask)}, accumulate=False)["m"]
         x = fns["embed"](self.resident, ids)
         if self.L:
-            self._pstore.prefetch_group(0, self._layer_tpl)
+            self._pstore.prefetch_group(0, self._pstore_tpl)
         for l in range(self.L):
             lp = self._fetch_layer(l)
             if l + 1 < self.L:
-                self._pstore.prefetch_group(l + 1, self._layer_tpl)
+                self._pstore.prefetch_group(l + 1, self._pstore_tpl)
             x = fns["layer"](lp, x, cos, sin, mask)
         return fns["head_loss"](self.resident, x, ids, mask)
 
@@ -574,10 +750,32 @@ class StreamedInfinityTrainer:
         """fp32 (master, m, v) in the ORIGINAL stacked param structure
         (checkpoint compatibility with non-streamed runs).  Stacked
         leaves materialize one at a time (peak host = one stacked leaf);
-        ``lazy`` defers each leaf's read+stack to ``np.asarray``."""
+        ``lazy`` defers each leaf's read+stack to ``np.asarray``.
+        Multi-host: stacked HostShards — each process contributes its
+        save-owned (layer, fragment) regions, read lazily."""
         un_m, un_mo, un_v = self._opt.state_trees(lazy=lazy)
 
         def restack(un):
+            if self._multi:
+                from ..checkpoint.engine import HostShards
+
+                def stack_hs(*ls):
+                    hs = HostShards.__new__(HostShards)
+                    hs.shape = (len(ls),) + tuple(ls[0].shape)
+                    hs.dtype = ls[0].dtype
+
+                    def gen(_ls=ls):
+                        for l, sub in enumerate(_ls):
+                            for idx, data in sub.shards:
+                                yield ((slice(l, l + 1),) + tuple(idx),
+                                       data[None])
+                    hs.shards = gen()
+                    return hs
+
+                blocks = jax.tree.map(
+                    stack_hs, *un["layers"],
+                    is_leaf=lambda x: not isinstance(x, (dict, list)))
+                return {**un["resident"], "blocks": blocks}
             blocks = jax.tree.map(
                 lambda *ls: _LazyStack(ls) if lazy
                 else np.stack([np.asarray(x) for x in ls]),
@@ -604,13 +802,18 @@ class StreamedInfinityTrainer:
         self._opt.restore(unstack(master), unstack(m), unstack(v))
         dt = self.eng.compute_dtype
         for l in range(self.L):
-            lp = jax.tree.map(lambda x: np.asarray(x)[l].astype(dt), blocks)
+            if self._multi:
+                lp = [[np.asarray(x)[l][idx].astype(dt) if idx
+                       else np.asarray(x)[l].astype(dt)
+                       for idx in self._lfrags[j]]
+                      for j, x in enumerate(jax.tree.leaves(blocks))]
+            else:
+                lp = jax.tree.map(
+                    lambda x: np.asarray(x)[l].astype(dt), blocks)
             self._pstore.write_group(l, lp)
-        mesh = self.eng.topology.mesh
         self.resident = jax.tree.map(
-            lambda a, sp: jax.device_put(
-                np.asarray(a).astype(dt), NamedSharding(mesh, sp)),
-            resident, {k: self.eng.param_specs[k] for k in resident})
+            lambda a, sh: jax.device_put(np.asarray(a).astype(dt), sh),
+            resident, self._res_sh)
 
 
 class _LazyStack:

@@ -135,6 +135,54 @@ class LazyNVMeLeaf:
         return arr.copy() if copy else arr
 
 
+def shard_fragments(shape, sharding) -> Tuple[List[tuple], List[bool]]:
+    """This process's distinct shard fragments of an array with ``shape``
+    under ``sharding``: (fragment shard-indices, save-ownership flags).
+
+    Fragments are the deduped addressable shard indices; exactly one
+    process globally "save-owns" each index (the one holding its
+    lowest-id device) so checkpoint writers emit each region once
+    (reference: per-rank swap-file ownership, stage3.py:614)."""
+    my_devs = {d.id for d in jax.local_devices()}
+    by_idx: Dict[tuple, List[int]] = {}
+    for d, idx in sharding.devices_indices_map(tuple(shape)).items():
+        by_idx.setdefault(tuple(idx), []).append(d.id)
+    frags, owned = [], []
+    for idx in sorted(by_idx, key=lambda t: min(by_idx[t])):
+        holders = by_idx[idx]
+        if not my_devs.intersection(holders):
+            continue
+        frags.append(idx)
+        owned.append(min(holders) in my_devs)
+    return frags, owned
+
+
+def fragment_shape(shape, idx) -> tuple:
+    if not idx:
+        return tuple(shape)
+    return tuple(
+        (sl.stop if sl.stop is not None else dim)
+        - (sl.start if sl.start is not None else 0)
+        for sl, dim in zip(idx, shape))
+
+
+def dedup_addressable_frags(arr: jax.Array, frags: Sequence[tuple],
+                            dtype=np.float32) -> List[np.ndarray]:
+    """Fetch ``arr``'s local shards matching ``frags`` (order preserved);
+    raises if the array's layout doesn't produce a required index."""
+    by_idx: Dict[tuple, Any] = {}
+    for sh in arr.addressable_shards:
+        by_idx.setdefault(tuple(sh.index), sh.data)
+    out = []
+    for idx in frags:
+        if idx not in by_idx:
+            raise ValueError(
+                f"array layout mismatch: no addressable shard at {idx} "
+                f"(have {sorted(by_idx)[:4]}...)")
+        out.append(np.asarray(by_idx[idx], dtype))
+    return out
+
+
 class NVMeOptimizer:
     """Group-partitioned NVMe state store + pipelined host update."""
 
@@ -195,22 +243,8 @@ class NVMeOptimizer:
                 shardings,
                 is_leaf=lambda x: isinstance(x, jax.sharding.Sharding))
             self._frags, self._save_owned = [], []
-            my_devs = {d.id for d in jax.local_devices()}
             for (shape, _), sh in zip(self._leaf_meta, self._shardings):
-                imap = sh.devices_indices_map(shape)
-                by_idx: Dict[tuple, List[int]] = {}
-                for d, idx in imap.items():
-                    by_idx.setdefault(tuple(idx), []).append(d.id)
-                frags, owned = [], []
-                for idx in sorted(by_idx,
-                                  key=lambda t: min(by_idx[t])):
-                    holders = by_idx[idx]
-                    if not my_devs.intersection(holders):
-                        continue
-                    frags.append(idx)
-                    # exactly one process saves each fragment: the one
-                    # owning the globally-lowest device holding it
-                    owned.append(min(holders) in my_devs)
+                frags, owned = shard_fragments(shape, sh)
                 self._frags.append(frags)
                 self._save_owned.append(owned)
         leaf_bytes = [
@@ -242,12 +276,7 @@ class NVMeOptimizer:
                     else ""))
 
     def _frag_shape(self, i: int, k: int) -> tuple:
-        shape = self._leaf_meta[i][0]
-        idx = self._frags[i][k]
-        return tuple(
-            (sl.stop if sl.stop is not None else dim)
-            - (sl.start if sl.start is not None else 0)
-            for sl, dim in zip(idx, shape)) if idx else shape
+        return fragment_shape(self._leaf_meta[i][0], self._frags[i][k])
 
     @staticmethod
     def _covering_slice(shard_idx, frag_idx):
@@ -361,7 +390,10 @@ class NVMeOptimizer:
         """This process's gradient fragments for leaf i, keyed by shard
         index.  A jax array must carry the layout the masters were
         partitioned by (the engine guarantees this; a mismatch is a hard
-        error, not silent corruption)."""
+        error, not silent corruption).  Lazy readers (the param-stream
+        grad store) provide fragments via a ``frag_map`` hook."""
+        if hasattr(g, "frag_map"):
+            return g.frag_map()
         if isinstance(g, jax.Array) and not g.is_fully_addressable:
             by_idx: Dict[tuple, Any] = {}
             for sh in g.addressable_shards:
