@@ -324,6 +324,34 @@ class TestParamStreaming:
         loss = float(eng.eval_batch(self._batch(eng)))
         assert np.isfinite(loss)
 
+    def test_streamed_fp16_scaling_and_overflow_skip(self, tmp_path):
+        """fp16: the streamed path unscales grads host-side, and a
+        non-finite grad skips the update sweep (skipped counter up,
+        step unchanged, scale dropped by the dynamic scaler)."""
+        cfg = self._cfg(tmp_path, fp16={"enabled": True,
+                                        "initial_scale_power": 4})
+        eng = ds.initialize(model=self._model(), config=cfg)
+        m = eng.train_batch(self._batch(eng, seed=0))
+        assert np.isfinite(float(np.asarray(m["loss"])))
+        assert int(np.asarray(eng.state.step)) == 1
+        # poison the resident embedding -> non-finite grads everywhere
+        bad = jax.tree.map(lambda x: x, eng._stream.resident)
+        bad["embed"]["table"] = bad["embed"]["table"].at[0, 0].set(
+            jnp.inf)
+        eng._stream.resident = bad
+        eng.state = eng.state._replace(master=bad)
+        scale_before = float(np.asarray(eng.state.loss_scale.scale))
+        m2 = eng.train_batch(self._batch(eng, seed=1))
+        assert int(np.asarray(m2["overflow"])) == 1
+        assert int(np.asarray(eng.state.step)) == 1      # update skipped
+        assert int(np.asarray(eng.state.skipped)) == 1
+        # first overflow spends a hysteresis credit; the second drops
+        # the scale (reference: DynamicLossScaler delayed_shift)
+        eng.train_batch(self._batch(eng, seed=2))
+        assert int(np.asarray(eng.state.skipped)) == 2
+        assert float(np.asarray(
+            eng.state.loss_scale.scale)) < scale_before
+
     @pytest.mark.nightly
     def test_streamed_bf16_trains(self, tmp_path):
         """bf16 compute: fp32 grads hit the store with the right dtype
