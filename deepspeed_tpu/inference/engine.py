@@ -285,6 +285,12 @@ class InferenceEngine:
                     qmeta[gname][name] = (qt.bits, qt.shape[1:], qt.dtype)
             record["quant"] = qarrays
             store.qmeta = qmeta
+            # mixed-gemm eligibility: per-layer payloads kept in the
+            # weight's own shape with symmetric int8 row scales
+            from ..ops.quant import is_rowwise_int8
+            store.rowwise_int8 = all(
+                is_rowwise_int8(qt)
+                for grp in qblocks.values() for qt in grp.values())
         store.spill(record)
         self._stream = store
         if self.icfg.decode_burst > 1:
@@ -511,30 +517,33 @@ class InferenceEngine:
     def _quant_is_rowwise(self) -> bool:
         """The mixed-input kernel consumes only the row-wise int8
         symmetric layout (payload in the weight's own shape)."""
-        from ..ops.quant import QuantizedTensor
+        from ..ops.quant import QuantizedTensor, is_rowwise_int8
         if self._quant is None:
             return False
         leaves = [x for x in jax.tree.leaves(
             self._quant, is_leaf=lambda x: isinstance(x, QuantizedTensor))
             if isinstance(x, QuantizedTensor)]
-        return bool(leaves) and all(
-            q.bits == 8 and q.zero is None
-            and tuple(q.data.shape) == tuple(q.shape) for q in leaves)
+        return bool(leaves) and all(is_rowwise_int8(q) for q in leaves)
 
     def _resolve_mixed_gemm(self, attn_impl: str) -> bool:
         """Resolve the mixed_gemm config to a bool for this build
         (reference analog: the cuda_linear kernel selection)."""
         mode = self.icfg.mixed_gemm
-        if mode == "on" and self._stream is not None:
+        eligible = (self._quant_is_rowwise() if self._stream is None
+                    else self._stream.rowwise_int8)
+        if mode == "on" and self._stream is not None and not eligible:
             raise ValueError(
-                "mixed_gemm='on' does not compose with weight_stream "
-                "(streamed payloads dequantize on fetch); use 'auto'")
-        if mode == "off" or not self._quant_is_rowwise() \
-                or self._stream is not None:
+                "mixed_gemm='on': the weight-stream payloads are not the "
+                "row-wise int8 layout the kernel consumes; use 'auto'")
+        if mode == "off" or not eligible:
             return False
         if mode == "on":
             return True
-        key = self._probe_key("mixed_gemm_" + attn_impl)
+        # streamed and resident steps have different cost profiles —
+        # never share a probe verdict between them
+        key = self._probe_key(
+            "mixed_gemm_" + attn_impl
+            + ("_stream" if self._stream is not None else ""))
         cached = _PROBE_CACHE.get(key)
         if cached is None:
             results = self._probe_variants(

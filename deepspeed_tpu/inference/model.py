@@ -169,9 +169,12 @@ def _paged_attention_chunked(kv_layer, q, batch: RaggedBatch,
 
 
 
-def _stream_layer(stream, li, dt):
+def _stream_layer(stream, li, dt, mixed_gemm: bool = False):
     """Fetch layer ``li``'s weights from the NVMe store (host callback)
-    and dequantize any streamed quantized payloads on device."""
+    and dequantize any streamed quantized payloads on device — or, with
+    ``mixed_gemm``, keep row-wise int8 payloads quantized for the
+    VMEM-dequant kernel (the weight stays int8-sized from NVMe through
+    HBM into the MXU feed)."""
     rec = stream.fetch_layer(li)
     lp = {k: (dict(v) if isinstance(v, dict) else v)
           for k, v in rec["dense"].items()}
@@ -183,7 +186,11 @@ def _stream_layer(stream, li, dt):
                 bits, shp, odt = stream.qmeta[gname][name]
                 qt = QuantizedTensor(arrs["data"], arrs["scale"],
                                      arrs.get("zero"), bits, shp, odt)
-                g[name] = dequantize_any(qt, dt)
+                from ..ops.quant import is_rowwise_int8
+                if mixed_gemm and is_rowwise_int8(qt):
+                    g[name] = qt
+                else:
+                    g[name] = dequantize_any(qt, dt)
             lp[gname] = g
     return lp
 
@@ -296,7 +303,7 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
             lp, kv_layer, li = xs
         else:
             kv_layer, li = xs
-            lp = _stream_layer(stream, li, dt)
+            lp = _stream_layer(stream, li, dt, mixed_gemm=mixed_gemm)
         if kv_host:
             kv_layer = jax.device_put(kv_layer, jax.memory.Space.Device)
         if quant is not None:

@@ -125,11 +125,12 @@ def merge_layer(lp: Dict[str, Any], quant_blocks: Dict[str, Any], i,
     plus this layer's quantized weights — dequantized here, or (with
     ``mixed=True``) left as row-wise QuantizedTensors for the
     mixed-input GEMM (dequant happens in VMEM inside the kernel)."""
+    from ..ops.quant import is_rowwise_int8
     out = dict(lp)
     for group_name, qgroup in quant_blocks.items():
         g = dict(out.get(group_name, {}))
         for name, qt in qgroup.items():
-            if mixed and qt.bits == 8 and qt.zero is None:
+            if mixed and is_rowwise_int8(qt):
                 g[name] = layer_qt(qt, i)
             else:
                 g[name] = layer_weight(qt, i, dt)

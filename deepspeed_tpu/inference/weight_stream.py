@@ -29,6 +29,11 @@ class NVMeWeightStore:
     """Spill a stacked per-layer pytree to per-layer files and fetch one
     layer at a time from inside a compiled scan."""
 
+    # set by the engine at spill time when every quantized payload is the
+    # row-wise int8 layout the mixed-input GEMM consumes
+    rowwise_int8 = False
+    qmeta = None
+
     def __init__(self, path: str, num_layers: int):
         self.dir = path
         self.num_layers = num_layers

@@ -160,6 +160,14 @@ def _quantize_leading(x: jax.Array, lead_dims: int) -> QuantizedTensor:
                            orig_shape, orig_dtype)
 
 
+def is_rowwise_int8(qt: "QuantizedTensor") -> bool:
+    """The layout the mixed-input GEMM consumes (ops/mixed_gemm.py):
+    symmetric int8 payload kept in the weight's own shape with leading-
+    dim scales — the single source of truth for eligibility checks."""
+    return (qt.bits == 8 and qt.zero is None
+            and tuple(qt.data.shape) == tuple(qt.shape))
+
+
 def dequantize(qt: QuantizedTensor, dtype=None) -> jax.Array:
     """(reference: dequantize / dequantize_int4_to_half_experimental)."""
     out_dt = dtype or qt.dtype

@@ -198,3 +198,28 @@ class TestWeightStream:
             weight_stream=str(tmp_path / "wq"), **kw))
         assert eng._quant["blocks"] == {}       # payloads live on NVMe
         assert ref == self._gen(eng, prompts)
+
+    def test_streamed_mixed_gemm_matches(self, tmp_path):
+        """mixed_gemm='on' + weight_stream: streamed row-wise int8
+        payloads stay quantized all the way into the VMEM-dequant kernel
+        and reproduce the streamed-dequant greedy decode."""
+        from deepspeed_tpu.inference import InferenceConfig, InferenceEngine
+        from deepspeed_tpu.models import build_model
+
+        def mk():
+            return build_model("llama-tiny", vocab_size=128, num_layers=3,
+                               d_model=32, num_heads=4, num_kv_heads=2,
+                               d_ff=64, max_seq_len=64)
+        kw = dict(token_budget=16, max_seqs=2, kv_block_size=8,
+                  num_kv_blocks=32, attn_impl="xla", weight_quant="int8",
+                  param_dtype=jnp.float32, kv_dtype=jnp.float32)
+        prompts = {0: [5, 17, 99, 3], 1: [8, 9]}
+        ref = self._gen(InferenceEngine(mk(), InferenceConfig(
+            weight_stream=str(tmp_path / "wd"), mixed_gemm="off", **kw)),
+            prompts)
+        eng = InferenceEngine(mk(), InferenceConfig(
+            weight_stream=str(tmp_path / "wm"), mixed_gemm="on", **kw))
+        assert eng._stream.rowwise_int8
+        out = self._gen(eng, prompts)
+        assert eng._mixed_gemm_active
+        assert out == ref
