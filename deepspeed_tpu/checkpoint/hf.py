@@ -438,6 +438,63 @@ def _convert_gpt_neox(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
     return params
 
 
+def _convert_bloom(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
+    """BLOOM (reference container: module_inject/containers/bloom.py —
+    ALiBi position, word-embedding layernorm, head-interleaved fused
+    query_key_value, tied embeddings)."""
+    H, D, nl = cfg.num_heads, cfg.head_dim, cfg.num_layers
+    pre = next((p for p in ("transformer.", "")
+                if f"{p}word_embeddings.weight" in sd), "transformer.")
+    L = pre + "h.{}."
+
+    def qkv(i):
+        w = _np(sd[L.format(i) + "self_attention.query_key_value.weight"])
+        w = w.reshape(H, 3, D, cfg.d_model)           # [H, 3, D, dm]
+        b = _np(sd[L.format(i) + "self_attention.query_key_value.bias"])
+        b = b.reshape(H, 3, D)
+        out = {}
+        for which, (wn, bn) in enumerate((("wq", "bq"), ("wk", "bk"),
+                                          ("wv", "bv"))):
+            out[wn] = np.transpose(w[:, which], (2, 0, 1))  # [dm, H, D]
+            out[bn] = b[:, which]
+        return out
+
+    def qkv_stacked():
+        outs = [qkv(i) for i in range(nl)]
+        return {k: np.stack([o[k] for o in outs]) for k in outs[0]}
+
+    return {
+        "embed": {"table": _np(sd[f"{pre}word_embeddings.weight"])},
+        "ln_embed": {
+            "scale": _np(sd[f"{pre}word_embeddings_layernorm.weight"]),
+            "bias": _np(sd[f"{pre}word_embeddings_layernorm.bias"])},
+        "blocks": {
+            "attn": {
+                **qkv_stacked(),
+                "wo": _stack(sd, L + "self_attention.dense.weight", nl,
+                             lambda w: _o_heads(w, H, D, True)),
+                "bo": _stack(sd, L + "self_attention.dense.bias", nl),
+            },
+            "mlp": {
+                "wi": _stack(sd, L + "mlp.dense_h_to_4h.weight", nl,
+                             lambda w: w.T),
+                "bi": _stack(sd, L + "mlp.dense_h_to_4h.bias", nl),
+                "wo": _stack(sd, L + "mlp.dense_4h_to_h.weight", nl,
+                             lambda w: w.T),
+                "bo": _stack(sd, L + "mlp.dense_4h_to_h.bias", nl),
+            },
+            "ln1": {"scale": _stack(sd, L + "input_layernorm.weight", nl),
+                    "bias": _stack(sd, L + "input_layernorm.bias", nl)},
+            "ln2": {"scale": _stack(
+                        sd, L + "post_attention_layernorm.weight", nl),
+                    "bias": _stack(
+                        sd, L + "post_attention_layernorm.bias", nl)},
+        },
+        "ln_f": {"scale": _np(sd[f"{pre}ln_f.weight"]),
+                 "bias": _np(sd[f"{pre}ln_f.bias"])},
+    }
+
+
 CONVERTERS: Dict[str, Callable] = {
     "gpt2": _convert_gpt2,
     "llama": _convert_llama,
@@ -449,6 +506,7 @@ CONVERTERS: Dict[str, Callable] = {
     "opt": _convert_opt,
     "gptj": _convert_gptj,
     "gpt_neox": _convert_gpt_neox,
+    "bloom": _convert_bloom,
 }
 
 
@@ -459,7 +517,7 @@ def family_of(name_or_type: str) -> str:
     if "neox" in s or "pythia" in s:
         return "gpt_neox"
     for fam in ("mixtral", "llama", "mistral", "qwen2", "gpt2",
-                "falcon", "phi", "opt"):
+                "falcon", "phi", "opt", "bloom"):
         if fam in s:
             return fam
     raise ValueError(f"no HF converter for {name_or_type!r}; "

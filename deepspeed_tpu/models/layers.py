@@ -75,6 +75,35 @@ def rmsnorm(p, x, eps: float = 1e-6):
 # csrc/transformer/inference apply_rotary_pos_emb, v2 kv_rotary)
 # --------------------------------------------------------------------------
 
+def alibi_slopes(num_heads: int) -> jnp.ndarray:
+    """ALiBi per-head slopes (reference consumers: the bloom injection
+    policy, module_inject/containers/bloom.py; math from the ALiBi
+    paper): geometric sequence from 2^(-8/n), closest power of two
+    padded like the HF implementation for non-power-of-two head counts."""
+    n = 2 ** math.floor(math.log2(num_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+    slopes = [base ** (i + 1) for i in range(n)]
+    if n < num_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * n) - 3)))
+        slopes += [extra_base ** (2 * i + 1)
+                   for i in range(num_heads - n)]
+    return jnp.asarray(slopes, jnp.float32)
+
+
+def make_alibi_attention(base=None):
+    """Wrap an attention fn with the ALiBi bias.  Uses the key-position
+    form ``slope_h * j`` (the query-position term is constant per softmax
+    row and cancels) — exactly HF Bloom's ``build_alibi_tensor``."""
+    base_fn = base or causal_attention
+
+    def attn(q, k, v, mask=None, **kw):
+        H, Sk = q.shape[2], k.shape[1]
+        bias = alibi_slopes(H)[:, None, None] \
+            * jnp.arange(Sk, dtype=jnp.float32)[None, None, :]
+        return base_fn(q, k, v, mask=mask, bias=bias, **kw)
+    return attn
+
+
 def rope_freqs(head_dim: int, max_seq: int, theta: float = 10000.0):
     inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
                            / head_dim))
@@ -105,11 +134,13 @@ def apply_rope(x, cos, sin, positions=None):
 # --------------------------------------------------------------------------
 
 def causal_attention(q, k, v, mask: Optional[jnp.ndarray] = None,
-                     scale: Optional[float] = None, causal: bool = True):
+                     scale: Optional[float] = None, causal: bool = True,
+                     bias: Optional[jnp.ndarray] = None):
     """q: [B, S, H, D]; k/v: [B, Sk, Hkv, D].  GQA via grouped einsum — KV
     are never materialized at full head count, preserving the memory GQA
     exists to save.  Softmax in fp32 for stability; XLA fuses the block
-    onto the MXU.  ``causal=False`` gives bidirectional attention."""
+    onto the MXU.  ``causal=False`` gives bidirectional attention.
+    ``bias``: additive attention bias [H, S|1, Sk] (ALiBi et al.)."""
     B, S, H, D = q.shape
     Sk, Hkv = k.shape[1], k.shape[2]
     rep = H // Hkv
@@ -117,6 +148,9 @@ def causal_attention(q, k, v, mask: Optional[jnp.ndarray] = None,
     qg = q.reshape(B, S, Hkv, rep, D)
     logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k) * scale
     logits = logits.astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32).reshape(
+            Hkv, rep, bias.shape[-2], Sk)[None]
     if causal:
         keep = jnp.tril(jnp.ones((S, Sk), bool), k=Sk - S)
         logits = jnp.where(keep[None, None, None], logits, -1e30)

@@ -131,6 +131,26 @@ PRESETS: Dict[str, dict] = {
                   norm="layernorm", position="rope", rope_pct=0.4,
                   parallel_block=True, tie_embeddings=False,
                   attn_bias=True, mlp_bias=True, head_bias=True),
+    # --- BLOOM (ALiBi + word-embedding layernorm; reference container:
+    # module_inject/containers/bloom.py) ---------------------------------
+    "bloom-tiny": dict(vocab_size=1024, num_layers=4, d_model=256,
+                       num_heads=8, max_seq_len=2048,
+                       activation="gelu_new", norm="layernorm",
+                       position="alibi", embed_norm=True,
+                       tie_embeddings=True, attn_bias=True,
+                       mlp_bias=True, attention_impl="xla"),
+    "bloom-560m": dict(vocab_size=250880, num_layers=24, d_model=1024,
+                       num_heads=16, max_seq_len=2048,
+                       activation="gelu_new", norm="layernorm",
+                       position="alibi", embed_norm=True,
+                       tie_embeddings=True, attn_bias=True,
+                       mlp_bias=True, attention_impl="xla"),
+    "bloom-7b1": dict(vocab_size=250880, num_layers=30, d_model=4096,
+                      num_heads=32, max_seq_len=2048,
+                      activation="gelu_new", norm="layernorm",
+                      position="alibi", embed_norm=True,
+                      tie_embeddings=True, attn_bias=True,
+                      mlp_bias=True, attention_impl="xla"),
     # --- OPT ------------------------------------------------------------
     "opt-125m": dict(vocab_size=50272, num_layers=12, d_model=768,
                      num_heads=12, max_seq_len=2048, activation="relu",

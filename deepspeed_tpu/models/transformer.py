@@ -43,9 +43,11 @@ class TransformerConfig:
     activation: str = "gelu"
     gated_mlp: bool = False                   # SwiGLU-style (llama)
     norm: str = "layernorm"                   # layernorm | rmsnorm
-    position: str = "learned"                 # learned | rope
+    position: str = "learned"                 # learned | rope | alibi
     rope_theta: float = 10000.0
     rope_pct: float = 1.0                     # partial rotary (phi: 0.4)
+    # bloom: layernorm applied to the word embeddings before the stack
+    embed_norm: bool = False
     # parallel residual: x + attn(ln(x)) + mlp(ln(x)), one shared norm
     # (falcon, phi, gpt-j)
     parallel_block: bool = False
@@ -156,6 +158,10 @@ def init_params(cfg: TransformerConfig, key) -> Tuple[Dict, Dict]:
         params["pos_embed"], axes["pos_embed"] = (
             {"table": jax.random.normal(keys[1], (cfg.max_seq_len, dm)) * 0.01},
             {"table": (None, "embed")})
+    if cfg.embed_norm:                      # bloom word_embeddings_layernorm
+        _ninit = (L.layernorm_init if cfg.norm == "layernorm"
+                  else L.rmsnorm_init)
+        params["ln_embed"], axes["ln_embed"] = _ninit(dm)
 
     blk_p: Dict[str, Any] = {}
     blk_a: Dict[str, Any] = {}
@@ -320,10 +326,18 @@ def apply(cfg: TransformerConfig, params, input_ids, mask=None,
     (reference: data_routing/basic_layer.py gather/scatter)."""
     dt = dtype or params["embed"]["table"].dtype
     x = L.embed(params["embed"], input_ids).astype(dt)
+    if cfg.embed_norm:
+        x = _norm(cfg)(params["ln_embed"], x)
     if cfg.position == "learned":
         S = input_ids.shape[1]
         x = x + params["pos_embed"]["table"][:S].astype(dt)
         cos = sin = None
+    elif cfg.position == "alibi":
+        cos = sin = None
+        # safety net for direct apply() calls: the default eager
+        # attention gains the ALiBi bias (Model wraps attention_fn too)
+        if attention_fn is L.causal_attention:
+            attention_fn = L.make_alibi_attention()
     else:
         cos, sin = L.rope_freqs(cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta)
 
@@ -452,6 +466,25 @@ def lm_loss_fn(cfg: TransformerConfig,
     return loss_fn
 
 
+def _resolve_attention(cfg: TransformerConfig) -> Callable:
+    """attention_impl -> callable; ALiBi wraps the eager attention with
+    the per-head bias (the flash kernels have no bias operand)."""
+    if cfg.position == "alibi":
+        if cfg.attention_impl in ("flash", "xla_flash"):
+            raise ValueError(
+                "position='alibi' needs the eager attention "
+                "(attention_impl='xla'): the flash kernels carry no "
+                "additive-bias operand")
+        return L.make_alibi_attention()
+    if cfg.attention_impl == "flash":
+        from ..ops.flash_attention import flash_attention
+        return flash_attention
+    if cfg.attention_impl == "xla_flash":
+        from ..ops.xla_attention import fused_attention
+        return fused_attention
+    return L.causal_attention
+
+
 class Model:
     """Bundles config+params+loss for ``deepspeed_tpu.initialize(model=…)``."""
 
@@ -459,14 +492,7 @@ class Model:
                  attention_fn: Optional[Callable] = None):
         self.config = cfg
         if attention_fn is None:
-            if cfg.attention_impl == "flash":
-                from ..ops.flash_attention import flash_attention
-                attention_fn = flash_attention
-            elif cfg.attention_impl == "xla_flash":
-                from ..ops.xla_attention import fused_attention
-                attention_fn = fused_attention
-            else:
-                attention_fn = L.causal_attention
+            attention_fn = _resolve_attention(cfg)
         self.params, self.param_axes = init_params(cfg, jax.random.PRNGKey(seed))
         self.loss_fn = lm_loss_fn(cfg, attention_fn)
         self.attention_fn = attention_fn
@@ -486,14 +512,7 @@ class Model:
         m = cls.__new__(cls)
         m.config = cfg
         if attention_fn is None:
-            if cfg.attention_impl == "flash":
-                from ..ops.flash_attention import flash_attention
-                attention_fn = flash_attention
-            elif cfg.attention_impl == "xla_flash":
-                from ..ops.xla_attention import fused_attention
-                attention_fn = fused_attention
-            else:
-                attention_fn = L.causal_attention
+            attention_fn = _resolve_attention(cfg)
         m.params = params
         if param_axes is None:
             from ..parallel.sharding import infer_logical_axes

@@ -79,6 +79,11 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r} "
                          "(gpipe | 1f1b)")
+    if cfg.position == "alibi" or cfg.embed_norm:
+        raise ValueError(
+            "pipeline parallelism does not support ALiBi/embed-norm "
+            "models yet (bloom family): the stage embed path has no "
+            "bias/ln_embed plumbing")
 
     if sp > 1:
         if cfg.num_heads % sp or cfg.num_kv_heads % sp:

@@ -1873,6 +1873,14 @@ def initialize(loss_fn: Callable = None,
             raise ConfigError(
                 "progressive_layer_drop / random_ltd need model= with a "
                 "TransformerConfig (the loss must expose the layer stack)")
+        if de_routing and model.config.position == "alibi":
+            # LTD gathers a token subset; the ALiBi bias uses compressed
+            # key indices and would silently distort distances (rope
+            # threads original positions; the alibi wrapper cannot)
+            raise ConfigError(
+                "random_ltd does not compose with position='alibi' "
+                "(the distance bias would see gathered, not original, "
+                "token positions)")
         if max(cfg.mesh.pipe, cfg.pipeline.stages) > 1 \
                 or max(cfg.mesh.seq, cfg.sequence_parallel.size) > 1:
             raise ConfigError(
@@ -1893,6 +1901,15 @@ def initialize(loss_fn: Callable = None,
         # Ulysses/ring wrapper over this run's mesh
         seq_size = max(cfg.mesh.seq, cfg.sequence_parallel.size)
         pipe_size = max(cfg.mesh.pipe, cfg.pipeline.stages)
+        if seq_size > 1 and getattr(getattr(model, "config", None),
+                                    "position", None) == "alibi":
+            # inside the Ulysses shard_map the wrapper would derive
+            # slopes from the LOCAL head count (wrong geometric series);
+            # ring mode drops the bias entirely — reject loudly
+            raise ConfigError(
+                "sequence parallelism does not compose with "
+                "position='alibi' (per-head slopes would be computed on "
+                "the head shard, not the global head set)")
         # seq parallel WITHOUT pipeline: swap attention in the plain loss.
         # With pipeline, make_pipelined_loss_fn composes seq itself.
         if loss_fn is None and seq_size > 1 and pipe_size == 1 \

@@ -295,6 +295,8 @@ class StreamedInfinityTrainer:
 
         def embed_f(resident, ids):
             x = Lx.embed(resident["embed"], ids).astype(dt)
+            if cfg.embed_norm:
+                x = norm(resident["ln_embed"], x)
             if cfg.position == "learned":
                 x = x + resident["pos_embed"]["table"][:ids.shape[1]] \
                     .astype(dt)
