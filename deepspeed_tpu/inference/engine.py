@@ -144,6 +144,10 @@ class InferenceEngine:
         self._stream = None
         if self.icfg.weight_stream:
             self._setup_weight_stream()
+        if self.icfg.mixed_gemm == "on":
+            # fail at construction, not at the first compiled step: an
+            # explicit force-on with an ineligible layout is a config error
+            self._require_mixed_gemm_eligible()
         self._setup_sharding()
         if self.topology is None:
             self._place_default_device()
@@ -529,20 +533,28 @@ class InferenceEngine:
             if isinstance(x, QuantizedTensor)]
         return bool(leaves) and all(is_rowwise_int8(q) for q in leaves)
 
+    def _mixed_gemm_eligible(self) -> bool:
+        return (self._quant_is_rowwise() if self._stream is None
+                else self._stream.rowwise_int8)
+
+    def _require_mixed_gemm_eligible(self) -> None:
+        if not self._mixed_gemm_eligible():
+            what = ("the weight-stream payloads are"
+                    if self._stream is not None
+                    else "the resident quantized weights are")
+            raise ValueError(
+                f"mixed_gemm='on': {what} not the row-wise int8 layout "
+                "the kernel consumes; use 'auto'")
+
     def _resolve_mixed_gemm(self, attn_impl: str) -> bool:
         """Resolve the mixed_gemm config to a bool for this build
         (reference analog: the cuda_linear kernel selection)."""
         mode = self.icfg.mixed_gemm
-        eligible = (self._quant_is_rowwise() if self._stream is None
-                    else self._stream.rowwise_int8)
-        if mode == "on" and self._stream is not None and not eligible:
-            raise ValueError(
-                "mixed_gemm='on': the weight-stream payloads are not the "
-                "row-wise int8 layout the kernel consumes; use 'auto'")
-        if mode == "off" or not eligible:
-            return False
         if mode == "on":
+            self._require_mixed_gemm_eligible()
             return True
+        if mode == "off" or not self._mixed_gemm_eligible():
+            return False
         # streamed and resident steps have different cost profiles —
         # never share a probe verdict between them
         key = self._probe_key(

@@ -79,7 +79,7 @@ class StreamedInfinityTrainer:
         cfg = model.config
         self.cfg = cfg
         self.attention_fn = getattr(model, "attention_fn", None)
-        self._check_supported(engine)
+        self._check_supported(engine, cfg)
         self.L = int(cfg.num_layers)
         self.meter = ResidencyMeter()
 
@@ -235,7 +235,7 @@ class StreamedInfinityTrainer:
             f"{root}; resident {self._res_bytes/1e6:.1f} MB stays in HBM")
 
     @staticmethod
-    def _check_supported(engine) -> None:
+    def _check_supported(engine, model_cfg) -> None:
         cfg = engine.config
         bad = []
         if max(cfg.mesh.pipe, cfg.pipeline.stages) > 1:
@@ -258,6 +258,11 @@ class StreamedInfinityTrainer:
             bad.append("ZeRO++ quantized collectives")
         if cfg.sparse_gradients:
             bad.append("sparse_gradients")
+        if getattr(model_cfg, "num_experts", 1) > 1:
+            # the streamed layer sweep discards block_apply's metrics, so
+            # the MoE load-balancing aux loss would be silently dropped
+            bad.append("MoE (the streamed sweep cannot carry the "
+                       "load-balancing aux loss)")
         if engine.eval_fn is not None:
             # eval_batch streams the built-in LM loss; silently replacing
             # a custom eval metric would report the wrong quantity

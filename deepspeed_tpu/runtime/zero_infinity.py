@@ -263,6 +263,10 @@ class NVMeOptimizer:
             cur_bytes += nbytes
         if cur:
             self.groups.append(cur)
+        # p+m+v resident bytes per group (fragment-aware in multi-host
+        # mode — leaf_bytes already counts only this rank's fragments)
+        self._group_bytes = [3 * sum(leaf_bytes[i] for i in idxs)
+                             for idxs in self.groups]
         self.swapper = OptimizerSwapper(self.dir, len(self.groups),
                                         aio_config=self.aio_config)
         for g, idxs in enumerate(self.groups):
@@ -279,18 +283,22 @@ class NVMeOptimizer:
         return fragment_shape(self._leaf_meta[i][0], self._frags[i][k])
 
     @staticmethod
-    def _covering_slice(shard_idx, frag_idx):
+    def _covering_slice(shard_idx, frag_idx, shape):
         """If ``shard_idx`` covers ``frag_idx``, return the relative
-        slices of the fragment within the shard; else None."""
+        slices of the fragment within the shard; else None.  Extents are
+        normalized against ``shape`` so ``slice(None)`` and an explicit
+        ``slice(0, dim)`` compare equal (a shard that is genuinely
+        partial on a dim the fragment spans must NOT be declared
+        covering — it would yield a wrong-shaped fragment)."""
         rel = []
-        for ss, fs in zip(shard_idx, frag_idx):
+        for ss, fs, dim in zip(shard_idx, frag_idx, shape):
             s0 = ss.start or 0
             f0 = fs.start or 0
-            if f0 < s0 or (ss.stop is not None and fs.stop is not None
-                           and fs.stop > ss.stop):
+            s1 = dim if ss.stop is None else min(ss.stop, dim)
+            f1 = dim if fs.stop is None else min(fs.stop, dim)
+            if f0 < s0 or f1 > s1:
                 return None
-            rel.append(slice(f0 - s0, None if fs.stop is None
-                             else fs.stop - s0))
+            rel.append(slice(f0 - s0, f1 - s0))
         return tuple(rel)
 
     def _leaf_payload(self, leaf, i: int):
@@ -311,7 +319,8 @@ class NVMeOptimizer:
                         break
                 if data is None:
                     for sh in leaf.addressable_shards:
-                        rel = self._covering_slice(tuple(sh.index), idx)
+                        rel = self._covering_slice(tuple(sh.index), idx,
+                                                   np.shape(leaf))
                         if rel is not None:
                             data = np.asarray(sh.data,
                                               np.float32)[rel]
@@ -353,9 +362,7 @@ class NVMeOptimizer:
             None if consume else [None] * len(self._leaf_meta)
         G = len(self.groups)
 
-        def group_bytes(g):
-            return 3 * sum(int(np.prod(self._leaf_meta[i][0]) or 1) * 4
-                           for i in self.groups[g])
+        group_bytes = self._group_bytes.__getitem__
 
         if G:
             self.swapper.prefetch_group(0, self._template(0))

@@ -80,12 +80,17 @@ class TestQuantizedServing:
         assert out_m == out_d
 
     def test_mixed_gemm_rejected_for_grouped_layouts(self):
-        """int4 (grouped/packed) trees must not engage the kernel even
-        when forced on."""
+        """Grouped int4 trees are not the layout the kernel consumes:
+        forcing mixed_gemm='on' must raise (same contract as the streamed
+        path), while 'auto' quietly keeps the kernel off."""
         m = tiny_model()
+        with pytest.raises(ValueError, match="mixed_gemm"):
+            make_engine(m, kv_dtype=jnp.float32,
+                        param_dtype=jnp.float32, weight_quant="int4",
+                        mixed_gemm="on")
         eng = make_engine(m, kv_dtype=jnp.float32,
                           param_dtype=jnp.float32, weight_quant="int4",
-                          mixed_gemm="on")
+                          mixed_gemm="auto")
         prompt = list(np.random.RandomState(2).randint(1, 128, 8))
         out = eng.generate({1: prompt}, GREEDY)[1]
         assert len(out) == GREEDY.max_new_tokens
