@@ -109,10 +109,6 @@ class InferenceEngine:
         materializes)."""
         self.model = model
         self.cfg: TransformerConfig = model.config
-        if self.cfg.position == "alibi":
-            raise NotImplementedError(
-                "serving ALiBi models: the paged-attention paths carry "
-                "no additive-bias operand yet (train/eval only)")
         self.icfg = config or InferenceConfig()
         max_len = self.icfg.max_seq_len or self.cfg.max_seq_len
         # a sequence can never hold more blocks than the pool has

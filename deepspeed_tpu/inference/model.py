@@ -50,7 +50,7 @@ def _write_kv(kv_layer, k, v, batch: RaggedBatch, block_size: int):
 
 def _paged_attention_pallas(kv_layer, q, batch: RaggedBatch,
                             block_size: int, max_blocks_per_seq: int,
-                            scale: float, shard_mesh=None):
+                            scale: float, shard_mesh=None, slopes=None):
     """Pallas streaming kernel behind the same signature
     (ops/paged_attention.py — reference: blocked_flash).
 
@@ -64,21 +64,28 @@ def _paged_attention_pallas(kv_layer, q, batch: RaggedBatch,
     if shard_mesh is None:
         return paged_attention(kv_layer, q, batch.seq_slot, batch.positions,
                                batch.block_tables, block_size,
-                               max_blocks_per_seq, scale)
+                               max_blocks_per_seq, scale, slopes=slopes)
     from jax.sharding import PartitionSpec as P
 
     from ..comm.mesh import TENSOR_AXIS
 
     kv_spec = P(None, None, None, TENSOR_AXIS, None)  # [blocks,bs,2,Hkv,D]
     q_spec = P(None, TENSOR_AXIS, None)               # [T, H, D]
+    in_specs = [kv_spec, q_spec, P(), P(), P()]
+    operands = [kv_layer, q, batch.seq_slot, batch.positions,
+                batch.block_tables]
+    if slopes is not None:
+        in_specs.append(P(TENSOR_AXIS, None))   # slopes [Hkv, rep] split
+        operands.append(jnp.asarray(slopes, jnp.float32).reshape(
+            kv_layer.shape[3], -1))             # with the kv heads
     f = jax.shard_map(
-        lambda kvl, qq, ss, pos, bt: paged_attention(
-            kvl, qq, ss, pos, bt, block_size, max_blocks_per_seq, scale),
+        lambda kvl, qq, ss, pos, bt, *sl: paged_attention(
+            kvl, qq, ss, pos, bt, block_size, max_blocks_per_seq, scale,
+            slopes=sl[0] if sl else None),
         mesh=shard_mesh,
-        in_specs=(kv_spec, q_spec, P(), P(), P()),
+        in_specs=tuple(in_specs),
         out_specs=q_spec, check_vma=False)
-    return f(kv_layer, q, batch.seq_slot, batch.positions,
-             batch.block_tables)
+    return f(*operands)
 
 
 # one-shot gather cap: [T, C, 2, Hkv, D] materializes T*C*2*Hkv*D
@@ -89,7 +96,7 @@ _ONE_SHOT_GATHER_BYTES = 512 * 1024 * 1024
 
 
 def _paged_attention(kv_layer, q, batch: RaggedBatch, block_size: int,
-                     max_blocks_per_seq: int, scale: float):
+                     max_blocks_per_seq: int, scale: float, slopes=None):
     """Per-token attention over the owning sequence's context
     (reference kernel: blocked_flash / flash_attn_by_atoms).
 
@@ -107,7 +114,8 @@ def _paged_attention(kv_layer, q, batch: RaggedBatch, block_size: int,
     gather_bytes = T * C * 2 * Hkv * D * kv_layer.dtype.itemsize
     if gather_bytes > _ONE_SHOT_GATHER_BYTES:
         return _paged_attention_chunked(kv_layer, q, batch, block_size,
-                                        max_blocks_per_seq, scale)
+                                        max_blocks_per_seq, scale,
+                                        slopes=slopes)
     rep = H // Hkv
 
     tables = batch.block_tables[batch.seq_slot, :max_blocks_per_seq]  # [T, nb]
@@ -118,6 +126,9 @@ def _paged_attention(kv_layer, q, batch: RaggedBatch, block_size: int,
     qg = q.reshape(T, Hkv, rep, D)
     s = jnp.einsum("thrd,tchd->thrc", qg, k_ctx).astype(jnp.float32) * scale
     cols = jnp.arange(C)[None, :]                                  # [1, C]
+    if slopes is not None:      # ALiBi: slope_h * absolute key position
+        s = s + (slopes.reshape(Hkv, rep)[None, :, :, None]
+                 * cols[:, None, None, :].astype(jnp.float32))
     valid = cols <= batch.positions[:, None]                       # [T, C]
     s = jnp.where(valid[:, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
@@ -127,7 +138,7 @@ def _paged_attention(kv_layer, q, batch: RaggedBatch, block_size: int,
 
 def _paged_attention_chunked(kv_layer, q, batch: RaggedBatch,
                              block_size: int, max_blocks_per_seq: int,
-                             scale: float):
+                             scale: float, slopes=None):
     """Streaming XLA paged attention: scan over the block-table columns,
     gathering ONE context block per step ([T, bs, 2, Hkv, D]) and folding
     it into an online-softmax accumulator — same numerics as the
@@ -148,6 +159,9 @@ def _paged_attention_chunked(kv_layer, q, batch: RaggedBatch,
         k, v = ctx[:, :, 0], ctx[:, :, 1]           # [T, bs, Hkv, D]
         s = jnp.einsum("thrd,tbhd->thrb", qg, k).astype(jnp.float32) * scale
         cols = j * bs + offs[None, :]               # [1, bs]
+        if slopes is not None:
+            s = s + (slopes.reshape(Hkv, rep)[None, :, :, None]
+                     * cols[:, None, None, :].astype(jnp.float32))
         valid = cols <= batch.positions[:, None]    # [T, bs]
         s = jnp.where(valid[:, None, None, :], s, -1e30)
         m_new = jnp.maximum(m, s.max(axis=-1))
@@ -292,9 +306,14 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
     scale = 1.0 / (cfg.head_dim ** 0.5)
 
     x = L.embed(embed_tab, batch.token_ids).astype(dt)             # [T, dm]
+    if cfg.embed_norm:                  # bloom word_embeddings_layernorm
+        x = norm(params["ln_embed"], x)
+    slopes = None
+    cos = sin = None
     if cfg.position == "learned":
         x = x + params["pos_embed"]["table"][batch.positions].astype(dt)
-        cos = sin = None
+    elif cfg.position == "alibi":
+        slopes = L.alibi_slopes(cfg.num_heads)
     else:
         cos, sin = L.rope_freqs(cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta)
 
@@ -316,10 +335,11 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
         if attn_impl == "pallas":
             o = _paged_attention_pallas(kv_layer, q, batch, block_size,
                                         max_blocks_per_seq, scale,
-                                        shard_mesh=shard_mesh)
+                                        shard_mesh=shard_mesh,
+                                        slopes=slopes)
         else:
             o = _paged_attention(kv_layer, q, batch, block_size,
-                                 max_blocks_per_seq, scale)
+                                 max_blocks_per_seq, scale, slopes=slopes)
         o = _mm(o.reshape(o.shape[0], -1), ap["wo"], dt,
                 contract_dims=2)
         if cfg.attn_out_bias:
@@ -407,11 +427,12 @@ def decode_burst_forward(cfg: TransformerConfig, params, prefix,
     else:
         embed_tab = params["embed"]
     dt = embed_tab["table"].dtype
+    cos = sin = slopes = None
     if cfg.position == "rope":
         cos, sin = L.rope_freqs(cfg.rotary_dim, cfg.max_seq_len,
                                 cfg.rope_theta)
-    else:
-        cos = sin = None
+    elif cfg.position == "alibi":
+        slopes = L.alibi_slopes(H).reshape(Hkv, rep)
 
     def one_layer(x, lp, li, tail_l, pos, j):
         """x: [S, dm]; tail_l: [S, K, 2, Hkv, D] this layer's in-burst
@@ -432,6 +453,9 @@ def decode_burst_forward(cfg: TransformerConfig, params, prefix,
         sa = jnp.einsum("shrd,sphd->shrp", qg, kp.astype(dt)
                         ).astype(jnp.float32) * scale
         cols = jnp.arange(P)[None, :]
+        if slopes is not None:      # ALiBi over absolute prefix positions
+            sa = sa + (slopes[None, :, :, None]
+                       * cols[:, None, None, :].astype(jnp.float32))
         valid = cols < base_ctx[:, None]              # [S, P]
         sa = jnp.where(valid[:, None, None, :], sa, -1e30)
         ma = sa.max(axis=-1)
@@ -443,6 +467,11 @@ def decode_burst_forward(cfg: TransformerConfig, params, prefix,
         vt = tail_l[:, :, 1]
         sb = jnp.einsum("shrd,skhd->shrk", qg, kt).astype(jnp.float32) \
             * scale
+        if slopes is not None:  # tail key k sits at position base_ctx+k
+            kpos = (base_ctx[:, None]
+                    + jnp.arange(tail_l.shape[1])[None, :]).astype(
+                        jnp.float32)                  # [S, K]
+            sb = sb + slopes[None, :, :, None] * kpos[:, None, None, :]
         it_valid = jnp.arange(tail_l.shape[1]) <= j
         sb = jnp.where(it_valid[None, None, None, :], sb, -1e30)
         mb = sb.max(axis=-1)
@@ -480,6 +509,8 @@ def decode_burst_forward(cfg: TransformerConfig, params, prefix,
         j, r = xs
         pos = base_ctx + j                           # this token's position
         x = L.embed(embed_tab, tok).astype(dt)
+        if cfg.embed_norm:              # bloom word_embeddings_layernorm
+            x = norm(params["ln_embed"], x)
         if cfg.position == "learned":
             x = x + params["pos_embed"]["table"][pos].astype(dt)
 

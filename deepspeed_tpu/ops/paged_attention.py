@@ -45,9 +45,14 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _kernel(tables_ref, pos_ref, q_ref, kv_ref, o_ref,
-            acc_ref, m_ref, l_ref, *, block_size: int, scale: float,
-            num_kv_heads: int, rep: int):
+def _kernel(tables_ref, pos_ref, q_ref, kv_ref, *rest,
+            block_size: int, scale: float,
+            num_kv_heads: int, rep: int, alibi: bool):
+    if alibi:   # optional trailing input before outputs/scratch
+        slopes_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        slopes_ref = None
+        o_ref, acc_ref, m_ref, l_ref = rest
     t = pl.program_id(0)
     j = pl.program_id(1)
     nb = pl.num_programs(1)
@@ -72,6 +77,9 @@ def _kernel(tables_ref, pos_ref, q_ref, kv_ref, o_ref,
             s = jax.lax.dot_general(
                 q, k, dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale  # [rep, bs]
+            if alibi:       # ALiBi: slope_h * absolute key position
+                s = s + (slopes_ref[h, :][:, None]
+                         * cols.astype(jnp.float32))
             s = jnp.where(keep, s, NEG_INF)
             sl = slice(h * rep, (h + 1) * rep)
             m_prev, l_prev = m_ref[sl, :], l_ref[sl, :]
@@ -93,10 +101,15 @@ def _kernel(tables_ref, pos_ref, q_ref, kv_ref, o_ref,
 
 
 def paged_attention(kv_layer, q, seq_slot, positions, block_tables,
-                    block_size: int, max_blocks_per_seq: int, scale: float):
+                    block_size: int, max_blocks_per_seq: int, scale: float,
+                    slopes=None):
     """kv_layer: [blocks+1, bs, 2, Hkv, D] (last row = trash);
     q: [T, H, D]; seq_slot/positions: [T] i32;
-    block_tables: [max_seqs, max_blocks] i32 (-1 pad) → out [T, H, D]."""
+    block_tables: [max_seqs, max_blocks] i32 (-1 pad) → out [T, H, D].
+    ``slopes``: optional ALiBi per-head slopes, any shape reshapeable to
+    [Hkv, rep] in head order h = hkv*rep + r (reference analog: the alibi
+    operand of the inference softmax kernels, csrc/transformer/inference/
+    csrc/softmax.cu)."""
     T, H, D = q.shape
     nblocks, bs, _, Hkv, _ = kv_layer.shape
     rep = H // Hkv
@@ -113,18 +126,27 @@ def paged_attention(kv_layer, q, seq_slot, positions, block_tables,
         jj = jnp.minimum(j, pos[t] // bs)
         return (tbl[t, jj], 0, 0, 0, 0)
 
+    alibi = slopes is not None
+    in_specs = [
+        pl.BlockSpec((1, H, D),
+                     lambda t, j, tbl, pos: (t, 0, 0)),
+        pl.BlockSpec((1, bs, 2, Hkv, D), _kv_index),
+    ]
+    operands = [tables, positions, q, kv_layer]
+    if alibi:
+        in_specs.append(pl.BlockSpec((Hkv, rep),
+                                     lambda t, j, tbl, pos: (0, 0)))
+        operands.append(jnp.asarray(slopes, jnp.float32)
+                        .reshape(Hkv, rep))
+
     grid = (T, nb)
     out = pl.pallas_call(
         functools.partial(_kernel, block_size=bs, scale=scale,
-                          num_kv_heads=Hkv, rep=rep),
+                          num_kv_heads=Hkv, rep=rep, alibi=alibi),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, H, D),
-                             lambda t, j, tbl, pos: (t, 0, 0)),
-                pl.BlockSpec((1, bs, 2, Hkv, D), _kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, H, D),
                                    lambda t, j, tbl, pos: (t, 0, 0)),
             scratch_shapes=[
@@ -135,5 +157,5 @@ def paged_attention(kv_layer, q, seq_slot, positions, block_tables,
         ),
         out_shape=jax.ShapeDtypeStruct((T, H, D), q.dtype),
         interpret=_use_interpret(),
-    )(tables, positions, q, kv_layer)
+    )(*operands)
     return out

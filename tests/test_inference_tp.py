@@ -122,3 +122,17 @@ def test_tp_indivisible_heads_falls_back_replicated(devices):
     assert tp_eng.state.kv.sharding.spec[4] is None
     tp = tp_eng.generate({u: list(p) for u, p in PROMPTS.items()}, GREEDY)
     assert ref == tp
+
+
+def test_tp_alibi_parity(devices):
+    """ALiBi serving under TP: the per-head slopes split with the kv
+    head groups (both the XLA path via GSPMD and the Pallas kernel's
+    explicit shard_map slopes operand)."""
+    model = Model(small_cfg(position="alibi", embed_norm=True,
+                            attention_impl="xla"), seed=2)
+    ref = run(model, icfg())
+    tp = run(model, icfg(), topology=topo_tp4_fsdp2(devices))
+    assert ref == tp
+    tp_pallas = run(model, icfg(attn_impl="pallas"),
+                    topology=topo_tp4_fsdp2(devices))
+    assert ref == tp_pallas
