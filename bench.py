@@ -465,10 +465,13 @@ def llama8b_serving_bench(on_tpu: bool):
     # budget 1024 = two 512-token prompts per step: each full-model
     # weight pass amortizes over 2x the prompt tokens (prompt 1761 ->
     # 2189 tok/s measured; budget 2048 OOMs the 8B compile)
+    # int8 paged KV (per-vector scales): halves the KV HBM stream that
+    # competes with the int8 weights for decode bandwidth at long context
     eng = InferenceEngine(model, InferenceConfig(
         token_budget=1024 if on_tpu else 16, max_seqs=n_seqs,
         kv_block_size=64 if on_tpu else 16,
         num_kv_blocks=128 if on_tpu else 32,
+        kv_quant="int8",
         decode_burst=8 if on_tpu else 2), quant_tree=quant)
 
     r = np.random.RandomState(0)

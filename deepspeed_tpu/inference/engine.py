@@ -54,6 +54,12 @@ class InferenceConfig:
     # kernel against the XLA gather formulation on the first step's real
     # shapes and keeps the faster one; "xla" / "pallas" force a path
     attn_impl: str = "auto"
+    # "int8" | "fp8": store the paged KV cache quantized (one scale per
+    # written token/head vector, per-block layout).  Halves (int8) the
+    # dominant HBM stream of long-context decode; all paged-attention
+    # paths and the decode burst consume it natively (reference analog:
+    # ZeRO-Inference KV quantization, deepspeed/inference/quantization/)
+    kv_quant: Optional[str] = None
     # --- ZeRO-Inference (reference: inference/quantization, README:35) --
     # "int8" | "int4": group-quantized weights, one layer dequantized at
     # a time inside the forward (2-4x smaller resident model)
@@ -120,7 +126,8 @@ class InferenceEngine:
             head_dim=self.cfg.head_dim,
             block_size=self.icfg.kv_block_size,
             num_blocks=self.icfg.num_kv_blocks,
-            dtype=self.icfg.kv_dtype)
+            dtype=self.icfg.kv_dtype,
+            quant=self.icfg.kv_quant or "none")
         self.state = StateManager(kv_cfg, max_seqs=self.icfg.max_seqs,
                                   max_blocks_per_seq=self.max_blocks_per_seq)
         self.topology = topology if (
@@ -208,7 +215,6 @@ class InferenceEngine:
             # the Pallas kernel runs under shard_map, one head group/chip
             self._tp_mesh = topo.mesh
         self.state.kv = jax.device_put(self.state.kv, self._kv_nsh)
-        self._kv_shape_dtype = (self.state.kv.shape, self.state.kv.dtype)
         self._shard_weights()
 
     def _shard_weights(self) -> None:
@@ -329,8 +335,7 @@ class InferenceEngine:
 
     def _kv_zeros(self):
         """A pristine zero cache with the serving sharding applied."""
-        kv = jnp.zeros(*getattr(self, "_kv_shape_dtype",
-                                (self.state.kv.shape, self.state.kv.dtype)))
+        kv = self.state.cfg.kv_zeros()
         if self._kv_nsh is not None:
             kv = jax.device_put(kv, self._kv_nsh)
         return kv
@@ -392,7 +397,8 @@ class InferenceEngine:
         if kv_host:
             # pin the cache output to host memory so the persistent
             # state never round-trips through HBM between steps
-            out_sh = (None, self.state.kv.sharding)
+            out_sh = (None, jax.tree.map(lambda x: x.sharding,
+                                         self.state.kv))
             return jax.jit(step, donate_argnums=(2,),
                            out_shardings=out_sh)
         if self._kv_nsh is not None:
@@ -410,7 +416,7 @@ class InferenceEngine:
                 cfg.num_heads, cfg.num_kv_heads, self.icfg.token_budget,
                 self.icfg.max_seqs, self.icfg.kv_block_size,
                 self.icfg.num_kv_blocks, self.max_blocks_per_seq,
-                topo_sig, self._tp_mesh is not None)
+                self.icfg.kv_quant, topo_sig, self._tp_mesh is not None)
 
     def _probe_variants(self, label: str, variants):
         """Race full ragged steps, one per variant (name -> extra
@@ -680,8 +686,7 @@ class InferenceEngine:
             self._kv_on_host = False
             # the failed call donated the cache; at step 0 it is all
             # zeros — recreate it
-            self.state.kv = jnp.zeros(self.state.kv.shape,
-                                      self.state.kv.dtype)
+            self.state.kv = self.state.cfg.kv_zeros()
             self._step_fns.clear()
             step_fn = self._step_fns[mbs] = self._build_step(mbs)
             logits, self.state.kv = step_fn(

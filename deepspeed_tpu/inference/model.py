@@ -30,22 +30,63 @@ from ..models.transformer import TransformerConfig, _norm
 from .ragged.state import RaggedBatch
 
 
+_KV_QMAX = {jnp.dtype(jnp.int8): 127.0,
+            jnp.dtype(jnp.float8_e4m3fn): 448.0}
+
+
+def _kv_parts(kv_layer):
+    """(data, scales-or-None) view of a paged cache operand — quantized
+    caches travel as a (data, scales) tuple pytree."""
+    if isinstance(kv_layer, tuple):
+        return kv_layer[0], kv_layer[1]
+    return kv_layer, None
+
+
+def _quantize_kv(x, qdt):
+    """x: [..., D] → (codes [..., D] in ``qdt``, scales [...] f32) with
+    one symmetric scale per trailing vector."""
+    xf = x.astype(jnp.float32)
+    qmax = _KV_QMAX[jnp.dtype(qdt)]
+    scale = jnp.max(jnp.abs(xf), axis=-1) / qmax
+    scale = jnp.maximum(scale, 1e-8)
+    q = xf / scale[..., None]
+    if jnp.dtype(qdt) == jnp.dtype(jnp.int8):
+        q = jnp.clip(jnp.round(q), -127, 127)
+    return q.astype(qdt), scale
+
+
+def _dequant_ctx(data, scales, dt):
+    """data: [..., D] codes, scales: [...] → [..., D] in ``dt``."""
+    return (data.astype(jnp.float32)
+            * scales[..., None]).astype(dt)
+
+
 def _write_kv(kv_layer, k, v, batch: RaggedBatch, block_size: int):
-    """Scatter per-token K/V into the paged cache.
+    """Scatter per-token K/V into the paged cache (quantizing on write
+    when the cache is a (data, scales) pair).
 
     kv_layer: [blocks, bs, 2, Hkv, D]; k/v: [T, Hkv, D]
     (reference kernel: linear_blocked_kv_rotary / linear_kv_copy).
     """
+    data, scales = _kv_parts(kv_layer)
     blk = batch.block_tables[batch.seq_slot,
                              batch.positions // block_size]      # [T]
     # budget-padding tokens write to the trash block (last row) so they
     # can never clobber a live sequence's KV
-    trash = kv_layer.shape[0] - 1
+    trash = data.shape[0] - 1
     blk = jnp.where(batch.token_valid, blk, trash)
     off = batch.positions % block_size                           # [T]
-    kv_layer = kv_layer.at[blk, off, 0].set(k)
-    kv_layer = kv_layer.at[blk, off, 1].set(v)
-    return kv_layer
+    if scales is None:
+        data = data.at[blk, off, 0].set(k)
+        data = data.at[blk, off, 1].set(v)
+        return data
+    kq, ks = _quantize_kv(k, data.dtype)
+    vq, vs = _quantize_kv(v, data.dtype)
+    data = data.at[blk, off, 0].set(kq)
+    data = data.at[blk, off, 1].set(vq)
+    scales = scales.at[blk, off, 0].set(ks)
+    scales = scales.at[blk, off, 1].set(vs)
+    return (data, scales)
 
 
 def _paged_attention_pallas(kv_layer, q, batch: RaggedBatch,
@@ -69,7 +110,9 @@ def _paged_attention_pallas(kv_layer, q, batch: RaggedBatch,
 
     from ..comm.mesh import TENSOR_AXIS
 
-    kv_spec = P(None, None, None, TENSOR_AXIS, None)  # [blocks,bs,2,Hkv,D]
+    data_spec = P(None, None, None, TENSOR_AXIS, None)  # [blocks,bs,2,Hkv,D]
+    kv_spec = (data_spec if not isinstance(kv_layer, tuple)
+               else (data_spec, P(None, None, None, TENSOR_AXIS)))
     q_spec = P(None, TENSOR_AXIS, None)               # [T, H, D]
     in_specs = [kv_spec, q_spec, P(), P(), P()]
     operands = [kv_layer, q, batch.seq_slot, batch.positions,
@@ -77,7 +120,7 @@ def _paged_attention_pallas(kv_layer, q, batch: RaggedBatch,
     if slopes is not None:
         in_specs.append(P(TENSOR_AXIS, None))   # slopes [Hkv, rep] split
         operands.append(jnp.asarray(slopes, jnp.float32).reshape(
-            kv_layer.shape[3], -1))             # with the kv heads
+            _kv_parts(kv_layer)[0].shape[3], -1))   # with the kv heads
     f = jax.shard_map(
         lambda kvl, qq, ss, pos, bt, *sl: paged_attention(
             kvl, qq, ss, pos, bt, block_size, max_blocks_per_seq, scale,
@@ -109,9 +152,10 @@ def _paged_attention(kv_layer, q, batch: RaggedBatch, block_size: int,
     behind the same signature; ``InferenceEngine`` probes both.
     """
     T, H, D = q.shape
-    Hkv = kv_layer.shape[3]
+    data, scales = _kv_parts(kv_layer)
+    Hkv = data.shape[3]
     C = max_blocks_per_seq * block_size
-    gather_bytes = T * C * 2 * Hkv * D * kv_layer.dtype.itemsize
+    gather_bytes = T * C * 2 * Hkv * D * data.dtype.itemsize
     if gather_bytes > _ONE_SHOT_GATHER_BYTES:
         return _paged_attention_chunked(kv_layer, q, batch, block_size,
                                         max_blocks_per_seq, scale,
@@ -119,9 +163,13 @@ def _paged_attention(kv_layer, q, batch: RaggedBatch, block_size: int,
     rep = H // Hkv
 
     tables = batch.block_tables[batch.seq_slot, :max_blocks_per_seq]  # [T, nb]
-    ctx = kv_layer[tables]            # [T, nb, bs, 2, Hkv, D]
+    ctx = data[tables]                # [T, nb, bs, 2, Hkv, D]
     ctx = ctx.reshape(T, C, 2, Hkv, D)
     k_ctx, v_ctx = ctx[:, :, 0], ctx[:, :, 1]                     # [T, C, Hkv, D]
+    if scales is not None:
+        sctx = scales[tables].reshape(T, C, 2, Hkv)
+        k_ctx = _dequant_ctx(k_ctx, sctx[:, :, 0], q.dtype)
+        v_ctx = _dequant_ctx(v_ctx, sctx[:, :, 1], q.dtype)
 
     qg = q.reshape(T, Hkv, rep, D)
     s = jnp.einsum("thrd,tchd->thrc", qg, k_ctx).astype(jnp.float32) * scale
@@ -144,7 +192,8 @@ def _paged_attention_chunked(kv_layer, q, batch: RaggedBatch,
     it into an online-softmax accumulator — same numerics as the
     one-shot softmax, peak memory ∝ T·block_size."""
     T, H, D = q.shape
-    Hkv = kv_layer.shape[3]
+    data, scales = _kv_parts(kv_layer)
+    Hkv = data.shape[3]
     rep = H // Hkv
     bs = block_size
 
@@ -155,8 +204,12 @@ def _paged_attention_chunked(kv_layer, q, batch: RaggedBatch,
     def fold(carry, j):
         m, l, acc = carry
         blk = tables[:, j]                          # [T] (-1 pad -> trash)
-        ctx = kv_layer[blk]                         # [T, bs, 2, Hkv, D]
+        ctx = data[blk]                             # [T, bs, 2, Hkv, D]
         k, v = ctx[:, :, 0], ctx[:, :, 1]           # [T, bs, Hkv, D]
+        if scales is not None:
+            sc = scales[blk]                        # [T, bs, 2, Hkv]
+            k = _dequant_ctx(k, sc[:, :, 0], q.dtype)
+            v = _dequant_ctx(v, sc[:, :, 1], q.dtype)
         s = jnp.einsum("thrd,tbhd->thrb", qg, k).astype(jnp.float32) * scale
         cols = j * bs + offs[None, :]               # [1, bs]
         if slopes is not None:
@@ -386,14 +439,22 @@ def snapshot_prefix(kv, block_tables, P: int, block_size: int):
     """Gather each slot's first ``P`` context tokens into a dense
     read-only buffer [L, S, P, 2, Hkv, D] (the burst's attention operand;
     gathered ONCE per burst, never carried through the scan — carrying
-    the paged cache itself copies it every iteration)."""
+    the paged cache itself copies it every iteration).  A quantized
+    cache snapshots as a (codes, scales [L, S, P, 2, Hkv]) pair — the
+    burst dequantizes per layer in its attention, so the snapshot stays
+    1 byte/element."""
+    data, scales = _kv_parts(kv)
     nb = P // block_size
     tables = block_tables[:, :nb]                     # [S, nb]
-    trash = kv.shape[1] - 1
+    trash = data.shape[1] - 1
     tables = jnp.where(tables < 0, trash, tables)
-    ctx = kv[:, tables]            # [L, S, nb, bs, 2, Hkv, D]
+    ctx = data[:, tables]          # [L, S, nb, bs, 2, Hkv, D]
     L, S = ctx.shape[0], ctx.shape[1]
-    return ctx.reshape(L, S, P, 2, ctx.shape[-2], ctx.shape[-1])
+    ctx = ctx.reshape(L, S, P, 2, ctx.shape[-2], ctx.shape[-1])
+    if scales is None:
+        return ctx
+    sctx = scales[:, tables].reshape(L, S, P, 2, ctx.shape[-2])
+    return (ctx, sctx)
 
 
 def decode_burst_forward(cfg: TransformerConfig, params, prefix,
@@ -411,9 +472,10 @@ def decode_burst_forward(cfg: TransformerConfig, params, prefix,
     over the prefix (masked by base_ctx) and (b) attention over the
     in-burst tail (masked by iteration) — no concatenation, the prefix
     is never copied."""
-    nL = prefix.shape[0]
-    S, P = prefix.shape[1], prefix.shape[2]
-    Hkv, D = prefix.shape[4], prefix.shape[5]
+    pdata, pscales = _kv_parts(prefix)
+    nL = pdata.shape[0]
+    S, P = pdata.shape[1], pdata.shape[2]
+    Hkv, D = pdata.shape[4], pdata.shape[5]
     H = cfg.num_heads
     rep = H // Hkv
     norm = _norm(cfg)
@@ -448,8 +510,11 @@ def decode_burst_forward(cfg: TransformerConfig, params, prefix,
 
         qg = q.reshape(S, Hkv, rep, D)
         # (a) prefix attention, masked by each slot's true context length
-        kp = prefix[li, :, :, 0]                      # [S, P, Hkv, D]
-        vp = prefix[li, :, :, 1]
+        kp = pdata[li, :, :, 0]                       # [S, P, Hkv, D]
+        vp = pdata[li, :, :, 1]
+        if pscales is not None:
+            kp = _dequant_ctx(kp, pscales[li, :, :, 0], dt)
+            vp = _dequant_ctx(vp, pscales[li, :, :, 1], dt)
         sa = jnp.einsum("shrd,sphd->shrp", qg, kp.astype(dt)
                         ).astype(jnp.float32) * scale
         cols = jnp.arange(P)[None, :]
@@ -541,15 +606,21 @@ def decode_burst_forward(cfg: TransformerConfig, params, prefix,
 def scatter_tail(kv, tail, block_tables, base_ctx, block_size: int):
     """Write the burst's tail KV into the paged cache (one donated
     dispatch after the scan): token (slot s, iter j) lands at block
-    tables[s, (base+j)//bs], offset (base+j)%bs."""
+    tables[s, (base+j)//bs], offset (base+j)%bs.  Quantized caches
+    quantize the dense in-burst tail here, on commit."""
+    data, scales = _kv_parts(kv)
     nL, S, K = tail.shape[0], tail.shape[1], tail.shape[2]
     pos = base_ctx[:, None] + jnp.arange(K)[None, :]          # [S, K]
     blk = jnp.take_along_axis(block_tables, pos // block_size,
                               axis=1)                          # [S, K]
-    trash = kv.shape[1] - 1
+    trash = data.shape[1] - 1
     blk = jnp.where(blk < 0, trash, blk)
     off = pos % block_size
     li = jnp.arange(nL)[:, None, None]
     # kv[l, blk[s,k], off[s,k]] <- tail[l, s, k]  ([2, Hkv, D] payload)
-    kv = kv.at[li, blk[None], off[None]].set(tail)
-    return kv
+    if scales is None:
+        return data.at[li, blk[None], off[None]].set(tail)
+    tq, ts = _quantize_kv(tail, data.dtype)   # ts: [L, S, K, 2, Hkv]
+    data = data.at[li, blk[None], off[None]].set(tq)
+    scales = scales.at[li, blk[None], off[None]].set(ts)
+    return (data, scales)

@@ -36,10 +36,33 @@ class KVCacheConfig:
     block_size: int = 64
     num_blocks: int = 128
     dtype: object = jnp.bfloat16
+    # "none" | "int8" | "fp8": store the paged cache quantized with one
+    # scale per written (token, k|v, head) vector — halves the KV HBM
+    # stream that dominates long-context decode (reference analog:
+    # ZeRO-Inference KV quantization, deepspeed/inference/quantization/)
+    quant: str = "none"
 
     @property
     def max_context(self) -> int:
         return self.num_blocks * self.block_size
+
+    def __post_init__(self):
+        if self.quant not in ("none", "int8", "fp8"):
+            raise ValueError(
+                f"kv_quant={self.quant!r}: the paged cache supports "
+                "'int8' or 'fp8' (per-vector scales); weight_quant is "
+                "the option that also takes 'int4'")
+
+    def kv_zeros(self):
+        """A pristine cache: a single array, or (data, scales) when
+        quantized (a plain tuple — a pytree, so jit/donate/device_put
+        treat it like the array everywhere the engine is agnostic)."""
+        shape = (self.num_layers, self.num_blocks + 1, self.block_size, 2,
+                 self.num_kv_heads, self.head_dim)
+        if self.quant == "none":
+            return jnp.zeros(shape, self.dtype)
+        qdt = {"int8": jnp.int8, "fp8": jnp.float8_e4m3fn}[self.quant]
+        return (jnp.zeros(shape, qdt), jnp.zeros(shape[:-1], jnp.float32))
 
 
 @dataclasses.dataclass
@@ -87,9 +110,8 @@ class StateManager:
         self._free_slots = list(range(max_seqs))
         # paged KV: [L, blocks+1, block_size, 2, Hkv, D] — the extra row is
         # the trash block that padding tokens' KV writes are routed to
-        self.kv = jnp.zeros(
-            (cfg.num_layers, cfg.num_blocks + 1, cfg.block_size, 2,
-             cfg.num_kv_heads, cfg.head_dim), cfg.dtype)
+        # (plus per-vector scales when cfg.quant != "none")
+        self.kv = cfg.kv_zeros()
 
     # ---- sequence lifecycle ---------------------------------------------
     def get_or_create(self, uid: int) -> SequenceDescriptor:

@@ -47,12 +47,13 @@ def _use_interpret() -> bool:
 
 def _kernel(tables_ref, pos_ref, q_ref, kv_ref, *rest,
             block_size: int, scale: float,
-            num_kv_heads: int, rep: int, alibi: bool):
-    if alibi:   # optional trailing input before outputs/scratch
-        slopes_ref, o_ref, acc_ref, m_ref, l_ref = rest
-    else:
-        slopes_ref = None
-        o_ref, acc_ref, m_ref, l_ref = rest
+            num_kv_heads: int, rep: int, alibi: bool, kv_quant: bool):
+    # optional trailing inputs (order: kv scales, alibi slopes) before
+    # the output and scratch refs
+    rest = list(rest)
+    ks_ref = rest.pop(0) if kv_quant else None
+    slopes_ref = rest.pop(0) if alibi else None
+    o_ref, acc_ref, m_ref, l_ref = rest
     t = pl.program_id(0)
     j = pl.program_id(1)
     nb = pl.num_programs(1)
@@ -74,6 +75,11 @@ def _kernel(tables_ref, pos_ref, q_ref, kv_ref, *rest,
             q = q_ref[0, h * rep:(h + 1) * rep, :]         # [rep, D]
             k = kv_ref[0, :, 0, h, :]                      # [bs, D]
             v = kv_ref[0, :, 1, h, :]                      # [bs, D]
+            if kv_quant:    # in-VMEM dequant: HBM only streamed codes
+                k = (k.astype(jnp.float32)
+                     * ks_ref[0, :, 0, h][:, None]).astype(q.dtype)
+                v = (v.astype(jnp.float32)
+                     * ks_ref[0, :, 1, h][:, None]).astype(q.dtype)
             s = jax.lax.dot_general(
                 q, k, dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale  # [rep, bs]
@@ -103,13 +109,19 @@ def _kernel(tables_ref, pos_ref, q_ref, kv_ref, *rest,
 def paged_attention(kv_layer, q, seq_slot, positions, block_tables,
                     block_size: int, max_blocks_per_seq: int, scale: float,
                     slopes=None):
-    """kv_layer: [blocks+1, bs, 2, Hkv, D] (last row = trash);
+    """kv_layer: [blocks+1, bs, 2, Hkv, D] (last row = trash), or a
+    (data, scales) tuple for a quantized cache (scales
+    [blocks+1, bs, 2, Hkv] f32; codes dequantized in VMEM so HBM only
+    streams the 1-byte payloads);
     q: [T, H, D]; seq_slot/positions: [T] i32;
     block_tables: [max_seqs, max_blocks] i32 (-1 pad) → out [T, H, D].
     ``slopes``: optional ALiBi per-head slopes, any shape reshapeable to
     [Hkv, rep] in head order h = hkv*rep + r (reference analog: the alibi
     operand of the inference softmax kernels, csrc/transformer/inference/
     csrc/softmax.cu)."""
+    kv_scales = None
+    if isinstance(kv_layer, tuple):
+        kv_layer, kv_scales = kv_layer
     T, H, D = q.shape
     nblocks, bs, _, Hkv, _ = kv_layer.shape
     rep = H // Hkv
@@ -126,13 +138,21 @@ def paged_attention(kv_layer, q, seq_slot, positions, block_tables,
         jj = jnp.minimum(j, pos[t] // bs)
         return (tbl[t, jj], 0, 0, 0, 0)
 
+    def _ks_index(t, j, tbl, pos):
+        jj = jnp.minimum(j, pos[t] // bs)
+        return (tbl[t, jj], 0, 0, 0)
+
     alibi = slopes is not None
+    kv_quant = kv_scales is not None
     in_specs = [
         pl.BlockSpec((1, H, D),
                      lambda t, j, tbl, pos: (t, 0, 0)),
         pl.BlockSpec((1, bs, 2, Hkv, D), _kv_index),
     ]
     operands = [tables, positions, q, kv_layer]
+    if kv_quant:
+        in_specs.append(pl.BlockSpec((1, bs, 2, Hkv), _ks_index))
+        operands.append(kv_scales)
     if alibi:
         in_specs.append(pl.BlockSpec((Hkv, rep),
                                      lambda t, j, tbl, pos: (0, 0)))
@@ -142,7 +162,8 @@ def paged_attention(kv_layer, q, seq_slot, positions, block_tables,
     grid = (T, nb)
     out = pl.pallas_call(
         functools.partial(_kernel, block_size=bs, scale=scale,
-                          num_kv_heads=Hkv, rep=rep, alibi=alibi),
+                          num_kv_heads=Hkv, rep=rep, alibi=alibi,
+                          kv_quant=kv_quant),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,

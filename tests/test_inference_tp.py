@@ -136,3 +136,15 @@ def test_tp_alibi_parity(devices):
     tp_pallas = run(model, icfg(attn_impl="pallas"),
                     topology=topo_tp4_fsdp2(devices))
     assert ref == tp_pallas
+
+
+def test_tp_kv_quant_parity(devices, model):
+    """int8 paged KV under TP: codes and scales head-split together;
+    both the XLA path and the Pallas shard_map kernel match the
+    single-device quantized engine exactly."""
+    ref = run(model, icfg(kv_quant="int8"))
+    tp = run(model, icfg(kv_quant="int8"), topology=topo_tp4_fsdp2(devices))
+    assert ref == tp
+    tp_pallas = run(model, icfg(kv_quant="int8", attn_impl="pallas"),
+                    topology=topo_tp4_fsdp2(devices))
+    assert ref == tp_pallas
