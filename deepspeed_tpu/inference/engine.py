@@ -67,8 +67,8 @@ class InferenceConfig:
     # mixed-input GEMM (int8 weight x bf16 act, dequant in VMEM —
     # ops/mixed_gemm.py; reference: cuda_linear fp6 GEMM): "auto" races
     # it against the fused-dequant XLA path once post-compile (like
-    # attn_impl); "on"/"off" force.  Only engages for row-wise int8
-    # quant trees.
+    # attn_impl); "on"/"off" force.  Engages for the row-wise int8
+    # and packed row-wise int4 layouts.
     mixed_gemm: str = "auto"
     quantize_embeddings: bool = False
     # keep the paged KV cache in host memory, streaming one layer per
@@ -292,15 +292,18 @@ class InferenceEngine:
                     if qt.zero is not None:
                         a["zero"] = qt.zero
                     qarrays[gname][name] = a
-                    qmeta[gname][name] = (qt.bits, qt.shape[1:], qt.dtype)
+                    qmeta[gname][name] = (qt.bits, qt.shape[1:], qt.dtype,
+                                          qt.layout)
             record["quant"] = qarrays
             store.qmeta = qmeta
-            # mixed-gemm eligibility: per-layer payloads kept in the
-            # weight's own shape with symmetric int8 row scales
-            from ..ops.quant import is_rowwise_int8
-            store.rowwise_int8 = all(
-                is_rowwise_int8(qt)
-                for grp in qblocks.values() for qt in grp.values())
+            # mixed-gemm eligibility: row-wise int8 (weight-shaped) or
+            # packed row-wise int4 per-layer payloads; expert weights
+            # don't count — moe_ffn always consumes them dense
+            from ..ops.quant import is_mixed_gemm_layout
+            store.mixed_gemm_eligible = all(
+                is_mixed_gemm_layout(qt)
+                for gname, grp in qblocks.items() if gname != "experts"
+                for qt in grp.values())
         store.spill(record)
         self._stream = store
         if self.icfg.decode_burst > 1:
@@ -525,19 +528,26 @@ class InferenceEngine:
         return best
 
     def _quant_is_rowwise(self) -> bool:
-        """The mixed-input kernel consumes only the row-wise int8
-        symmetric layout (payload in the weight's own shape)."""
-        from ..ops.quant import QuantizedTensor, is_rowwise_int8
+        """The mixed-input kernel family consumes the row-wise int8
+        (weight-shaped payload) and packed row-wise int4 layouts.
+        Only the weights the ``_mm`` projection sites consume count:
+        expert weights (dense in moe_ffn) and the embedding table
+        (dequantized once per step) are always dequantized regardless."""
+        from ..ops.quant import QuantizedTensor, is_mixed_gemm_layout
         if self._quant is None:
             return False
+        blocks = {k: v for k, v in
+                  (self._quant.get("blocks") or {}).items()
+                  if k != "experts"}
         leaves = [x for x in jax.tree.leaves(
-            self._quant, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+            blocks, is_leaf=lambda x: isinstance(x, QuantizedTensor))
             if isinstance(x, QuantizedTensor)]
-        return bool(leaves) and all(is_rowwise_int8(q) for q in leaves)
+        return bool(leaves) and all(is_mixed_gemm_layout(q)
+                                    for q in leaves)
 
     def _mixed_gemm_eligible(self) -> bool:
         return (self._quant_is_rowwise() if self._stream is None
-                else self._stream.rowwise_int8)
+                else self._stream.mixed_gemm_eligible)
 
     def _require_mixed_gemm_eligible(self) -> None:
         if not self._mixed_gemm_eligible():
@@ -545,8 +555,8 @@ class InferenceEngine:
                     if self._stream is not None
                     else "the resident quantized weights are")
             raise ValueError(
-                f"mixed_gemm='on': {what} not the row-wise int8 layout "
-                "the kernel consumes; use 'auto'")
+                f"mixed_gemm='on': {what} not a row-wise int8/int4 "
+                "layout the kernel family consumes; use 'auto'")
 
     def _resolve_mixed_gemm(self, attn_impl: str) -> bool:
         """Resolve the mixed_gemm config to a bool for this build

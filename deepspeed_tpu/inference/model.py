@@ -246,15 +246,17 @@ def _stream_layer(stream, li, dt, mixed_gemm: bool = False):
     lp = {k: (dict(v) if isinstance(v, dict) else v)
           for k, v in rec["dense"].items()}
     if "quant" in rec:
-        from ..ops.quant import QuantizedTensor, dequantize_any
+        from ..ops.quant import (QuantizedTensor, dequantize_any,
+                                 is_mixed_gemm_layout)
         for gname, grp in rec["quant"].items():
             g = dict(lp.get(gname, {}))
             for name, arrs in grp.items():
-                bits, shp, odt = stream.qmeta[gname][name]
+                bits, shp, odt, layout = stream.qmeta[gname][name]
                 qt = QuantizedTensor(arrs["data"], arrs["scale"],
-                                     arrs.get("zero"), bits, shp, odt)
-                from ..ops.quant import is_rowwise_int8
-                if mixed_gemm and is_rowwise_int8(qt):
+                                     arrs.get("zero"), bits, shp, odt,
+                                     layout=layout)
+                if mixed_gemm and gname != "experts" \
+                        and is_mixed_gemm_layout(qt):
                     g[name] = qt
                 else:
                     g[name] = dequantize_any(qt, dt)

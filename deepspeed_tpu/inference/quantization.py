@@ -38,10 +38,13 @@ from ..ops.quant import (MINIFLOAT_BY_BITS, QuantizedTensor,
 _BLOCK_WEIGHTS = ("wq", "wk", "wv", "wo", "wi", "wg")
 
 
-def _quantize_stacked(w: jax.Array, bits: int) -> QuantizedTensor:
+def _quantize_stacked(w: jax.Array, bits: int,
+                      contract_dims: int = 1) -> QuantizedTensor:
     """Quantize a [L, ...] stacked weight layer-by-layer (eager, at
     engine build), so a single layer can be dequantized without touching
-    the others.  bits 4/8 = grouped int; 6/12 = emulated minifloat
+    the others.  bits 8 = row-wise weight-shaped; 4 = PACKED row-wise
+    nibbles (real 0.5 byte/weight storage+bandwidth — reference:
+    cuda_linear/linear_kernels_cuda.cu); 6/12 = emulated minifloat
     (reference: csrc/fp_quantizer FP6/FP12)."""
     if bits == 8:
         # row-wise weight-shaped layout: per (layer, row) scales, data in
@@ -49,6 +52,15 @@ def _quantize_stacked(w: jax.Array, bits: int) -> QuantizedTensor:
         # matmul with no reshape/layout copy (ops/quant.quantize_rowwise)
         from ..ops.quant import _quantize_leading
         return _quantize_leading(w, lead_dims=2)
+    if bits == 4:
+        from ..ops.quant import quantize_rowwise4
+        K = 1
+        for d in w.shape[1:1 + contract_dims]:
+            K *= d
+        if K % 2 == 0:
+            return quantize_rowwise4(w, contract_dims=contract_dims,
+                                     lead_dims=1)
+        # odd contraction cannot pack strided halves — grouped fallback
     groups = default_groups(w[0].size)
     if bits in MINIFLOAT_BY_BITS:
         fmt = MINIFLOAT_BY_BITS[bits]
@@ -70,7 +82,8 @@ def layer_qt(qt: QuantizedTensor, i) -> QuantizedTensor:
     (the mixed-input GEMM consumes this directly — ops/mixed_gemm.py)."""
     return QuantizedTensor(qt.data[i], qt.scale[i],
                            None if qt.zero is None else qt.zero[i],
-                           qt.bits, qt.shape[1:], qt.dtype)
+                           qt.bits, qt.shape[1:], qt.dtype,
+                           layout=qt.layout)
 
 
 def layer_weight(qt: QuantizedTensor, i, dt) -> jax.Array:
@@ -97,7 +110,12 @@ def quantize_model_params(params: Dict[str, Any], bits: int = 8,
         qgroup = {}
         for name, w in list(group.items()):
             if name in _BLOCK_WEIGHTS and w.ndim >= 3:   # [L, ...] weight
-                qgroup[name] = _quantize_stacked(w, bits)
+                # the attention output projection contracts its leading
+                # (H, Dh) dims — the packed-int4 layout must flatten the
+                # same split the serving matmul uses (_mm contract_dims)
+                cd = 2 if (group_name == "attn" and name == "wo"
+                           and w.ndim >= 4) else 1
+                qgroup[name] = _quantize_stacked(w, bits, contract_dims=cd)
                 del group[name]
         if qgroup:
             quant["blocks"][group_name] = qgroup
@@ -125,12 +143,15 @@ def merge_layer(lp: Dict[str, Any], quant_blocks: Dict[str, Any], i,
     plus this layer's quantized weights — dequantized here, or (with
     ``mixed=True``) left as row-wise QuantizedTensors for the
     mixed-input GEMM (dequant happens in VMEM inside the kernel)."""
-    from ..ops.quant import is_rowwise_int8
+    from ..ops.quant import is_mixed_gemm_layout
     out = dict(lp)
     for group_name, qgroup in quant_blocks.items():
         g = dict(out.get(group_name, {}))
         for name, qt in qgroup.items():
-            if mixed and is_rowwise_int8(qt):
+            # expert weights are consumed DENSE by moe_ffn's ragged/
+            # scatter dispatch — never hand it a QuantizedTensor
+            if mixed and group_name != "experts" \
+                    and is_mixed_gemm_layout(qt):
                 g[name] = layer_qt(qt, i)
             else:
                 g[name] = layer_weight(qt, i, dt)

@@ -29,9 +29,10 @@ class NVMeWeightStore:
     """Spill a stacked per-layer pytree to per-layer files and fetch one
     layer at a time from inside a compiled scan."""
 
-    # set by the engine at spill time when every quantized payload is the
-    # row-wise int8 layout the mixed-input GEMM consumes
-    rowwise_int8 = False
+    # set by the engine at spill time when every quantized payload is a
+    # layout the mixed-input GEMM family consumes (row-wise int8 or
+    # packed row-wise int4)
+    mixed_gemm_eligible = False
     qmeta = None
 
     def __init__(self, path: str, num_layers: int):

@@ -101,19 +101,97 @@ def mixed_matmul_2d(x: jax.Array, data: jax.Array, scale: jax.Array,
     return out[:M] if Mp != M else out
 
 
+def _mixed4_kernel(x1_ref, x2_ref, d_ref, s1_ref, s2_ref, o_ref, acc_ref):
+    """Packed-int4 tile: the byte block unpacks IN VMEM into the two
+    strided contraction halves (lo nibble = flat row j, hi = j + K/2 —
+    ops/quant.quantize_rowwise4), each fed to its own MXU dot against
+    the matching activation tile.  HBM streams 0.5 byte/weight."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    from .quant import unpack_nibbles
+    lo, hi = unpack_nibbles(d_ref[...])
+    w1 = lo.astype(jnp.bfloat16) * s1_ref[...].astype(jnp.bfloat16)
+    w2 = hi.astype(jnp.bfloat16) * s2_ref[...].astype(jnp.bfloat16)
+    acc_ref[...] += jax.lax.dot(
+        x1_ref[...].astype(jnp.bfloat16), w1,
+        preferred_element_type=jnp.float32)
+    acc_ref[...] += jax.lax.dot(
+        x2_ref[...].astype(jnp.bfloat16), w2,
+        preferred_element_type=jnp.float32)
+
+    @pl.when(k == pl.num_programs(2) - 1)
+    def _flush():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_m", "block_n",
+                                             "block_k", "interpret",
+                                             "out_dtype"))
+def mixed4_matmul_2d(x: jax.Array, data: jax.Array, scale: jax.Array,
+                     *, block_m: int = 0, block_n: int = 512,
+                     block_k: int = 512, out_dtype=jnp.bfloat16,
+                     interpret: bool = False) -> jax.Array:
+    """``x [M, K] @ unpack(int4 data [K/2, N], scale [K, 1]) -> [M, N]``.
+
+    ``data`` byte row j packs flat contraction rows j (lo nibble) and
+    j + K/2 (hi).  The x and scale operands are passed TWICE with offset
+    index maps — one view per half — so the kernel needs no gather."""
+    M, K = x.shape
+    Kh, N = data.shape
+    assert K == 2 * Kh and scale.shape[0] == K, (x.shape, data.shape,
+                                                 scale.shape)
+    if block_m <= 0:
+        block_m = min(128, max(8, 1 << (max(M - 1, 1)).bit_length()))
+    bk = min(block_k, Kh)
+    bn = min(block_n, N)
+    if Kh % bk or N % bn:
+        raise ValueError(f"K/2={Kh}/N={N} must divide block_k={bk}/"
+                         f"block_n={bn}")
+    Mp = -(-M // block_m) * block_m
+    if Mp != M:
+        x = jnp.pad(x, ((0, Mp - M), (0, 0)))
+    nk = Kh // bk
+    scale2 = scale.reshape(K, 1)
+
+    out = pl.pallas_call(
+        _mixed4_kernel,
+        grid=(Mp // block_m, N // bn, nk),
+        in_specs=[
+            pl.BlockSpec((block_m, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((block_m, bk),
+                         lambda i, j, k, _nk=nk: (i, k + _nk)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+            pl.BlockSpec((bk, 1), lambda i, j, k: (k, 0)),
+            pl.BlockSpec((bk, 1), lambda i, j, k, _nk=nk: (k + _nk, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_m, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((Mp, N), out_dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, bn), jnp.float32)],
+        interpret=interpret,
+    )(x, x, data, scale2, scale2)
+    return out[:M] if Mp != M else out
+
+
 def mixed_matmul(x: jax.Array, qt, *, contract_dims: int = 1,
                  interpret: bool = False, out_dtype=None) -> jax.Array:
-    """``x @ dequant(qt)`` through the mixed-input kernel.
+    """``x @ dequant(qt)`` through the mixed-input kernel family.
 
-    ``x``: [..., K]; ``qt``: a row-wise :class:`~deepspeed_tpu.ops.quant.
-    QuantizedTensor` whose payload's first ``contract_dims`` dims flatten
-    into the contraction (K) and the rest into N — e.g. an attention
-    output projection [H, Dh, d] uses ``contract_dims=2``.  Scales on a
-    coarser leading granularity than K (per-head for [H, Dh, d])
-    broadcast down to rows.
+    ``x``: [..., K]; ``qt``: a row-wise int8 (weight-shaped payload) or
+    packed row-wise int4 ("rowwise4" flat [K/2, N])
+    :class:`~deepspeed_tpu.ops.quant.QuantizedTensor` whose payload's
+    first ``contract_dims`` dims flatten into the contraction (K) and
+    the rest into N — e.g. an attention output projection [H, Dh, d]
+    uses ``contract_dims=2``.  Scales on a coarser leading granularity
+    than K (per-head for [H, Dh, d]) broadcast down to rows.
     """
-    assert qt.bits == 8 and qt.zero is None, \
-        "mixed_matmul consumes the row-wise int8 symmetric layout"
+    from .quant import is_rowwise_int4
+    int4 = is_rowwise_int4(qt)
+    assert int4 or (qt.bits == 8 and qt.zero is None), \
+        "mixed_matmul consumes the row-wise int8/int4 symmetric layouts"
     if jax.default_backend() != "tpu":
         interpret = True        # CPU/virtual meshes: no Mosaic lowering
     wshape = tuple(qt.shape)
@@ -128,9 +206,20 @@ def mixed_matmul(x: jax.Array, qt, *, contract_dims: int = 1,
         # leading-dim scales are constant over their trailing rows
         s = jnp.broadcast_to(s[:, None], (s.size, K // s.size))
     out_dtype = out_dtype or x.dtype
-    y = mixed_matmul_2d(x.reshape(M, K), qt.data.reshape(K, N),
-                        s.reshape(K, 1), out_dtype=out_dtype,
-                        interpret=interpret)
+    if int4:
+        # the flat packing fixed K at quantize time; a caller using a
+        # different contraction split would reshape "successfully" into
+        # garbage — reject loudly instead
+        assert qt.data.shape[-2] == K // 2, \
+            ("rowwise4 payload packed for a different contraction split",
+             qt.data.shape, K)
+        y = mixed4_matmul_2d(x.reshape(M, K), qt.data.reshape(K // 2, N),
+                             s.reshape(K, 1), out_dtype=out_dtype,
+                             interpret=interpret)
+    else:
+        y = mixed_matmul_2d(x.reshape(M, K), qt.data.reshape(K, N),
+                            s.reshape(K, 1), out_dtype=out_dtype,
+                            interpret=interpret)
     return y.reshape(*lead, *wshape[contract_dims:])
 
 

@@ -36,30 +36,36 @@ class QuantizedTensor:
     buffers) instead of closure constants — a closed-over llama3-8b int8
     tree baked 7.5 GB of constants into the HLO and killed the compile."""
 
-    __slots__ = ("data", "scale", "zero", "bits", "shape", "dtype")
+    __slots__ = ("data", "scale", "zero", "bits", "shape", "dtype",
+                 "layout")
 
     def __init__(self, data, scale, zero, bits: int,
-                 shape: Tuple[int, ...], dtype):
+                 shape: Tuple[int, ...], dtype, layout: str = "grouped"):
         self.data = data           # int8 (packed nibbles when bits=4)
         self.scale = scale         # f32 [groups, 1]
         self.zero = zero           # f32 [groups, 1] (None when symmetric)
         self.bits = bits
         self.shape = tuple(shape)  # original shape
         self.dtype = dtype         # original dtype
+        # "grouped": grouped-flat [G, gsz];  "rowwise": weight-shaped
+        # int8 with leading-dim scales;  "rowwise4": flat [K/2, N] packed
+        # nibbles over strided contraction halves (byte j = rows j and
+        # j + K/2) with leading-dim scales — the serving GEMM layouts
+        self.layout = layout
 
     def tree_flatten(self):
         return (self.data, self.scale, self.zero), \
-            (self.bits, self.shape, jnp.dtype(self.dtype))
+            (self.bits, self.shape, jnp.dtype(self.dtype), self.layout)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         data, scale, zero = children
-        bits, shape, dtype = aux
-        return cls(data, scale, zero, bits, shape, dtype)
+        bits, shape, dtype, layout = aux
+        return cls(data, scale, zero, bits, shape, dtype, layout)
 
     def __repr__(self):
         return (f"QuantizedTensor(bits={self.bits}, shape={self.shape}, "
-                f"dtype={self.dtype})")
+                f"dtype={self.dtype}, layout={self.layout})")
 
 
 def _group(x: jax.Array, num_groups: int) -> jax.Array:
@@ -86,13 +92,20 @@ def _pack_int4(q: jax.Array) -> jax.Array:
     return (lo | (hi << 4)).astype(jnp.int8)
 
 
-def _unpack_int4(p: jax.Array) -> jax.Array:
+def unpack_nibbles(p: jax.Array):
+    """(lo, hi) int8 nibbles of a packed byte array, sign-extended from
+    4-bit two's complement.  Pure jnp — shared by the grouped unpack,
+    the rowwise4 dequant, and the Pallas mixed-GEMM kernel."""
     u = p.astype(jnp.uint8)
     lo = (u & 0x0F).astype(jnp.int8)
     hi = ((u >> 4) & 0x0F).astype(jnp.int8)
-    # sign-extend 4-bit two's complement
     lo = jnp.where(lo > 7, lo - 16, lo)
     hi = jnp.where(hi > 7, hi - 16, hi)
+    return lo, hi
+
+
+def _unpack_int4(p: jax.Array) -> jax.Array:
+    lo, hi = unpack_nibbles(p)
     return jnp.stack([lo, hi], axis=-1).reshape(p.shape[0], -1)
 
 
@@ -157,19 +170,80 @@ def _quantize_leading(x: jax.Array, lead_dims: int) -> QuantizedTensor:
     scale = jnp.where(scale == 0, 1.0, scale)
     q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -128, 127)
     return QuantizedTensor(q.astype(jnp.int8), scale, None, 8,
-                           orig_shape, orig_dtype)
+                           orig_shape, orig_dtype, layout="rowwise")
 
 
 def is_rowwise_int8(qt: "QuantizedTensor") -> bool:
-    """The layout the mixed-input GEMM consumes (ops/mixed_gemm.py):
+    """The layout the int8 mixed-input GEMM consumes (ops/mixed_gemm.py):
     symmetric int8 payload kept in the weight's own shape with leading-
     dim scales — the single source of truth for eligibility checks."""
     return (qt.bits == 8 and qt.zero is None
             and tuple(qt.data.shape) == tuple(qt.shape))
 
 
+def is_rowwise_int4(qt: "QuantizedTensor") -> bool:
+    """The packed layout the int4 mixed-input GEMM consumes: flat
+    [K/2, N] strided-half nibbles with leading-dim scales
+    (:func:`quantize_rowwise4`)."""
+    return qt.bits == 4 and qt.zero is None and qt.layout == "rowwise4"
+
+
+def is_mixed_gemm_layout(qt: "QuantizedTensor") -> bool:
+    """Any layout the mixed-input GEMM family consumes natively."""
+    return is_rowwise_int8(qt) or is_rowwise_int4(qt)
+
+
+def quantize_rowwise4(x: jax.Array, contract_dims: int = 1,
+                      lead_dims: int = 0) -> QuantizedTensor:
+    """Packed int4 serving layout (reference analog: the FP6/int4
+    weight-only GEMM's prepacked storage,
+    inference/v2/kernels/core_ops/cuda_linear/linear_kernels_cuda.cu —
+    real 0.5-byte/weight storage AND bandwidth, not emulation).
+
+    ``x``: [*lead, K..., N...] where the first ``contract_dims`` dims
+    after ``lead_dims`` stack dims flatten into the contraction K.
+    Symmetric per-(lead, first-K-dim-row) scales, values in [-7, 7],
+    and the flat contraction packed as STRIDED HALVES: byte row j holds
+    flat rows j (lo nibble) and j + K/2 (hi nibble).  The strided split
+    means unpacking is two contiguous row blocks — no lane interleave —
+    which both the XLA dequant and the Pallas kernel exploit."""
+    orig_shape, orig_dtype = tuple(x.shape), x.dtype
+    lead = orig_shape[:lead_dims]
+    K = int(np.prod(orig_shape[lead_dims:lead_dims + contract_dims]))
+    N = int(np.prod(orig_shape[lead_dims + contract_dims:]) or 1)
+    assert K % 2 == 0, f"int4 packing needs an even contraction ({K})"
+    red = tuple(range(lead_dims + 1, x.ndim))
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=red,
+                    keepdims=False) / 7.0
+    scale = jnp.where(scale == 0, 1.0, scale)       # [*lead, S]
+    S = scale.shape[-1]
+    sb = scale.reshape(*lead, S, *([1] * (x.ndim - lead_dims - 1)))
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / sb), -7, 7)
+    q = q.astype(jnp.int8).reshape(*lead, K, N)
+    lo, hi = q[..., : K // 2, :], q[..., K // 2:, :]
+    packed = ((lo & 0x0F) | ((hi & 0x0F) << 4)).astype(jnp.int8)
+    return QuantizedTensor(packed, scale.reshape(*lead, S, 1), None, 4,
+                           orig_shape, orig_dtype, layout="rowwise4")
+
+
+def dequantize_rowwise4(qt: QuantizedTensor, dtype=None) -> jax.Array:
+    """Unpack a :func:`quantize_rowwise4` payload back to the original
+    weight shape (the XLA fallback path; the kernel unpacks in VMEM)."""
+    out_dt = dtype or qt.dtype
+    lo, hi = unpack_nibbles(qt.data)                # [*lead, K/2, N]
+    flat = jnp.concatenate([lo, hi], axis=-2)       # [*lead, K, N]
+    K, N = flat.shape[-2], flat.shape[-1]
+    s = qt.scale.reshape(*qt.scale.shape[:-1])      # [*lead, S]
+    S = s.shape[-1]
+    w = flat.reshape(*flat.shape[:-2], S, K // S, N).astype(out_dt) \
+        * s[..., None, None].astype(out_dt)
+    return w.reshape(qt.shape).astype(out_dt)
+
+
 def dequantize(qt: QuantizedTensor, dtype=None) -> jax.Array:
     """(reference: dequantize / dequantize_int4_to_half_experimental)."""
+    if qt.layout == "rowwise4":
+        return dequantize_rowwise4(qt, dtype)
     out_dt = dtype or qt.dtype
     q = _unpack_int4(qt.data) if qt.bits == 4 else qt.data
     if qt.bits == 8 and qt.zero is None \

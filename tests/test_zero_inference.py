@@ -80,16 +80,18 @@ class TestQuantizedServing:
         assert out_m == out_d
 
     def test_mixed_gemm_rejected_for_grouped_layouts(self):
-        """Grouped int4 trees are not the layout the kernel consumes:
-        forcing mixed_gemm='on' must raise (same contract as the streamed
-        path), while 'auto' quietly keeps the kernel off."""
+        """Grouped/minifloat trees are not layouts the kernel family
+        consumes: forcing mixed_gemm='on' must raise (same contract as
+        the streamed path), while 'auto' quietly keeps the kernel off.
+        (int4 is now the packed row-wise layout and IS eligible — fp6
+        stays the ineligible exemplar.)"""
         m = tiny_model()
         with pytest.raises(ValueError, match="mixed_gemm"):
             make_engine(m, kv_dtype=jnp.float32,
-                        param_dtype=jnp.float32, weight_quant="int4",
+                        param_dtype=jnp.float32, weight_quant="fp6",
                         mixed_gemm="on")
         eng = make_engine(m, kv_dtype=jnp.float32,
-                          param_dtype=jnp.float32, weight_quant="int4",
+                          param_dtype=jnp.float32, weight_quant="fp6",
                           mixed_gemm="auto")
         prompt = list(np.random.RandomState(2).randint(1, 128, 8))
         out = eng.generate({1: prompt}, GREEDY)[1]
@@ -224,7 +226,7 @@ class TestWeightStream:
             prompts)
         eng = InferenceEngine(mk(), InferenceConfig(
             weight_stream=str(tmp_path / "wm"), mixed_gemm="on", **kw))
-        assert eng._stream.rowwise_int8
+        assert eng._stream.mixed_gemm_eligible
         out = self._gen(eng, prompts)
         assert eng._mixed_gemm_active
         assert out == ref
