@@ -555,3 +555,135 @@ def load_hf_state_dict(cfg: TransformerConfig, state_dict: Dict[str, Any],
     logger.info("converted %d HF tensors (%s family)",
                 len(state_dict), family_of(family))
     return params
+
+
+def load_hf_bert(cfg, state_dict: Dict[str, Any], dtype=None) -> Dict:
+    """BERT-class encoder state dict → ``models/encoder.py`` tree
+    (reference container: module_inject/containers/bert.py:13).
+    DistilBERT's different naming goes through :func:`load_hf_distilbert`
+    (reference: distil_bert.py).  ``cfg``: an
+    :class:`~deepspeed_tpu.models.encoder.EncoderConfig`."""
+    H, D, nl = cfg.num_heads, cfg.head_dim, cfg.num_layers
+    pre = next((p for p in ("bert.", "")
+                if f"{p}embeddings.word_embeddings.weight" in state_dict),
+               "bert.")
+    sd = state_dict
+    Lf = pre + "encoder.layer.{}."
+
+    def attn_w(sub):
+        return _stack(sd, Lf + f"attention.self.{sub}.weight", nl,
+                      lambda w: _qkv_heads(w, H, D, True))
+
+    def attn_b(sub):
+        return _stack(sd, Lf + f"attention.self.{sub}.bias", nl,
+                      lambda b: b.reshape(H, D))
+
+    params = {
+        "embed": {"table": _np(
+            sd[f"{pre}embeddings.word_embeddings.weight"])},
+        "pos_embed": {"table": _np(
+            sd[f"{pre}embeddings.position_embeddings.weight"])},
+        "type_embed": {"table": _np(
+            sd[f"{pre}embeddings.token_type_embeddings.weight"])},
+        "ln_embed": {
+            "scale": _np(sd[f"{pre}embeddings.LayerNorm.weight"]),
+            "bias": _np(sd[f"{pre}embeddings.LayerNorm.bias"])},
+        "blocks": {
+            "attn": {
+                "wq": attn_w("query"), "bq": attn_b("query"),
+                "wk": attn_w("key"), "bk": attn_b("key"),
+                "wv": attn_w("value"), "bv": attn_b("value"),
+                "wo": _stack(sd, Lf + "attention.output.dense.weight",
+                             nl, lambda w: _o_heads(w, H, D, True)),
+                "bo": _stack(sd, Lf + "attention.output.dense.bias", nl),
+            },
+            "ln_attn": {
+                "scale": _stack(
+                    sd, Lf + "attention.output.LayerNorm.weight", nl),
+                "bias": _stack(
+                    sd, Lf + "attention.output.LayerNorm.bias", nl)},
+            "mlp": {
+                "wi": _stack(sd, Lf + "intermediate.dense.weight", nl,
+                             lambda w: w.T),
+                "bi": _stack(sd, Lf + "intermediate.dense.bias", nl),
+                "wo": _stack(sd, Lf + "output.dense.weight", nl,
+                             lambda w: w.T),
+                "bo": _stack(sd, Lf + "output.dense.bias", nl),
+            },
+            "ln_mlp": {
+                "scale": _stack(sd, Lf + "output.LayerNorm.weight", nl),
+                "bias": _stack(sd, Lf + "output.LayerNorm.bias", nl)},
+        },
+    }
+    if cfg.pooler and (f"{pre}pooler.dense.weight" in sd
+                       or "pooler.dense.weight" in sd):
+        pk = f"{pre}pooler.dense.weight" \
+            if f"{pre}pooler.dense.weight" in sd else "pooler.dense.weight"
+        pb = pk.replace(".weight", ".bias")
+        params["pooler"] = {"kernel": _np(sd[pk]).T, "bias": _np(sd[pb])}
+    if dtype is not None:
+        import jax
+        params = jax.tree.map(lambda x: np.asarray(x, dtype), params)
+    logger.info("converted %d HF tensors (bert encoder)", len(sd))
+    return params
+
+
+def load_hf_distilbert(cfg, state_dict: Dict[str, Any],
+                       dtype=None) -> Dict:
+    """DistilBERT state dict → encoder tree (reference container:
+    module_inject/containers/distil_bert.py).  DistilBERT has no segment
+    embeddings and no pooler — build with
+    ``EncoderConfig(type_vocab_size=0, pooler=False)``."""
+    H, D, nl = cfg.num_heads, cfg.head_dim, cfg.num_layers
+    sd = state_dict
+    pre = next((p for p in ("distilbert.", "")
+                if f"{p}embeddings.word_embeddings.weight" in sd),
+               "distilbert.")
+    Lf = pre + "transformer.layer.{}."
+
+    def attn_w(sub):
+        return _stack(sd, Lf + f"attention.{sub}.weight", nl,
+                      lambda w: _qkv_heads(w, H, D, True))
+
+    def attn_b(sub):
+        return _stack(sd, Lf + f"attention.{sub}.bias", nl,
+                      lambda b: b.reshape(H, D))
+
+    params = {
+        "embed": {"table": _np(
+            sd[f"{pre}embeddings.word_embeddings.weight"])},
+        "pos_embed": {"table": _np(
+            sd[f"{pre}embeddings.position_embeddings.weight"])},
+        "ln_embed": {
+            "scale": _np(sd[f"{pre}embeddings.LayerNorm.weight"]),
+            "bias": _np(sd[f"{pre}embeddings.LayerNorm.bias"])},
+        "blocks": {
+            "attn": {
+                "wq": attn_w("q_lin"), "bq": attn_b("q_lin"),
+                "wk": attn_w("k_lin"), "bk": attn_b("k_lin"),
+                "wv": attn_w("v_lin"), "bv": attn_b("v_lin"),
+                "wo": _stack(sd, Lf + "attention.out_lin.weight", nl,
+                             lambda w: _o_heads(w, H, D, True)),
+                "bo": _stack(sd, Lf + "attention.out_lin.bias", nl),
+            },
+            "ln_attn": {
+                "scale": _stack(sd, Lf + "sa_layer_norm.weight", nl),
+                "bias": _stack(sd, Lf + "sa_layer_norm.bias", nl)},
+            "mlp": {
+                "wi": _stack(sd, Lf + "ffn.lin1.weight", nl,
+                             lambda w: w.T),
+                "bi": _stack(sd, Lf + "ffn.lin1.bias", nl),
+                "wo": _stack(sd, Lf + "ffn.lin2.weight", nl,
+                             lambda w: w.T),
+                "bo": _stack(sd, Lf + "ffn.lin2.bias", nl),
+            },
+            "ln_mlp": {
+                "scale": _stack(sd, Lf + "output_layer_norm.weight", nl),
+                "bias": _stack(sd, Lf + "output_layer_norm.bias", nl)},
+        },
+    }
+    if dtype is not None:
+        import jax
+        params = jax.tree.map(lambda x: np.asarray(x, dtype), params)
+    logger.info("converted %d HF tensors (distilbert encoder)", len(sd))
+    return params

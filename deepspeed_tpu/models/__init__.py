@@ -2,7 +2,9 @@ from . import layers
 from .transformer import (Model, TransformerConfig, apply, init_params,
                           cross_entropy_loss, lm_loss_fn, block_apply)
 from .presets import PRESETS, build_config, build_model
+from .encoder import Encoder, EncoderConfig
 
 __all__ = ["layers", "Model", "TransformerConfig", "apply", "init_params",
            "cross_entropy_loss", "lm_loss_fn", "block_apply",
-           "PRESETS", "build_config", "build_model"]
+           "PRESETS", "build_config", "build_model",
+           "Encoder", "EncoderConfig"]

@@ -1,0 +1,238 @@
+"""BERT-class bidirectional encoder (embedding / rerank serving class).
+
+TPU-native analog of the reference's encoder serving support
+(``module_inject/containers/bert.py:13``, ``distil_bert.py`` — policy
+injection into HF BertLayer; here a scan-layout post-LN encoder core of
+its own, because the decoder core in ``models/transformer.py`` is
+pre-LN and causal by construction).
+
+Architecture (BERT): word + position + token-type embeddings → LayerNorm
+→ N × [x = LN(x + Attn(x)); x = LN(x + MLP(x))] (post-LN, bidirectional
+with a padding mask) → optional tanh pooler over [CLS].
+
+Serving is batch-stateless (no KV cache): :meth:`Encoder.encode_batch`
+pads requests into power-of-two sequence buckets so the compiled-program
+count stays O(log max_len), the encoder analog of the decoder engine's
+context buckets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import layers as L
+
+
+@dataclasses.dataclass
+class EncoderConfig:
+    vocab_size: int
+    d_model: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    d_ff: Optional[int] = None            # None => 4*d_model
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    activation: str = "gelu"
+    eps: float = 1e-12                    # BERT's LayerNorm eps
+    pooler: bool = True                   # tanh pooler over [CLS]
+
+    def __post_init__(self):
+        if self.d_ff is None:
+            self.d_ff = 4 * self.d_model
+        assert self.d_model % self.num_heads == 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+
+def init_params(cfg: EncoderConfig, key) -> Tuple[Dict, Dict]:
+    """(params, logical-axis tree) — same axis vocabulary as the decoder
+    core so ``parallel/sharding.py`` TP rules apply unchanged."""
+    dm, H, D, dff = cfg.d_model, cfg.num_heads, cfg.head_dim, cfg.d_ff
+    keys = jax.random.split(key, 8)
+    norm_init = lambda: L.layernorm_init(dm)    # noqa: E731
+
+    params: Dict[str, Any] = {}
+    axes: Dict[str, Any] = {}
+    params["embed"], axes["embed"] = L.embedding_init(
+        keys[0], cfg.vocab_size, dm)
+    params["pos_embed"] = {"table": jax.random.normal(
+        keys[1], (cfg.max_seq_len, dm)) * 0.01}
+    axes["pos_embed"] = {"table": (None, "embed")}
+    if cfg.type_vocab_size > 0:           # distilbert: no segment embeds
+        params["type_embed"] = {"table": jax.random.normal(
+            keys[2], (cfg.type_vocab_size, dm)) * 0.01}
+        axes["type_embed"] = {"table": (None, "embed")}
+    params["ln_embed"], axes["ln_embed"] = norm_init()
+
+    def layer_init(k):
+        k1, k2, k3, k4, k5, k6 = jax.random.split(k, 6)
+        p: Dict[str, Any] = {"attn": {}, "mlp": {}}
+        a: Dict[str, Any] = {"attn": {}, "mlp": {}}
+        ap, aa = p["attn"], a["attn"]
+        ap["wq"] = jax.random.normal(k1, (dm, H, D)) / math.sqrt(dm)
+        aa["wq"] = ("embed", "heads", "head_dim")
+        ap["wk"] = jax.random.normal(k2, (dm, H, D)) / math.sqrt(dm)
+        aa["wk"] = ("embed", "kv_heads", "head_dim")
+        ap["wv"] = jax.random.normal(k3, (dm, H, D)) / math.sqrt(dm)
+        aa["wv"] = ("embed", "kv_heads", "head_dim")
+        ap["wo"] = jax.random.normal(k4, (H, D, dm)) / math.sqrt(dm)
+        aa["wo"] = ("heads", "head_dim", "embed")
+        for n, shp, ax in (("bq", (H, D), ("heads", "head_dim")),
+                           ("bk", (H, D), ("kv_heads", "head_dim")),
+                           ("bv", (H, D), ("kv_heads", "head_dim")),
+                           ("bo", (dm,), ("embed",))):
+            ap[n] = jnp.zeros(shp)
+            aa[n] = ax
+        mp, ma = p["mlp"], a["mlp"]
+        mp["wi"] = jax.random.normal(k5, (dm, dff)) / math.sqrt(dm)
+        ma["wi"] = ("embed", "mlp")
+        mp["bi"] = jnp.zeros((dff,)); ma["bi"] = ("mlp",)
+        mp["wo"] = jax.random.normal(k6, (dff, dm)) / math.sqrt(dff)
+        ma["wo"] = ("mlp", "embed")
+        mp["bo"] = jnp.zeros((dm,)); ma["bo"] = ("embed",)
+        p["ln_attn"], a["ln_attn"] = norm_init()
+        p["ln_mlp"], a["ln_mlp"] = norm_init()
+        return p, a
+
+    lkeys = jax.random.split(keys[3], cfg.num_layers)
+    per = [layer_init(k) for k in lkeys]
+    params["blocks"] = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                    *[p for p, _ in per])
+    axes["blocks"] = per[0][1]
+
+    if cfg.pooler:
+        params["pooler"] = {
+            "kernel": jax.random.normal(keys[4], (dm, dm)) / math.sqrt(dm),
+            "bias": jnp.zeros((dm,))}
+        axes["pooler"] = {"kernel": ("embed", None), "bias": (None,)}
+    return params, axes
+
+
+def encode(cfg: EncoderConfig, params, input_ids,
+           attention_mask=None, token_type_ids=None, dtype=None):
+    """→ last hidden state [B, S, dm] (bidirectional, padding-masked)."""
+    dt = dtype or params["embed"]["table"].dtype
+    B, S = input_ids.shape
+    x = L.embed(params["embed"], input_ids).astype(dt)
+    x = x + params["pos_embed"]["table"][:S].astype(dt)
+    if cfg.type_vocab_size > 0:
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = x + params["type_embed"]["table"][token_type_ids].astype(dt)
+    norm = lambda p, h: L.layernorm(p, h, eps=cfg.eps)   # noqa: E731
+    x = norm(params["ln_embed"], x)
+    act = L.ACTIVATIONS[cfg.activation]
+
+    def body(h, lp):
+        ap = lp["attn"]
+        q = jnp.einsum("bsd,dhk->bshk", h, ap["wq"].astype(dt)) \
+            + ap["bq"].astype(dt)
+        k = jnp.einsum("bsd,dhk->bshk", h, ap["wk"].astype(dt)) \
+            + ap["bk"].astype(dt)
+        v = jnp.einsum("bsd,dhk->bshk", h, ap["wv"].astype(dt)) \
+            + ap["bv"].astype(dt)
+        o = L.causal_attention(q, k, v, mask=attention_mask, causal=False)
+        o = jnp.einsum("bshk,hkd->bsd", o, ap["wo"].astype(dt)) \
+            + ap["bo"].astype(dt)
+        h = norm(lp["ln_attn"], h + o)                   # post-LN
+        mp = lp["mlp"]
+        u = act(h @ mp["wi"].astype(dt) + mp["bi"].astype(dt))
+        d = u @ mp["wo"].astype(dt) + mp["bo"].astype(dt)
+        h = norm(lp["ln_mlp"], h + d)
+        return h, None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    return x
+
+
+def pooled(cfg: EncoderConfig, params, hidden):
+    """BERT pooler: tanh(dense([CLS])) — the sentence embedding."""
+    cls = hidden[:, 0]
+    p = params["pooler"]
+    return jnp.tanh(cls @ p["kernel"].astype(cls.dtype)
+                    + p["bias"].astype(cls.dtype))
+
+
+class Encoder:
+    """Encoder model + bucketed batch serving.
+
+    ``encode_batch`` is the embedding/rerank serving surface: requests
+    pad into power-of-two sequence buckets (one compiled program per
+    bucket), masked mean- or CLS-pooled."""
+
+    def __init__(self, config: EncoderConfig, seed: int = 0,
+                 dtype=jnp.float32):
+        self.config = config
+        self.params, self.param_axes = init_params(
+            config, jax.random.PRNGKey(seed))
+        if dtype != jnp.float32:
+            self.params = jax.tree.map(
+                lambda x: x.astype(dtype)
+                if x.dtype == jnp.float32 else x, self.params)
+        self._fns: Dict[int, Any] = {}
+
+    @classmethod
+    def from_params(cls, config: EncoderConfig, params):
+        """Wrap an existing tree (e.g. ``checkpoint.hf.load_hf_bert``)."""
+        self = cls.__new__(cls)
+        self.config = config
+        self.params = params
+        self.param_axes = None
+        self._fns = {}
+        return self
+
+    def _fn(self, S: int):
+        f = self._fns.get(S)
+        if f is None:
+            cfg = self.config
+
+            def run(params, ids, mask, types):
+                h = encode(cfg, params, ids, attention_mask=mask,
+                           token_type_ids=types)
+                cls_vec = (pooled(cfg, params, h) if cfg.pooler
+                           else h[:, 0])
+                m = mask.astype(h.dtype)[..., None]
+                mean_vec = (h * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+                return h, cls_vec, mean_vec
+
+            f = self._fns[S] = jax.jit(run)
+        return f
+
+    def encode_batch(self, requests: Sequence[Sequence[int]],
+                     token_type_ids: Optional[Sequence[Sequence[int]]]
+                     = None, pool: str = "cls"
+                     ) -> "np.ndarray | List[np.ndarray]":
+        """→ [len(requests), d_model] embeddings (``pool``: "cls" |
+        "mean" | "none" for the full hidden states list)."""
+        assert pool in ("cls", "mean", "none")
+        maxlen = max(len(r) for r in requests)
+        S = 16
+        while S < maxlen:
+            S *= 2
+        S = min(S, self.config.max_seq_len)
+        assert maxlen <= S, (maxlen, self.config.max_seq_len)
+        B = len(requests)
+        ids = np.zeros((B, S), np.int32)
+        mask = np.zeros((B, S), np.int32)
+        types = np.zeros((B, S), np.int32)
+        for i, r in enumerate(requests):
+            ids[i, :len(r)] = r
+            mask[i, :len(r)] = 1
+            if token_type_ids is not None:
+                types[i, :len(token_type_ids[i])] = token_type_ids[i]
+        h, cls_vec, mean_vec = self._fn(S)(
+            self.params, jnp.asarray(ids), jnp.asarray(mask),
+            jnp.asarray(types))
+        if pool == "cls":
+            return np.asarray(cls_vec)
+        if pool == "mean":
+            return np.asarray(mean_vec)
+        return [np.asarray(h[i, :len(r)]) for i, r in enumerate(requests)]

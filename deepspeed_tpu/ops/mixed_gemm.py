@@ -55,6 +55,28 @@ def _mixed_kernel(x_ref, d_ref, s_ref, o_ref, acc_ref):
         o_ref[...] = acc_ref[...].astype(o_ref.dtype)
 
 
+def _tile_plan(x, Kb: int, N: int, block_m: int, block_n: int,
+               block_k: int):
+    """Shared tiling scaffold for the mixed-GEMM kernels: auto block_m
+    (decode bursts are small — pad M up to a lane-friendly multiple),
+    clamp K/N blocks, and reject non-dividing contractions rather than
+    silently pad them.  ``Kb``: the kernel's K-walk extent (K for int8,
+    K/2 packed rows for int4).  Returns (x_padded, M, Mp, block_m, bk,
+    bn)."""
+    M = x.shape[0]
+    if block_m <= 0:
+        block_m = min(128, max(8, 1 << (max(M - 1, 1)).bit_length()))
+    bk = min(block_k, Kb)
+    bn = min(block_n, N)
+    if Kb % bk or N % bn:
+        raise ValueError(f"K-extent={Kb}/N={N} must divide "
+                         f"block_k={bk}/block_n={bn}")
+    Mp = -(-M // block_m) * block_m
+    if Mp != M:
+        x = jnp.pad(x, ((0, Mp - M), (0, 0)))
+    return x, M, Mp, block_m, bk, bn
+
+
 @functools.partial(jax.jit, static_argnames=("block_m", "block_n",
                                              "block_k", "interpret",
                                              "out_dtype"))
@@ -62,27 +84,13 @@ def mixed_matmul_2d(x: jax.Array, data: jax.Array, scale: jax.Array,
                     *, block_m: int = 0, block_n: int = 512,
                     block_k: int = 512, out_dtype=jnp.bfloat16,
                     interpret: bool = False) -> jax.Array:
-    """``x [M, K] @ (int8 data [K, N] * scale [K, 1]) -> [M, N]``.
-
-    M is padded up to a lane-friendly multiple internally (decode bursts
-    are small); K and N must divide by the K/N blocks (serving dims are
-    powers-of-two times 128 — assert rather than silently pad the
-    contraction).
-    """
+    """``x [M, K] @ (int8 data [K, N] * scale [K, 1]) -> [M, N]``."""
     M, K = x.shape
     K2, N = data.shape
     assert K == K2 and scale.shape[0] == K, (x.shape, data.shape,
                                              scale.shape)
-    if block_m <= 0:
-        block_m = min(128, max(8, 1 << (max(M - 1, 1)).bit_length()))
-    bk = min(block_k, K)
-    bn = min(block_n, N)
-    if K % bk or N % bn:
-        raise ValueError(f"K={K}/N={N} must divide block_k={bk}/"
-                         f"block_n={bn}")
-    Mp = -(-M // block_m) * block_m
-    if Mp != M:
-        x = jnp.pad(x, ((0, Mp - M), (0, 0)))
+    x, M, Mp, block_m, bk, bn = _tile_plan(x, K, N, block_m, block_n,
+                                           block_k)
     scale2 = scale.reshape(K, 1)
 
     out = pl.pallas_call(
@@ -144,16 +152,8 @@ def mixed4_matmul_2d(x: jax.Array, data: jax.Array, scale: jax.Array,
     Kh, N = data.shape
     assert K == 2 * Kh and scale.shape[0] == K, (x.shape, data.shape,
                                                  scale.shape)
-    if block_m <= 0:
-        block_m = min(128, max(8, 1 << (max(M - 1, 1)).bit_length()))
-    bk = min(block_k, Kh)
-    bn = min(block_n, N)
-    if Kh % bk or N % bn:
-        raise ValueError(f"K/2={Kh}/N={N} must divide block_k={bk}/"
-                         f"block_n={bn}")
-    Mp = -(-M // block_m) * block_m
-    if Mp != M:
-        x = jnp.pad(x, ((0, Mp - M), (0, 0)))
+    x, M, Mp, block_m, bk, bn = _tile_plan(x, Kh, N, block_m, block_n,
+                                           block_k)
     nk = Kh // bk
     scale2 = scale.reshape(K, 1)
 

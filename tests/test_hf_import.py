@@ -187,3 +187,95 @@ class TestHFParityNewFamilies:
         m = build_model("gpt-neox-tiny", vocab_size=256, num_layers=2,
                         d_model=64, num_heads=4, max_seq_len=64)
         _logits_close(m, hf, IDS)
+
+
+class TestBertEncoder:
+    """BERT-class encoder family (reference containers:
+    module_inject/containers/bert.py:13, distil_bert.py)."""
+
+    def _pair(self):
+        from transformers import BertConfig, BertModel
+        from deepspeed_tpu.models.encoder import Encoder, EncoderConfig
+        hf = BertModel(BertConfig(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=64, type_vocab_size=2,
+            hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0)).eval()
+        cfg = EncoderConfig(vocab_size=256, d_model=64, num_layers=2,
+                            num_heads=4, d_ff=128, max_seq_len=64)
+        from deepspeed_tpu.checkpoint.hf import load_hf_bert
+        params = jax.tree.map(
+            jnp.asarray, load_hf_bert(cfg, hf.state_dict()))
+        return hf, Encoder.from_params(cfg, params)
+
+    def test_hidden_and_pooled_parity(self):
+        hf, enc = self._pair()
+        ids = np.random.RandomState(1).randint(1, 250, (2, 12))
+        mask = np.ones_like(ids)
+        mask[1, 8:] = 0
+        types = np.zeros_like(ids)
+        types[0, 6:] = 1
+        with torch.no_grad():
+            out = hf(torch.tensor(ids), attention_mask=torch.tensor(mask),
+                     token_type_ids=torch.tensor(types))
+        from deepspeed_tpu.models.encoder import encode, pooled
+        h = encode(enc.config, enc.params, jnp.asarray(ids),
+                   attention_mask=jnp.asarray(mask),
+                   token_type_ids=jnp.asarray(types))
+        # padded positions are garbage on both sides; compare live ones
+        got = np.asarray(h)
+        ref = out.last_hidden_state.numpy()
+        np.testing.assert_allclose(got[0], ref[0], atol=2e-3, rtol=1e-3)
+        np.testing.assert_allclose(got[1, :8], ref[1, :8],
+                                   atol=2e-3, rtol=1e-3)
+        np.testing.assert_allclose(
+            np.asarray(pooled(enc.config, enc.params, h)),
+            out.pooler_output.numpy(), atol=2e-3, rtol=1e-3)
+
+    def test_encode_batch_serving(self):
+        """The embedding-serving surface: ragged requests, bucketed
+        padding, CLS/mean pooling."""
+        _, enc = self._pair()
+        reqs = [[5, 17, 99], [3, 1, 4, 1, 5, 9, 2, 6], [42]]
+        embs = enc.encode_batch(reqs, pool="cls")
+        assert embs.shape == (3, 64)
+        means = enc.encode_batch(reqs, pool="mean")
+        assert means.shape == (3, 64)
+        # padding must not leak: same request alone == in a batch
+        solo = enc.encode_batch([reqs[1]], pool="cls")
+        np.testing.assert_allclose(solo[0], embs[1], atol=1e-5)
+
+    def test_fresh_encoder_trains_nothing_but_runs(self):
+        """Random-init Encoder forward runs standalone (no HF)."""
+        from deepspeed_tpu.models import Encoder, EncoderConfig
+        enc = Encoder(EncoderConfig(vocab_size=64, d_model=32,
+                                    num_layers=2, num_heads=2,
+                                    max_seq_len=32))
+        out = enc.encode_batch([[1, 2, 3], [4, 5]], pool="none")
+        assert out[0].shape == (3, 32) and out[1].shape == (2, 32)
+
+    def test_distilbert_parity(self):
+        """DistilBERT: no segment embeddings, no pooler, q_lin naming
+        (reference container: distil_bert.py)."""
+        from transformers import DistilBertConfig, DistilBertModel
+        from deepspeed_tpu.models.encoder import (Encoder, EncoderConfig,
+                                                  encode)
+        from deepspeed_tpu.checkpoint.hf import load_hf_distilbert
+        hf = DistilBertModel(DistilBertConfig(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4,
+            hidden_dim=128, max_position_embeddings=64,
+            dropout=0.0, attention_dropout=0.0)).eval()
+        cfg = EncoderConfig(vocab_size=256, d_model=64, num_layers=2,
+                            num_heads=4, d_ff=128, max_seq_len=64,
+                            type_vocab_size=0, pooler=False)
+        params = jax.tree.map(jnp.asarray,
+                              load_hf_distilbert(cfg, hf.state_dict()))
+        ids = np.random.RandomState(2).randint(1, 250, (2, 10))
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).last_hidden_state.numpy()
+        got = np.asarray(encode(cfg, params, jnp.asarray(ids)))
+        np.testing.assert_allclose(got, ref, atol=2e-3, rtol=1e-3)
+        enc = Encoder.from_params(cfg, params)
+        embs = enc.encode_batch([[5, 3], [9, 8, 7]], pool="mean")
+        assert embs.shape == (2, 64)
