@@ -615,10 +615,14 @@ def load_hf_bert(cfg, state_dict: Dict[str, Any], dtype=None) -> Dict:
                 "bias": _stack(sd, Lf + "output.LayerNorm.bias", nl)},
         },
     }
-    if cfg.pooler and (f"{pre}pooler.dense.weight" in sd
-                       or "pooler.dense.weight" in sd):
-        pk = f"{pre}pooler.dense.weight" \
-            if f"{pre}pooler.dense.weight" in sd else "pooler.dense.weight"
+    if cfg.pooler:
+        pk = next((k for k in (f"{pre}pooler.dense.weight",
+                               "pooler.dense.weight") if k in sd), None)
+        if pk is None:
+            raise KeyError(
+                "cfg.pooler=True but the checkpoint has no pooler "
+                "weights (e.g. BertForMaskedLM / add_pooling_layer="
+                "False); build with EncoderConfig(pooler=False)")
         pb = pk.replace(".weight", ".bias")
         params["pooler"] = {"kernel": _np(sd[pk]).T, "bias": _np(sd[pb])}
     if dtype is not None:

@@ -3,8 +3,11 @@ from .transformer import (Model, TransformerConfig, apply, init_params,
                           cross_entropy_loss, lm_loss_fn, block_apply)
 from .presets import PRESETS, build_config, build_model
 from .encoder import Encoder, EncoderConfig
+from .diffusion import (AutoencoderKL, UNet2DCondition, UNetConfig,
+                        VAEConfig)
 
 __all__ = ["layers", "Model", "TransformerConfig", "apply", "init_params",
            "cross_entropy_loss", "lm_loss_fn", "block_apply",
            "PRESETS", "build_config", "build_model",
-           "Encoder", "EncoderConfig"]
+           "Encoder", "EncoderConfig",
+           "AutoencoderKL", "UNet2DCondition", "UNetConfig", "VAEConfig"]

@@ -1,0 +1,113 @@
+"""Diffusers model family: UNet2DCondition + AutoencoderKL over the
+spatial op suite (reference: model_implementations/diffusers/unet.py:8,
+vae.py:8; containers module_inject/containers/unet.py:13, vae.py:10).
+NHWC (channels-last) conv path throughout — the TPU-native layout."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.diffusion import (AutoencoderKL, UNet2DCondition,
+                                            UNetConfig, VAEConfig)
+
+
+def tiny_unet(**over):
+    kw = dict(block_out_channels=(32, 64, 64), layers_per_block=1,
+              cross_attention_dim=48, attention_head_dim=4, num_groups=8)
+    kw.update(over)
+    return UNet2DCondition(UNetConfig(**kw))
+
+
+class TestUNet:
+    def test_sd_shaped_smoke(self):
+        """SD-1.x structure: 4 stages, 2 res layers/block, cross-attn
+        transformers at the three shallower stages, /8 downsampling —
+        channels reduced for the test box."""
+        unet = tiny_unet(block_out_channels=(32, 64, 128, 128),
+                         layers_per_block=2)
+        lat = jnp.asarray(np.random.RandomState(0).randn(1, 32, 32, 4),
+                          jnp.float32)
+        ctx = jnp.asarray(np.random.RandomState(1).randn(1, 7, 48),
+                          jnp.float32)
+        eps = unet(lat, jnp.asarray([10]), ctx)
+        assert eps.shape == lat.shape
+        assert np.isfinite(np.asarray(eps)).all()
+
+    def test_context_conditions_output(self):
+        unet = tiny_unet()
+        lat = jnp.asarray(np.random.RandomState(0).randn(1, 16, 16, 4),
+                          jnp.float32)
+        r = np.random.RandomState(1)
+        c1 = jnp.asarray(r.randn(1, 5, 48), jnp.float32)
+        c2 = jnp.asarray(r.randn(1, 5, 48), jnp.float32)
+        t = jnp.asarray([50])
+        e1 = unet(lat, t, c1)
+        e2 = unet(lat, t, c2)
+        assert float(jnp.abs(e1 - e2).max()) > 1e-6   # cross-attn is live
+
+    def test_timestep_conditions_output(self):
+        unet = tiny_unet()
+        lat = jnp.asarray(np.random.RandomState(0).randn(1, 16, 16, 4),
+                          jnp.float32)
+        ctx = jnp.asarray(np.random.RandomState(1).randn(1, 5, 48),
+                          jnp.float32)
+        e1 = unet(lat, jnp.asarray([1]), ctx)
+        e2 = unet(lat, jnp.asarray([900]), ctx)
+        assert float(jnp.abs(e1 - e2).max()) > 1e-6
+
+    def test_cfg_denoise_loop(self):
+        """Classifier-free-guidance denoise loop — the serving usage:
+        batched cond+uncond forward, guidance mix, iterative update."""
+        unet = tiny_unet()
+        r = np.random.RandomState(3)
+        lat = jnp.asarray(r.randn(1, 16, 16, 4), jnp.float32)
+        cond = jnp.asarray(r.randn(1, 5, 48), jnp.float32)
+        uncond = jnp.zeros_like(cond)
+        ctx2 = jnp.concatenate([uncond, cond])
+        for t in (800, 500, 200):
+            lat2 = jnp.concatenate([lat, lat])
+            e_un, e_c = jnp.split(
+                unet(lat2, jnp.full((2,), t), ctx2), 2)
+            eps = e_un + 7.5 * (e_c - e_un)
+            lat = lat - 0.1 * eps                 # toy scheduler step
+        assert np.isfinite(np.asarray(lat)).all()
+
+
+class TestVAE:
+    def test_encode_decode_shapes(self):
+        vae = AutoencoderKL(VAEConfig(block_out_channels=(16, 32, 32),
+                                      layers_per_block=1, num_groups=8))
+        img = jnp.asarray(np.random.RandomState(0).randn(1, 32, 32, 3),
+                          jnp.float32)
+        z = vae.encode(img)
+        assert z.shape == (1, 8, 8, 4)            # /4 for 3 stages
+        rec = vae.decode(z)
+        assert rec.shape == img.shape
+
+    def test_sampled_posterior(self):
+        vae = AutoencoderKL(VAEConfig(block_out_channels=(16, 32),
+                                      layers_per_block=1, num_groups=8))
+        img = jnp.asarray(np.random.RandomState(1).randn(1, 16, 16, 3),
+                          jnp.float32)
+        z1 = vae.encode(img, rng=jax.random.PRNGKey(0))
+        z2 = vae.encode(img, rng=jax.random.PRNGKey(1))
+        zm = vae.encode(img)
+        assert float(jnp.abs(z1 - z2).max()) > 0     # stochastic
+        assert float(jnp.abs(z1 - zm).max()) > 0
+
+
+class TestPipelineCompose:
+    def test_vae_unet_latent_pipeline(self):
+        """VAE.encode -> UNet denoise -> VAE.decode — the txt2img data
+        path end-to-end at tiny scale."""
+        vae = AutoencoderKL(VAEConfig(block_out_channels=(16, 32, 32),
+                                      layers_per_block=1, num_groups=8))
+        unet = tiny_unet()
+        img = jnp.asarray(np.random.RandomState(0).randn(1, 32, 32, 3),
+                          jnp.float32)
+        ctx = jnp.asarray(np.random.RandomState(1).randn(1, 5, 48),
+                          jnp.float32)
+        z = vae.encode(img)
+        eps = unet(z, jnp.asarray([100]), ctx)
+        out = vae.decode(z - 0.1 * eps)
+        assert out.shape == img.shape
