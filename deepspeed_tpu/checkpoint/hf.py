@@ -495,6 +495,219 @@ def _convert_bloom(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
     }
 
 
+
+
+def _convert_phi3(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
+    """Phi-3 (reference: inference/v2/model_implementations/phi3/
+    policy.py): llama-ish RMSNorm + gated silu, but the checkpoint fuses
+    qkv_proj [(H+2Hkv)·D, dm] and gate_up_proj [2·ffn, dm]."""
+    H, D, Hkv = cfg.num_heads, cfg.head_dim, cfg.num_kv_heads
+    dm, nl, ffn = cfg.d_model, cfg.num_layers, cfg.d_ff
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    L = pre + "layers.{}."
+
+    def qkv(i):
+        w = _np(sd[L.format(i) + "self_attn.qkv_proj.weight"])
+        q, k, v = np.split(w, [H * D, H * D + Hkv * D])
+        return {"wq": _qkv_heads(q, H, D, True),
+                "wk": _qkv_heads(k, Hkv, D, True),
+                "wv": _qkv_heads(v, Hkv, D, True)}
+
+    def gate_up(i):
+        # one conversion per layer: the fused tensor is the model's
+        # largest (phi3-mini: ~200 MB fp32) — split once
+        w = _np(sd[L.format(i) + "mlp.gate_up_proj.weight"])
+        g, u = np.split(w, 2)
+        return g.T, u.T
+
+    qkvs = [qkv(i) for i in range(nl)]
+    gus = [gate_up(i) for i in range(nl)]
+    params = {
+        "embed": {"table": _np(sd[f"{pre}embed_tokens.weight"])},
+        "blocks": {
+            "attn": {
+                **{k: np.stack([o[k] for o in qkvs])
+                   for k in ("wq", "wk", "wv")},
+                "wo": _stack(sd, L + "self_attn.o_proj.weight", nl,
+                             lambda w: _o_heads(w, H, D, True)),
+            },
+            "mlp": {
+                "wg": np.stack([g for g, _ in gus]),
+                "wi": np.stack([u for _, u in gus]),
+                "wo": _stack(sd, L + "mlp.down_proj.weight", nl,
+                             lambda w: w.T),
+            },
+            "ln1": {"scale": _stack(sd, L + "input_layernorm.weight", nl)},
+            "ln2": {"scale": _stack(
+                sd, L + "post_attention_layernorm.weight", nl)},
+        },
+        "ln_f": {"scale": _np(sd[f"{pre}norm.weight"])},
+    }
+    if "lm_head.weight" in sd and not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": _np(sd["lm_head.weight"]).T}
+    return params
+
+
+def _convert_internlm(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
+    """InternLM (reference container: module_inject/containers/
+    internlm.py): llama tensor layout with q/k/v AND o-projection
+    biases."""
+    H, D, nl = cfg.num_heads, cfg.head_dim, cfg.num_layers
+    params = _convert_llama(cfg, sd)
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    L = pre + "layers.{}."
+    if L.format(0) + "self_attn.o_proj.bias" in sd:
+        params["blocks"]["attn"]["bo"] = _stack(
+            sd, L + "self_attn.o_proj.bias", nl)
+    return params
+
+
+def _convert_gptneo(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
+    """GPT-Neo (reference container: module_inject/containers/
+    gptneo.py): learned positions, separate UNBIASED q/k/v with a biased
+    out projection, and NO attention scaling (cfg.attn_scale=1).  Like
+    the reference's injection kernels, the alternating local-attention
+    layers serve as dense causal attention."""
+    H, D, dm, nl = cfg.num_heads, cfg.head_dim, cfg.d_model, cfg.num_layers
+    pre = "transformer." if any(k.startswith("transformer.")
+                                for k in sd) else ""
+    L = pre + "h.{}."
+    return {
+        "embed": {"table": _np(sd[f"{pre}wte.weight"])},
+        "pos_embed": {"table": _np(sd[f"{pre}wpe.weight"])},
+        "blocks": {
+            "attn": {
+                "wq": _stack(sd, L + "attn.attention.q_proj.weight", nl,
+                             lambda w: _qkv_heads(w, H, D, True)),
+                "wk": _stack(sd, L + "attn.attention.k_proj.weight", nl,
+                             lambda w: _qkv_heads(w, H, D, True)),
+                "wv": _stack(sd, L + "attn.attention.v_proj.weight", nl,
+                             lambda w: _qkv_heads(w, H, D, True)),
+                "wo": _stack(sd, L + "attn.attention.out_proj.weight",
+                             nl, lambda w: _o_heads(w, H, D, True)),
+                "bo": _stack(sd, L + "attn.attention.out_proj.bias", nl),
+            },
+            "mlp": {
+                "wi": _stack(sd, L + "mlp.c_fc.weight", nl,
+                             lambda w: w.T),
+                "bi": _stack(sd, L + "mlp.c_fc.bias", nl),
+                "wo": _stack(sd, L + "mlp.c_proj.weight", nl,
+                             lambda w: w.T),
+                "bo": _stack(sd, L + "mlp.c_proj.bias", nl),
+            },
+            "ln1": {"scale": _stack(sd, L + "ln_1.weight", nl),
+                    "bias": _stack(sd, L + "ln_1.bias", nl)},
+            "ln2": {"scale": _stack(sd, L + "ln_2.weight", nl),
+                    "bias": _stack(sd, L + "ln_2.bias", nl)},
+        },
+        "ln_f": {"scale": _np(sd[f"{pre}ln_f.weight"]),
+                 "bias": _np(sd[f"{pre}ln_f.bias"])},
+    }
+
+
+def _convert_qwen2_moe(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
+    """Qwen2-MoE (reference: inference/v2/model_implementations/
+    qwen_v2_moe/model.py): qwen2 attention (qkv biases, no o bias) +
+    sparse experts with RAW top-k softmax probs (norm_topk_prob=False)
+    + a sigmoid-gated dense shared expert."""
+    params = _convert_llama(cfg, sd, with_mlp=False)
+    nl, E = cfg.num_layers, cfg.num_experts
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    L = pre + "layers.{}."
+
+    def experts(i, name):
+        return np.stack([
+            _np(sd[L.format(i) + f"mlp.experts.{e}.{name}.weight"]).T
+            for e in range(E)])
+
+    params["blocks"]["gate"] = {"kernel": _stack(
+        sd, L + "mlp.gate.weight", nl, lambda w: w.T)}
+    params["blocks"]["experts"] = {
+        "wg": np.stack([experts(i, "gate_proj") for i in range(nl)]),
+        "wi": np.stack([experts(i, "up_proj") for i in range(nl)]),
+        "wo": np.stack([experts(i, "down_proj") for i in range(nl)]),
+    }
+    params["blocks"]["shared"] = {
+        "wg": _stack(sd, L + "mlp.shared_expert.gate_proj.weight", nl,
+                     lambda w: w.T),
+        "wi": _stack(sd, L + "mlp.shared_expert.up_proj.weight", nl,
+                     lambda w: w.T),
+        "wo": _stack(sd, L + "mlp.shared_expert.down_proj.weight", nl,
+                     lambda w: w.T),
+        "gate": _stack(sd, L + "mlp.shared_expert_gate.weight", nl,
+                       lambda w: w.T),
+    }
+    return params
+
+
+def _convert_megatron(cfg: TransformerConfig, sd: Dict[str, Any]) -> Dict:
+    """Megatron-LM GPT checkpoints (reference container:
+    module_inject/containers/megatron_gpt.py + megatron_gpt_moe.py):
+    megatron naming (``language_model.…``/``transformer.layers.N``) with
+    the fused query_key_value stored PER-HEAD INTERLEAVED
+    [H·3·D, dm] — (q_h, k_h, v_h) chunks per head, the layout the
+    reference container's qkv_copy() deinterleaves."""
+    H, D, dm, nl = cfg.num_heads, cfg.head_dim, cfg.d_model, cfg.num_layers
+    emb = next((p for p in
+                ("language_model.embedding.", "embedding.", "")
+                if f"{p}word_embeddings.weight" in sd), None)
+    if emb is None:
+        raise KeyError("not a megatron-lm GPT state dict "
+                       "(no *word_embeddings.weight)")
+    lpre = next((p for p in
+                 ("language_model.transformer.", "transformer.",
+                  "language_model.encoder.", "encoder.")
+                 if f"{p}layers.0.input_layernorm.weight" in sd),
+                "transformer.")
+    L = lpre + "layers.{}."
+
+    def qkv(i):
+        w = _np(sd[L.format(i) + "attention.query_key_value.weight"])
+        b = _np(sd[L.format(i) + "attention.query_key_value.bias"])
+        w = w.reshape(H, 3, D, dm)              # per-head (q,k,v) chunks
+        b = b.reshape(H, 3, D)
+        out = {}
+        for j, (wn, bn) in enumerate((("wq", "bq"), ("wk", "bk"),
+                                      ("wv", "bv"))):
+            out[wn] = np.transpose(w[:, j], (2, 0, 1))      # [dm, H, D]
+            out[bn] = b[:, j]
+        return out
+
+    qkvs = [qkv(i) for i in range(nl)]
+    fl = next((k for k in (lpre + "final_layernorm.weight",
+                           "final_layernorm.weight") if k in sd))
+    return {
+        "embed": {"table": _np(sd[f"{emb}word_embeddings.weight"])},
+        "pos_embed": {"table": _np(
+            sd[f"{emb}position_embeddings.weight"])},
+        "blocks": {
+            "attn": {
+                **{k: np.stack([o[k] for o in qkvs])
+                   for k in ("wq", "wk", "wv", "bq", "bk", "bv")},
+                "wo": _stack(sd, L + "attention.dense.weight", nl,
+                             lambda w: _o_heads(w, H, D, True)),
+                "bo": _stack(sd, L + "attention.dense.bias", nl),
+            },
+            "mlp": {
+                "wi": _stack(sd, L + "mlp.dense_h_to_4h.weight", nl,
+                             lambda w: w.T),
+                "bi": _stack(sd, L + "mlp.dense_h_to_4h.bias", nl),
+                "wo": _stack(sd, L + "mlp.dense_4h_to_h.weight", nl,
+                             lambda w: w.T),
+                "bo": _stack(sd, L + "mlp.dense_4h_to_h.bias", nl),
+            },
+            "ln1": {"scale": _stack(sd, L + "input_layernorm.weight", nl),
+                    "bias": _stack(sd, L + "input_layernorm.bias", nl)},
+            "ln2": {"scale": _stack(
+                        sd, L + "post_attention_layernorm.weight", nl),
+                    "bias": _stack(
+                        sd, L + "post_attention_layernorm.bias", nl)},
+        },
+        "ln_f": {"scale": _np(sd[fl]),
+                 "bias": _np(sd[fl.replace(".weight", ".bias")])},
+    }
+
+
 CONVERTERS: Dict[str, Callable] = {
     "gpt2": _convert_gpt2,
     "llama": _convert_llama,
@@ -507,6 +720,11 @@ CONVERTERS: Dict[str, Callable] = {
     "gptj": _convert_gptj,
     "gpt_neox": _convert_gpt_neox,
     "bloom": _convert_bloom,
+    "phi3": _convert_phi3,
+    "internlm": _convert_internlm,
+    "gpt_neo": _convert_gptneo,
+    "qwen2_moe": _convert_qwen2_moe,
+    "megatron": _convert_megatron,
 }
 
 
@@ -516,8 +734,14 @@ def family_of(name_or_type: str) -> str:
         return "gptj"
     if "neox" in s or "pythia" in s:
         return "gpt_neox"
-    for fam in ("mixtral", "llama", "mistral", "qwen2", "gpt2",
-                "falcon", "phi", "opt", "bloom"):
+    if "gpt-neo" in s or "gpt_neo" in s:
+        return "gpt_neo"
+    if "qwen2_moe" in s or "qwen2-moe" in s:
+        return "qwen2_moe"
+    if "phi3" in s or "phi-3" in s:
+        return "phi3"
+    for fam in ("megatron", "internlm", "mixtral", "llama", "mistral",
+                "qwen2", "gpt2", "falcon", "phi", "opt", "bloom"):
         if fam in s:
             return fam
     raise ValueError(f"no HF converter for {name_or_type!r}; "

@@ -299,14 +299,19 @@ def _qkv_proj(cfg, ap, h, dt, cos, sin, positions):
 def _ffn(cfg, lp, h, dt, act):
     """Shared MLP / MoE branch of a serving layer."""
     if cfg.num_experts > 1:
+        from ..models.transformer import _shared_expert
         from ..parallel import moe as M
 
         d, _ = M.moe_ffn(lp["gate"], lp["experts"], h[None],
                          top_k=cfg.moe_top_k,
                          capacity_factor=cfg.eval_capacity_factor,
                          min_capacity=cfg.min_capacity,
-                         activation=act, gated=cfg.gated_mlp)
-        return d[0]
+                         activation=act, gated=cfg.gated_mlp,
+                         norm_topk=cfg.moe_norm_topk)
+        d = d[0]
+        if "shared" in lp:       # qwen2-moe sigmoid-gated shared expert
+            d = d + _shared_expert(lp["shared"], h, act, cfg.gated_mlp)
+        return d
     mp = lp["mlp"]
     u = _mm(h, mp["wi"], dt)
     if cfg.mlp_bias:
@@ -358,7 +363,8 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
         dt = embed_tab["table"].dtype
     norm = _norm(cfg)
     act = L.ACTIVATIONS[cfg.activation]
-    scale = 1.0 / (cfg.head_dim ** 0.5)
+    scale = (cfg.attn_scale if cfg.attn_scale is not None
+             else 1.0 / (cfg.head_dim ** 0.5))
 
     x = L.embed(embed_tab, batch.token_ids).astype(dt)             # [T, dm]
     if cfg.embed_norm:                  # bloom word_embeddings_layernorm
@@ -482,7 +488,8 @@ def decode_burst_forward(cfg: TransformerConfig, params, prefix,
     rep = H // Hkv
     norm = _norm(cfg)
     act = L.ACTIVATIONS[cfg.activation]
-    scale = 1.0 / (cfg.head_dim ** 0.5)
+    scale = (cfg.attn_scale if cfg.attn_scale is not None
+             else 1.0 / (cfg.head_dim ** 0.5))
     if quant is not None:
         from .quantization import merge_layer
         from ..ops.quant import dequantize_any

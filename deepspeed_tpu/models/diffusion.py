@@ -167,7 +167,10 @@ class UNetConfig:
 
     def heads(self, c: int) -> int:
         n = self.attention_head_dim
-        return n if c % n == 0 else 1
+        assert c % n == 0, (
+            f"stage channels {c} must divide by attention_head_dim={n} "
+            "(SD quirk: that field is the HEAD COUNT)")
+        return n
 
 
 class UNet2DCondition:

@@ -155,6 +155,81 @@ PRESETS: Dict[str, dict] = {
     "opt-125m": dict(vocab_size=50272, num_layers=12, d_model=768,
                      num_heads=12, max_seq_len=2048, activation="relu",
                      norm="layernorm", position="learned"),
+    # --- Phi-3 (llama-ish: rmsnorm + gated silu, fused qkv/gate_up in
+    # the HF checkpoint — reference: inference/v2/model_implementations/
+    # phi3/policy.py) -----------------------------------------------------
+    "phi3-tiny": dict(vocab_size=1024, num_layers=4, d_model=256,
+                      num_heads=8, d_ff=512, max_seq_len=2048,
+                      activation="silu", gated_mlp=True, norm="rmsnorm",
+                      position="rope", tie_embeddings=False,
+                      attn_bias=False, mlp_bias=False, eps=1e-5),
+    "phi3-mini": dict(vocab_size=32064, num_layers=32, d_model=3072,
+                      num_heads=32, d_ff=8192, max_seq_len=4096,
+                      activation="silu", gated_mlp=True, norm="rmsnorm",
+                      position="rope", tie_embeddings=False,
+                      attn_bias=False, mlp_bias=False, eps=1e-5),
+    # --- InternLM (llama layout + q/k/v/o biases — reference:
+    # module_inject/containers/internlm.py) -------------------------------
+    "internlm-tiny": dict(vocab_size=1024, num_layers=4, d_model=256,
+                          num_heads=8, d_ff=688, max_seq_len=2048,
+                          activation="silu", gated_mlp=True,
+                          norm="rmsnorm", position="rope",
+                          tie_embeddings=False, attn_bias=True,
+                          attn_out_bias=True, mlp_bias=False, eps=1e-6),
+    "internlm-7b": dict(vocab_size=103168, num_layers=32, d_model=4096,
+                        num_heads=32, d_ff=11008, max_seq_len=2048,
+                        activation="silu", gated_mlp=True, norm="rmsnorm",
+                        position="rope", tie_embeddings=False,
+                        attn_bias=True, attn_out_bias=True,
+                        mlp_bias=False, eps=1e-6),
+    # --- GPT-Neo (learned positions, UNSCALED attention, no qkv biases —
+    # reference: module_inject/containers/gptneo.py.  Like the reference
+    # injection kernels, the alternating 256-token local-attention
+    # windows serve as dense causal attention) ----------------------------
+    "gpt-neo-tiny": dict(vocab_size=1024, num_layers=4, d_model=256,
+                         num_heads=8, max_seq_len=2048,
+                         activation="gelu_new", norm="layernorm",
+                         position="learned", tie_embeddings=True,
+                         attn_bias=False, attn_out_bias=True,
+                         mlp_bias=True, attn_scale=1.0,
+                         attention_impl="xla"),
+    "gpt-neo-1.3b": dict(vocab_size=50257, num_layers=24, d_model=2048,
+                         num_heads=16, max_seq_len=2048,
+                         activation="gelu_new", norm="layernorm",
+                         position="learned", tie_embeddings=True,
+                         attn_bias=False, attn_out_bias=True,
+                         mlp_bias=True, attn_scale=1.0,
+                         attention_impl="xla"),
+    # --- Qwen2-MoE (sparse experts + sigmoid-gated dense shared expert,
+    # raw softmax top-k probs — reference: inference/v2/
+    # model_implementations/qwen_v2_moe/model.py) -------------------------
+    "qwen2-moe-tiny": dict(vocab_size=1024, num_layers=4, d_model=256,
+                           num_heads=8, num_kv_heads=4, d_ff=352,
+                           max_seq_len=2048, activation="silu",
+                           gated_mlp=True, norm="rmsnorm",
+                           position="rope", rope_theta=1000000.0,
+                           tie_embeddings=False, attn_bias=True,
+                           attn_out_bias=False, mlp_bias=False,
+                           eps=1e-6, num_experts=4, moe_top_k=2,
+                           moe_shared_ff=704, moe_norm_topk=False),
+    "qwen2-moe-a2.7b": dict(vocab_size=151936, num_layers=24,
+                            d_model=2048, num_heads=16, num_kv_heads=16,
+                            d_ff=1408, max_seq_len=8192,
+                            activation="silu", gated_mlp=True,
+                            norm="rmsnorm", position="rope",
+                            rope_theta=1000000.0, tie_embeddings=False,
+                            attn_bias=True, attn_out_bias=False,
+                            mlp_bias=False, eps=1e-6, num_experts=60,
+                            moe_top_k=4, moe_shared_ff=5632,
+                            moe_norm_topk=False),
+    # --- Megatron-GPT (gpt2 architecture, megatron-lm checkpoint naming
+    # with per-head-interleaved fused QKV — reference:
+    # module_inject/containers/megatron_gpt.py) ---------------------------
+    "megatron-gpt2-345m": dict(vocab_size=50304, num_layers=24,
+                               d_model=1024, num_heads=16,
+                               max_seq_len=1024, activation="gelu_new",
+                               norm="layernorm", position="learned",
+                               tie_embeddings=True),
 }
 
 

@@ -71,9 +71,17 @@ class TransformerConfig:
     # for removing per-layer dynamic-update-slice traffic on the scan
     # carries (profiled at ~20% of a GPT-2s step on v5e)
     scan_unroll: int = 1
+    # gpt-neo: attention WITHOUT the 1/sqrt(d) scaling; None = default
+    attn_scale: Optional[float] = None
     # --- MoE (reference: deepspeed/moe; presets: mixtral) ----------------
     num_experts: int = 1                      # >1 => every layer is MoE
     moe_top_k: int = 2
+    # qwen2-moe: a dense "shared expert" MLP of this width runs on every
+    # token, sigmoid-gated, added to the routed output; None disables
+    moe_shared_ff: Optional[int] = None
+    # renormalize kept top-k gate weights to sum 1 (mixtral yes;
+    # qwen2-moe norm_topk_prob=False keeps raw softmax probabilities)
+    moe_norm_topk: bool = True
     capacity_factor: float = 1.25
     eval_capacity_factor: float = 2.0         # inference-time capacity
     min_capacity: int = 4
@@ -134,7 +142,7 @@ REMAT_POLICIES = {
 def init_params(cfg: TransformerConfig, key) -> Tuple[Dict, Dict]:
     """Returns (params, logical_axes).  Per-layer params are stacked on a
     leading 'layers' dimension (scan layout)."""
-    keys = jax.random.split(key, 8)
+    keys = jax.random.split(key, 9)
     H, D, Hkv = cfg.num_heads, cfg.head_dim, cfg.num_kv_heads
     dm, dff, nl = cfg.d_model, cfg.d_ff, cfg.num_layers
     out_scale = 1.0 / math.sqrt(dm) / math.sqrt(2.0 * nl)   # GPT-2 depth scaling
@@ -197,6 +205,25 @@ def init_params(cfg: TransformerConfig, key) -> Tuple[Dict, Dict]:
             lambda k: M.experts_init(k, cfg.num_experts, dm, dff,
                                      gated=cfg.gated_mlp,
                                      out_scale=out_scale), keys[3])
+        if cfg.moe_shared_ff:        # qwen2-moe dense shared expert
+            sff = cfg.moe_shared_ff
+
+            def shared_init(k):
+                k1, k2, k3, k4 = jax.random.split(k, 4)
+                p = {"wi": jax.random.normal(k1, (dm, sff))
+                     / math.sqrt(dm),
+                     "wo": jax.random.normal(k2, (sff, dm)) * out_scale}
+                a = {"wi": ("embed", "mlp"), "wo": ("mlp", "embed")}
+                if cfg.gated_mlp:
+                    p["wg"] = jax.random.normal(k3, (dm, sff)) \
+                        / math.sqrt(dm)
+                    a["wg"] = ("embed", "mlp")
+                p["gate"] = jax.random.normal(k4, (dm, 1)) / math.sqrt(dm)
+                a["gate"] = ("embed", None)
+                return p, a
+
+            blk_p["shared"], blk_a["shared"] = stack_init(
+                shared_init, keys[8])
 
     def mlp_init(k):
         k1, k2, k3 = jax.random.split(k, 3)
@@ -247,6 +274,18 @@ def _norm(cfg):
     return partial(fn, eps=cfg.eps)
 
 
+def _shared_expert(sp, h, act, gated: bool):
+    """qwen2-moe dense shared expert: a full MLP on every token, scaled
+    by a per-token sigmoid gate (reference analog: the qwen_v2_moe v2
+    model implementation's shared_expert path)."""
+    dt = h.dtype
+    u = h @ sp["wi"].astype(dt)
+    u = act(h @ sp["wg"].astype(dt)) * u if "wg" in sp else act(u)
+    d = u @ sp["wo"].astype(dt)
+    g = jax.nn.sigmoid((h @ sp["gate"].astype(dt)).astype(jnp.float32))
+    return d * g.astype(dt)
+
+
 def block_apply(cfg: TransformerConfig, lp, x, cos, sin,
                 mask=None, attention_fn: Callable = L.causal_attention,
                 rng=None, positions=None):
@@ -257,6 +296,11 @@ def block_apply(cfg: TransformerConfig, lp, x, cos, sin,
     norm = _norm(cfg)
     act = L.ACTIVATIONS[cfg.activation]
     ap = lp["attn"]
+    if cfg.attn_scale is not None and attention_fn is L.causal_attention:
+        # safety net for call sites that never resolved attention_fn
+        # (pipeline stage bodies, streamed sweeps): gpt-neo's unscaled
+        # attention must not silently regain the 1/sqrt(d) factor
+        attention_fn = partial(L.causal_attention, scale=cfg.attn_scale)
 
     h = norm(lp["ln1"], x)
     dt = x.dtype
@@ -291,7 +335,10 @@ def block_apply(cfg: TransformerConfig, lp, x, cos, sin,
             capacity_factor=cfg.capacity_factor,
             min_capacity=cfg.min_capacity, activation=act,
             gated=cfg.gated_mlp, rng=rng, noise_policy=cfg.noise_policy,
-            dispatch_mode=cfg.moe_dispatch)
+            dispatch_mode=cfg.moe_dispatch,
+            norm_topk=cfg.moe_norm_topk)
+        if "shared" in lp:       # qwen2-moe sigmoid-gated shared expert
+            d = d + _shared_expert(lp["shared"], h, act, cfg.gated_mlp)
     else:
         mp = lp["mlp"]
         u = h @ mp["wi"].astype(dt)
@@ -469,20 +516,33 @@ def lm_loss_fn(cfg: TransformerConfig,
 def _resolve_attention(cfg: TransformerConfig) -> Callable:
     """attention_impl -> callable; ALiBi wraps the eager attention with
     the per-head bias (the flash kernels have no bias operand)."""
+    if cfg.attn_scale is not None and cfg.attention_impl in (
+            "flash", "xla_flash"):
+        raise ValueError(
+            "attn_scale needs the eager attention (attention_impl="
+            "'xla'): the flash kernels bake in 1/sqrt(d)")
     if cfg.position == "alibi":
         if cfg.attention_impl in ("flash", "xla_flash"):
             raise ValueError(
                 "position='alibi' needs the eager attention "
                 "(attention_impl='xla'): the flash kernels carry no "
                 "additive-bias operand")
-        return L.make_alibi_attention()
-    if cfg.attention_impl == "flash":
+        fn = L.make_alibi_attention()
+    elif cfg.attention_impl == "flash":
         from ..ops.flash_attention import flash_attention
         return flash_attention
-    if cfg.attention_impl == "xla_flash":
+    elif cfg.attention_impl == "xla_flash":
         from ..ops.xla_attention import fused_attention
         return fused_attention
-    return L.causal_attention
+    else:
+        fn = L.causal_attention
+    if cfg.attn_scale is not None:
+        base = fn
+        s = cfg.attn_scale
+
+        def fn(q, k, v, mask=None, **kw):        # gpt-neo: no 1/sqrt(d)
+            return base(q, k, v, mask=mask, scale=s, **kw)
+    return fn
 
 
 class Model:

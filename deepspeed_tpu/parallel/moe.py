@@ -39,7 +39,8 @@ class GateOutput(NamedTuple):
 
 def top_k_gating(logits: jnp.ndarray, top_k: int, capacity: int,
                  rng: Optional[jax.Array] = None,
-                 noise_policy: Optional[str] = None) -> GateOutput:
+                 noise_policy: Optional[str] = None,
+                 norm_topk: bool = True) -> GateOutput:
     """logits: [T, E].  (reference: top1gating/top2gating/topkgating
     sharded_moe.py:183,290,449)."""
     T, E = logits.shape
@@ -83,10 +84,11 @@ def top_k_gating(logits: jnp.ndarray, top_k: int, capacity: int,
 
     dispatch = sum(dispatch_parts)
     combine = sum(combine_parts)
-    if top_k > 1:
+    if top_k > 1 and norm_topk:
         # renormalize kept gate weights to sum 1 per token (reference: top2
         # normalization sharded_moe.py:290; top-1 keeps the raw probability
-        # as in Switch / reference top1gating)
+        # as in Switch / reference top1gating; qwen2-moe's
+        # norm_topk_prob=False keeps the raw softmax probabilities)
         denom = combine.sum(axis=(1, 2), keepdims=True)
         combine = combine / jnp.maximum(denom, 1e-9)
     dropped = 1.0 - kept_any.mean()
@@ -108,8 +110,8 @@ class SparseGateOutput(NamedTuple):
 
 def top_k_gating_sparse(logits: jnp.ndarray, top_k: int, capacity: int,
                         rng: Optional[jax.Array] = None,
-                        noise_policy: Optional[str] = None
-                        ) -> SparseGateOutput:
+                        noise_policy: Optional[str] = None,
+                        norm_topk: bool = True) -> SparseGateOutput:
     """Same selection/capacity/renormalization math as
     :func:`top_k_gating`, returning indices instead of one-hot masks —
     dispatch/combine become gather/scatter (O(T·K·d)) instead of
@@ -155,7 +157,7 @@ def top_k_gating_sparse(logits: jnp.ndarray, top_k: int, capacity: int,
         kept_any = jnp.maximum(kept_any, kept_t)
 
     vals = jnp.stack(val_list, axis=1)                            # [T, K]
-    if top_k > 1:
+    if top_k > 1 and norm_topk:
         vals = vals / jnp.maximum(vals.sum(axis=1, keepdims=True), 1e-9)
     return SparseGateOutput(
         ids=jnp.stack(ids, axis=1), pos=jnp.stack(pos_list, axis=1),
@@ -210,6 +212,7 @@ def gate_init(key, d_model: int, num_experts: int):
 
 
 def _ragged_moe(expert_p, x, logits, *, top_k: int, activation, gated: bool,
+                norm_topk: bool = True,
                 noise_policy: Optional[str], rng: Optional[jax.Array],
                 dt) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """DROPLESS grouped-GEMM MoE (``dispatch_mode="ragged"``): tokens
@@ -241,7 +244,7 @@ def _ragged_moe(expert_p, x, logits, *, top_k: int, activation, gated: bool,
                                                       dtype=jnp.float32))
     ids = jnp.stack(ids, axis=1)                                  # [T, K]
     vals = jnp.stack(vals, axis=1)                                # [T, K]
-    if top_k > 1:
+    if top_k > 1 and norm_topk:
         # renormalize to sum 1 per token — same convention as
         # top_k_gating (reference top2 normalization sharded_moe.py:290)
         vals = vals / jnp.maximum(vals.sum(axis=1, keepdims=True), 1e-9)
@@ -274,7 +277,8 @@ def moe_ffn(gate_p, expert_p, x, *, top_k: int, capacity_factor: float,
             min_capacity: int = 4, activation=jax.nn.gelu,
             gated: bool = False, rng: Optional[jax.Array] = None,
             noise_policy: Optional[str] = None,
-            dispatch_mode: str = "scatter"
+            dispatch_mode: str = "scatter",
+            norm_topk: bool = True
             ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Full MoE FFN over x [B, S, d_model] (reference: MOELayer.forward
     sharded_moe.py:533).  Returns (y, metrics) with metrics carrying the
@@ -311,12 +315,14 @@ def moe_ffn(gate_p, expert_p, x, *, top_k: int, capacity_factor: float,
     if dispatch_mode == "ragged":
         return _ragged_moe(expert_p, x, logits, top_k=top_k,
                            activation=activation, gated=gated,
-                           noise_policy=noise_policy, rng=rng, dt=dt)
+                           noise_policy=noise_policy, rng=rng, dt=dt,
+                           norm_topk=norm_topk)
     rngs = jax.random.split(rng, B) if rng is not None else None
 
     gate_fn = functools.partial(
         top_k_gating_sparse if dispatch_mode == "scatter" else top_k_gating,
-        top_k=top_k, capacity=cap, noise_policy=noise_policy)
+        top_k=top_k, capacity=cap, noise_policy=noise_policy,
+        norm_topk=norm_topk)
     if rngs is None:
         gate = jax.vmap(lambda l: gate_fn(l, rng=None))(logits)
     else:

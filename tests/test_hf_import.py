@@ -279,3 +279,127 @@ class TestBertEncoder:
         enc = Encoder.from_params(cfg, params)
         embs = enc.encode_batch([[5, 3], [9, 8, 7]], pool="mean")
         assert embs.shape == (2, 64)
+
+
+class TestNewFamilies:
+    """Round-5 serving families (reference: phi3/policy.py,
+    qwen_v2_moe/model.py, containers/internlm.py, containers/gptneo.py,
+    containers/megatron_gpt.py)."""
+
+    def test_phi3_fused_qkv_gateup(self):
+        from transformers import Phi3Config, Phi3ForCausalLM
+        hf = Phi3ForCausalLM(Phi3Config(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=4, max_position_embeddings=64,
+            rope_theta=10000.0, attention_dropout=0.0,
+            resid_pdrop=0.0, embd_pdrop=0.0, rms_norm_eps=1e-5,
+            pad_token_id=0)).eval()
+        m = build_model("phi3-tiny", vocab_size=256, num_layers=2,
+                        d_model=64, num_heads=4, d_ff=128, max_seq_len=64)
+        _logits_close(m, hf, IDS)
+
+    def test_internlm_biased_llama(self):
+        """InternLM-1 = llama layout + q/k/v/o biases (HF expresses it
+        as LlamaConfig(attention_bias=True))."""
+        from transformers import LlamaConfig, LlamaForCausalLM
+        hf = LlamaForCausalLM(LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=4, max_position_embeddings=64,
+            attention_bias=True, attention_dropout=0.0,
+            rms_norm_eps=1e-6)).eval()
+        m = build_model("internlm-tiny", vocab_size=256, num_layers=2,
+                        d_model=64, num_heads=4, d_ff=128, max_seq_len=64)
+        params = load_hf_state_dict(m.config, hf.state_dict(),
+                                    family="internlm",
+                                    reference_params=m.params)
+        with torch.no_grad():
+            ref = hf(torch.tensor(IDS)).logits.float().numpy()
+        got = np.asarray(m.apply(jax.tree.map(jnp.asarray, params),
+                                 jnp.asarray(IDS), dtype=jnp.float32))
+        np.testing.assert_allclose(got, ref, atol=2e-3, rtol=1e-3)
+
+    def test_gpt_neo_unscaled_attention(self):
+        from transformers import GPTNeoConfig, GPTNeoForCausalLM
+        hf = GPTNeoForCausalLM(GPTNeoConfig(
+            vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+            max_position_embeddings=64, intermediate_size=256,
+            attention_types=[[["global", "local"], 1]], window_size=256,
+            attention_dropout=0.0, embed_dropout=0.0,
+            resid_dropout=0.0)).eval()
+        m = build_model("gpt-neo-tiny", vocab_size=256, num_layers=2,
+                        d_model=64, num_heads=4, max_seq_len=64)
+        assert m.config.attn_scale == 1.0
+        _logits_close(m, hf, IDS)
+
+    def test_qwen2_moe_shared_expert(self):
+        from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM
+        torch.manual_seed(0)    # near-tie routing is seed-sensitive
+        hf = Qwen2MoeForCausalLM(Qwen2MoeConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            moe_intermediate_size=96, shared_expert_intermediate_size=160,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, num_experts=4, num_experts_per_tok=2,
+            norm_topk_prob=False, decoder_sparse_step=1,
+            max_position_embeddings=64, rope_theta=10000.0,
+            attention_dropout=0.0, rms_norm_eps=1e-6,
+            output_router_logits=False)).eval()
+        m = build_model("qwen2-moe-tiny", vocab_size=256, num_layers=2,
+                        d_model=64, num_heads=4, num_kv_heads=2,
+                        d_ff=96, moe_shared_ff=160, max_seq_len=64,
+                        num_experts=4, moe_top_k=2,
+                        capacity_factor=4.0)     # dropless at test scale
+        # routed-expert accumulation order differs from torch's dense
+        # loop — tolerance covers f32 round-off, not routing flips
+        _logits_close(m, hf, IDS, atol=8e-3)
+
+    def test_megatron_interleaved_qkv_roundtrip(self):
+        """No transformers class for raw megatron-lm checkpoints: pack a
+        known core model INTO megatron naming (per-head interleaved
+        fused QKV), convert back, and require identical logits."""
+        from deepspeed_tpu.checkpoint.hf import load_hf_state_dict
+        m = build_model("megatron-gpt2-345m", vocab_size=256,
+                        num_layers=2, d_model=64, num_heads=4,
+                        max_seq_len=64)
+        p = jax.tree.map(np.asarray, m.params)
+        H, D, dm = 4, 16, 64
+        sd = {"language_model.embedding.word_embeddings.weight":
+              p["embed"]["table"],
+              "language_model.embedding.position_embeddings.weight":
+              p["pos_embed"]["table"],
+              "language_model.transformer.final_layernorm.weight":
+              p["ln_f"]["scale"],
+              "language_model.transformer.final_layernorm.bias":
+              p["ln_f"]["bias"]}
+        for i in range(2):
+            a = {k: v[i] for k, v in p["blocks"]["attn"].items()}
+            # [dm,H,D] -> per-head interleaved [H,3,D,dm] -> [3HD, dm]
+            w = np.stack([np.transpose(a["wq"], (1, 2, 0)),
+                          np.transpose(a["wk"], (1, 2, 0)),
+                          np.transpose(a["wv"], (1, 2, 0))], axis=1)
+            b = np.stack([a["bq"], a["bk"], a["bv"]], axis=1)
+            Lp = f"language_model.transformer.layers.{i}."
+            sd[Lp + "attention.query_key_value.weight"] = \
+                w.reshape(H * 3 * D, dm)
+            sd[Lp + "attention.query_key_value.bias"] = \
+                b.reshape(H * 3 * D)
+            sd[Lp + "attention.dense.weight"] = \
+                a["wo"].reshape(H * D, dm).T
+            sd[Lp + "attention.dense.bias"] = a["bo"]
+            mlp = {k: v[i] for k, v in p["blocks"]["mlp"].items()}
+            sd[Lp + "mlp.dense_h_to_4h.weight"] = mlp["wi"].T
+            sd[Lp + "mlp.dense_h_to_4h.bias"] = mlp["bi"]
+            sd[Lp + "mlp.dense_4h_to_h.weight"] = mlp["wo"].T
+            sd[Lp + "mlp.dense_4h_to_h.bias"] = mlp["bo"]
+            for ln, nm in (("ln1", "input_layernorm"),
+                           ("ln2", "post_attention_layernorm")):
+                sd[Lp + nm + ".weight"] = p["blocks"][ln]["scale"][i]
+                sd[Lp + nm + ".bias"] = p["blocks"][ln]["bias"][i]
+        params = load_hf_state_dict(m.config, sd, family="megatron",
+                                    reference_params=m.params)
+        got = np.asarray(m.apply(jax.tree.map(jnp.asarray, params),
+                                 jnp.asarray(IDS), dtype=jnp.float32))
+        ref = np.asarray(m.apply(m.params, jnp.asarray(IDS),
+                                 dtype=jnp.float32))
+        np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)

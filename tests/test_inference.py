@@ -393,6 +393,17 @@ class TestNewFamilyServing:
                            num_heads=4, max_seq_len=64)),
         ("gpt-neox-tiny", dict(vocab_size=128, num_layers=2, d_model=64,
                                num_heads=4, max_seq_len=64)),
+        ("phi3-tiny", dict(vocab_size=128, num_layers=2, d_model=64,
+                           num_heads=4, d_ff=128, max_seq_len=64)),
+        ("internlm-tiny", dict(vocab_size=128, num_layers=2, d_model=64,
+                               num_heads=4, d_ff=128, max_seq_len=64)),
+        ("gpt-neo-tiny", dict(vocab_size=128, num_layers=2, d_model=64,
+                              num_heads=4, max_seq_len=64)),
+        ("qwen2-moe-tiny", dict(vocab_size=128, num_layers=2, d_model=64,
+                                num_heads=4, num_kv_heads=2, d_ff=96,
+                                moe_shared_ff=160, num_experts=4,
+                                max_seq_len=64, capacity_factor=4.0,
+                                eval_capacity_factor=4.0)),
     ])
     def test_greedy_matches_full_forward(self, preset, over):
         m = build_model(preset, **over)
