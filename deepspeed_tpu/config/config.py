@@ -462,6 +462,10 @@ class AioConfig(ConfigModel):
     # page-cache bypass for 4096-aligned spans (falls back silently on
     # filesystems without O_DIRECT, e.g. tmpfs)
     use_odirect: bool = False
+    # "auto" | "uring" | "threads": io_uring submission (real kernel
+    # queue depth + registered O_DIRECT buffers — the libaio analog) vs
+    # the pread/pwrite worker pool; auto probes io_uring_setup once
+    backend: str = "auto"
 
 
 @dataclass

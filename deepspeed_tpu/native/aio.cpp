@@ -49,9 +49,60 @@
 #include <unistd.h>
 #include <vector>
 
+#include "uring.h"
+
 namespace {
 
 constexpr long kAlign = 4096;
+
+// backend-agnostic surface the C ABI dispatches through
+class Engine {
+public:
+  virtual ~Engine() = default;
+  virtual void submit(const char *path, char *buf, long nbytes, long offset,
+                      bool write, bool trunc = false) = 0;
+  virtual int wait() = 0;
+  virtual int pending() const = 0;
+  virtual long odirect_ops() const = 0;
+  virtual long tasks_total() const = 0;
+  virtual int backend() const = 0;  // 0 = thread pool, 1 = io_uring
+};
+
+struct Chunk {
+  long off;
+  long len;
+  bool direct;
+};
+
+// split the file span [offset, offset+nbytes) into an unaligned head,
+// an aligned O_DIRECT-eligible body (chunked by block_size) and an
+// unaligned tail — shared by both engines
+inline std::vector<Chunk> plan_chunks(long offset, long nbytes,
+                                      long block_size, bool single_submit,
+                                      bool have_direct) {
+  std::vector<Chunk> out;
+  long end = offset + nbytes;
+  if (single_submit) {
+    if (nbytes > 0) out.push_back({offset, nbytes, false});
+    return out;
+  }
+  long body_lo = offset, body_hi = end;
+  if (have_direct) {
+    body_lo = (offset + kAlign - 1) / kAlign * kAlign;
+    body_hi = end / kAlign * kAlign;
+    if (body_hi <= body_lo) { body_lo = body_hi = offset; }
+  } else {
+    for (long done = 0; done < nbytes; done += block_size)
+      out.push_back({offset + done, std::min(block_size, nbytes - done),
+                     false});
+    return out;
+  }
+  if (body_lo > offset) out.push_back({offset, body_lo - offset, false});
+  for (long off = body_lo; off < body_hi; off += block_size)
+    out.push_back({off, std::min(block_size, body_hi - off), true});
+  if (end > body_hi) out.push_back({body_hi, end - body_hi, false});
+  return out;
+}
 
 // One submitted read/write; owns the fds for all its chunks.
 struct Request {
@@ -75,7 +126,30 @@ struct Task {
   bool direct;  // aligned span eligible for the O_DIRECT fd
 };
 
-class AioPool {
+// open the buffered (and optionally O_DIRECT) fds for one request and
+// apply the trunc-for-full-rewrite policy — shared by both engines
+inline std::shared_ptr<Request> make_request(
+    const char *path, long nbytes, long offset, bool write, bool trunc,
+    bool want_direct, std::atomic<int> &errors) {
+  int flags = write ? (O_WRONLY | O_CREAT) : O_RDONLY;
+  auto req = std::make_shared<Request>();
+  req->fd = open(path, flags, 0644);
+  if (req->fd < 0) {
+    errors.fetch_add(1);
+    return nullptr;
+  }
+  if (want_direct)
+    req->fd_direct = open(path, flags | O_DIRECT, 0644);  // may fail: ok
+  // opt-in for full-file rewrites: a smaller rewrite must not leave a
+  // stale tail from a previous, larger request
+  if (write && trunc) {
+    if (ftruncate(req->fd, offset + nbytes) != 0) errors.fetch_add(1);
+  }
+  return req;
+}
+
+
+class AioPool : public Engine {
 public:
   AioPool(int num_threads, long block_size, int queue_depth,
           int single_submit, int overlap_events, int use_odirect)
@@ -104,60 +178,25 @@ public:
   }
 
   void submit(const char *path, char *buf, long nbytes, long offset,
-              bool write, bool trunc = false) {
-    int flags = write ? (O_WRONLY | O_CREAT) : O_RDONLY;
-    auto req = std::make_shared<Request>();
-    req->fd = open(path, flags, 0644);
-    if (req->fd < 0) {
-      errors_.fetch_add(1);
-      return;
-    }
+              bool write, bool trunc = false) override {
     // single_submit runs each request as ONE buffered op (no chunking);
     // opening a direct fd it can never use would waste a syscall pair
-    if (use_odirect_ && !single_submit_)
-      req->fd_direct = open(path, flags | O_DIRECT, 0644);  // may fail: ok
-    // opt-in for full-file rewrites: a smaller rewrite must not leave a
-    // stale tail from a previous, larger request (a reader trusting file
-    // size would see old data).  Never implicit — partial-write users of
-    // the public handle rely on surrounding bytes surviving.
-    if (write && trunc) {
-      if (ftruncate(req->fd, offset + nbytes) != 0) errors_.fetch_add(1);
-    }
-    long end = offset + nbytes;
-    // the file span [offset, end) splits into an unaligned head, an
-    // aligned body (O_DIRECT-eligible, chunked), and an unaligned tail
-    long body_lo = offset, body_hi = end;
-    if (req->fd_direct >= 0) {
-      body_lo = (offset + kAlign - 1) / kAlign * kAlign;
-      body_hi = end / kAlign * kAlign;
-      if (body_hi <= body_lo) { body_lo = body_hi = offset; }
-    }
+    auto req = make_request(path, nbytes, offset, write, trunc,
+                            use_odirect_ && !single_submit_, errors_);
+    if (!req) return;
+    auto chunks = plan_chunks(offset, nbytes, block_size_, single_submit_,
+                              req->fd_direct >= 0);
     std::unique_lock<std::mutex> lk(mu_);
-    auto push = [&](long off, long len, bool direct) {
-      if (len <= 0) return;
+    for (const auto &c : chunks) {
       // queue_depth backpressure (libaio iodepth analog)
       space_cv_.wait(lk, [this] {
         return (long)queue_.size() < queue_depth_;
       });
       queue_.push_back(
-          Task{req, buf + (off - offset), len, off, write, direct});
+          Task{req, buf + (c.off - offset), c.len, c.off, write, c.direct});
       pending_.fetch_add(1);
       tasks_total_.fetch_add(1);
       cv_.notify_one();
-    };
-    if (single_submit_ || req->fd_direct < 0) {
-      // one op per request (single_submit) / plain chunking (no direct)
-      if (single_submit_) {
-        push(offset, nbytes, false);
-      } else {
-        for (long done = 0; done < nbytes; done += block_size_)
-          push(offset + done, std::min(block_size_, nbytes - done), false);
-      }
-    } else {
-      push(offset, body_lo - offset, false);            // head
-      for (long off = body_lo; off < body_hi; off += block_size_)
-        push(off, std::min(block_size_, body_hi - off), true);
-      push(body_hi, end - body_hi, false);              // tail
     }
     lk.unlock();
     if (!overlap_events_) {
@@ -166,15 +205,16 @@ public:
     }
   }
 
-  int wait() {
+  int wait() override {
     std::unique_lock<std::mutex> lk(done_mu_);
     done_cv_.wait(lk, [this] { return pending_.load() == 0; });
     return errors_.exchange(0);
   }
 
-  int pending() const { return pending_.load(); }
-  long odirect_ops() const { return odirect_ops_.load(); }
-  long tasks_total() const { return tasks_total_.load(); }
+  int pending() const override { return pending_.load(); }
+  long odirect_ops() const override { return odirect_ops_.load(); }
+  long tasks_total() const override { return tasks_total_.load(); }
+  int backend() const override { return 0; }
 
 private:
   void worker() {
@@ -270,6 +310,261 @@ private:
   std::atomic<long> tasks_total_;
 };
 
+
+
+// ---------------------------------------------------------------------
+// io_uring engine: real kernel queue depth, registered bounce buffers
+// (see uring.h for the design notes)
+// ---------------------------------------------------------------------
+class UringEngine : public Engine {
+public:
+  UringEngine(long block_size, int queue_depth, int single_submit,
+              int overlap_events, int use_odirect, bool *ok)
+      : block_size_(block_size), single_submit_(single_submit != 0),
+        overlap_events_(overlap_events != 0), use_odirect_(use_odirect != 0),
+        stop_(false), pending_(0), errors_(0), odirect_ops_(0),
+        tasks_total_(0) {
+    if (block_size_ < 1) block_size_ = 1 << 20;
+    if (use_odirect_ && block_size_ % kAlign)
+      block_size_ = ((block_size_ / kAlign) + 1) * kAlign;
+    if (queue_depth < 2) queue_depth = 2;
+    if (queue_depth > 1024) queue_depth = 1024;
+    *ok = ring_.init((unsigned)queue_depth);
+    if (!*ok) return;
+    depth_ = ring_.entries;
+    ops_.resize(depth_);
+    for (unsigned i = 0; i < depth_; ++i) free_slots_.push_back((int)i);
+    if (use_odirect_) {
+      // one pinned aligned buffer per ring slot, registered once — the
+      // fixed-buffer pool O_DIRECT chunks do zero-copy kernel DMA into
+      bounce_.resize(depth_, nullptr);
+      std::vector<struct iovec> iov(depth_);
+      bool all = true;
+      for (unsigned i = 0; i < depth_; ++i) {
+        if (posix_memalign(reinterpret_cast<void **>(&bounce_[i]), kAlign,
+                           block_size_))
+          bounce_[i] = nullptr;
+        all = all && bounce_[i];
+        iov[i].iov_base = bounce_[i];
+        iov[i].iov_len = (size_t)block_size_;
+      }
+      registered_ =
+          all && uring::sys_register(ring_.fd, IORING_REGISTER_BUFFERS,
+                                     iov.data(), depth_) == 0;
+      if (!registered_) use_odirect_ = false;
+    }
+    reaper_ = std::thread([this] { reap(); });
+  }
+
+  ~UringEngine() override {
+    // drain in-flight I/O first: the kernel may still be DMA-ing into
+    // the registered bounce buffers and the caller's memory (the thread
+    // pool likewise completes its queue before destruction)
+    if (reaper_.joinable() && !dead_.load()) wait();
+    if (reaper_.joinable()) {
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        stop_ = true;
+        struct io_uring_sqe sqe;
+        std::memset(&sqe, 0, sizeof(sqe));
+        sqe.opcode = IORING_OP_NOP;
+        sqe.user_data = ~0ull;           // stop sentinel
+        while (!ring_.push(sqe))
+          uring::sys_enter(ring_.fd, 0, 1, IORING_ENTER_GETEVENTS);
+        uring::sys_enter(ring_.fd, 1, 0, 0);
+      }
+      reaper_.join();
+    }
+    for (char *b : bounce_) free(b);
+  }
+
+  void submit(const char *path, char *buf, long nbytes, long offset,
+              bool write, bool trunc = false) override {
+    if (dead_.load()) {        // ring failed fatally: fail fast, no hang
+      errors_.fetch_add(1);
+      return;
+    }
+    auto req = make_request(path, nbytes, offset, write, trunc,
+                            use_odirect_ && !single_submit_, errors_);
+    if (!req) return;
+    auto chunks = plan_chunks(offset, nbytes, block_size_, single_submit_,
+                              req->fd_direct >= 0);
+    for (const auto &c : chunks) {
+      std::unique_lock<std::mutex> lk(mu_);
+      slot_cv_.wait(lk, [this] { return !free_slots_.empty(); });
+      int slot = free_slots_.back();
+      free_slots_.pop_back();
+      UOp &op = ops_[slot];
+      op.req = req;
+      op.user = buf + (c.off - offset);
+      op.len = c.len;
+      op.off = c.off;
+      op.done = 0;
+      op.write = write;
+      op.direct = c.direct && registered_ && c.len <= block_size_;
+      pending_.fetch_add(1);
+      tasks_total_.fetch_add(1);
+      if (op.direct && write) std::memcpy(bounce_[slot], op.user, op.len);
+      push_locked(slot);
+    }
+    if (!overlap_events_) {
+      std::unique_lock<std::mutex> dlk(done_mu_);
+      done_cv_.wait(dlk, [this] { return pending_.load() == 0; });
+    }
+  }
+
+  int wait() override {
+    std::unique_lock<std::mutex> lk(done_mu_);
+    done_cv_.wait(lk, [this] { return pending_.load() == 0; });
+    return errors_.exchange(0);
+  }
+
+  int pending() const override { return pending_.load(); }
+  long odirect_ops() const override { return odirect_ops_.load(); }
+  long tasks_total() const override { return tasks_total_.load(); }
+  int backend() const override { return 1; }
+
+private:
+  struct UOp {
+    std::shared_ptr<Request> req;
+    char *user = nullptr;
+    long len = 0, off = 0, done = 0;
+    bool write = false;
+    bool direct = false;
+  };
+
+  // fill + submit the SQE for ops_[slot]'s remaining span (mu_ held)
+  void push_locked(int slot) {
+    UOp &op = ops_[slot];
+    struct io_uring_sqe sqe;
+    std::memset(&sqe, 0, sizeof(sqe));
+    if (op.direct) {
+      sqe.opcode = op.write ? IORING_OP_WRITE_FIXED : IORING_OP_READ_FIXED;
+      sqe.fd = op.req->fd_direct;
+      sqe.addr = (unsigned long long)(bounce_[slot] + op.done);
+      sqe.buf_index = (unsigned short)slot;
+    } else {
+      sqe.opcode = op.write ? IORING_OP_WRITE : IORING_OP_READ;
+      sqe.fd = op.req->fd;
+      sqe.addr = (unsigned long long)(op.user + op.done);
+    }
+    long remaining = op.len - op.done;
+    if (remaining > (1L << 30)) remaining = 1L << 30;  // sqe.len is u32
+    sqe.len = (unsigned)remaining;
+    sqe.off = (unsigned long long)(op.off + op.done);
+    sqe.user_data = (unsigned long long)slot;
+    while (!ring_.push(sqe))   // SQ can lag CQ reaping under bursts
+      uring::sys_enter(ring_.fd, 0, 1, IORING_ENTER_GETEVENTS);
+    for (int tries = 0;; ++tries) {
+      int r = uring::sys_enter(ring_.fd, 1, 0, 0);
+      if (r >= 0) return;
+      if ((errno == EINTR || errno == EAGAIN || errno == EBUSY) &&
+          tries < 1000) {
+        uring::sys_enter(ring_.fd, 0, 1, IORING_ENTER_GETEVENTS);
+        continue;
+      }
+      // fatal: the SQE may or may not ever be consumed — poison the
+      // engine so no slot is ever reused against a ghost completion
+      dead_.store(true);
+      retire_locked(slot, true);
+      return;
+    }
+  }
+
+  void retire_locked(int slot, bool error) {
+    UOp &op = ops_[slot];
+    if (error) errors_.fetch_add(1);
+    if (!error && op.direct) {
+      if (!op.write) std::memcpy(op.user, bounce_[slot], op.len);
+      odirect_ops_.fetch_add(1);
+    }
+    op.req.reset();            // close fds when the last chunk retires
+    free_slots_.push_back(slot);
+    slot_cv_.notify_one();
+    if (pending_.fetch_sub(1) == 1) {
+      std::lock_guard<std::mutex> dlk(done_mu_);
+      done_cv_.notify_all();
+    }
+  }
+
+  void reap() {
+    struct io_uring_cqe cqe[64];
+    for (;;) {
+      int n;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        n = ring_.pop(cqe, 64);
+      }
+      if (n == 0) {
+        int r = uring::sys_enter(ring_.fd, 0, 1, IORING_ENTER_GETEVENTS);
+        if (r < 0 && errno != EINTR && errno != EAGAIN) {
+          // ring unusable: poison the engine (submits fail fast) and
+          // fail everything still pending so wait() returns
+          dead_.store(true);
+          std::lock_guard<std::mutex> lk(mu_);
+          for (unsigned i = 0; i < depth_; ++i)
+            if (ops_[i].req) retire_locked((int)i, true);
+          return;
+        }
+        continue;
+      }
+      std::lock_guard<std::mutex> lk(mu_);
+      for (int i = 0; i < n; ++i) {
+        if (cqe[i].user_data == ~0ull) return;          // stop sentinel
+        int slot = (int)cqe[i].user_data;
+        UOp &op = ops_[slot];
+        long res = (long)cqe[i].res;
+        if (res < 0) {
+          if (op.direct) {
+            // e.g. -EINVAL: fs accepted the open but rejects direct
+            // I/O — retry the whole chunk buffered
+            op.direct = false;
+            op.done = 0;
+            push_locked(slot);
+          } else {
+            retire_locked(slot, true);
+          }
+          continue;
+        }
+        if (res == 0) {             // EOF: no progress is possible —
+          retire_locked(slot, true);  // error, like the thread pool
+          continue;
+        }
+        op.done += res;
+        if (op.done < op.len) {
+          if (op.direct && (op.done % kAlign)) {  // unaligned remainder
+            op.direct = false;
+            op.done = 0;
+          }
+          push_locked(slot);                      // short op: resubmit
+        } else {
+          retire_locked(slot, false);
+        }
+      }
+    }
+  }
+
+  long block_size_;
+  bool single_submit_, overlap_events_, use_odirect_;
+  bool registered_ = false;
+  bool stop_;
+  unsigned depth_ = 0;
+  uring::Ring ring_;
+  std::vector<UOp> ops_;
+  std::vector<char *> bounce_;
+  std::vector<int> free_slots_;
+  std::thread reaper_;
+  std::mutex mu_;
+  std::condition_variable slot_cv_;
+  std::mutex done_mu_;
+  std::condition_variable done_cv_;
+  std::atomic<int> pending_;
+  std::atomic<int> errors_;
+  std::atomic<long> odirect_ops_;
+  std::atomic<long> tasks_total_;
+  std::atomic<bool> dead_{false};
+};
+
 }  // namespace
 
 extern "C" {
@@ -285,18 +580,40 @@ void *aio_create2(int num_threads, long block_size, int queue_depth,
                      overlap_events, use_odirect);
 }
 
-void aio_destroy(void *h) { delete static_cast<AioPool *>(h); }
+// backend-selecting constructor: use_uring 1 = io_uring (falls back to
+// the thread pool when the kernel/sandbox refuses io_uring_setup),
+// 0 = thread pool, -1 = auto (io_uring when available)
+void *aio_create3(int num_threads, long block_size, int queue_depth,
+                  int single_submit, int overlap_events, int use_odirect,
+                  int use_uring) {
+  bool want = use_uring == 1 || (use_uring == -1 && uring::available());
+  if (want) {
+    bool ok = false;
+    auto *e = new UringEngine(block_size, queue_depth, single_submit,
+                              overlap_events, use_odirect, &ok);
+    if (ok) return e;
+    delete e;
+  }
+  return new AioPool(num_threads, block_size, queue_depth, single_submit,
+                     overlap_events, use_odirect);
+}
+
+int aio_backend(void *h) { return static_cast<Engine *>(h)->backend(); }
+
+int aio_uring_available(void) { return uring::available() ? 1 : 0; }
+
+void aio_destroy(void *h) { delete static_cast<Engine *>(h); }
 
 // async chunked read/write; call aio_wait to drain
 void aio_pread(void *h, const char *path, void *buf, long nbytes,
                long offset) {
-  static_cast<AioPool *>(h)->submit(path, static_cast<char *>(buf), nbytes,
-                                    offset, false);
+  static_cast<Engine *>(h)->submit(path, static_cast<char *>(buf), nbytes,
+                                   offset, false);
 }
 
 void aio_pwrite(void *h, const char *path, const void *buf, long nbytes,
                 long offset) {
-  static_cast<AioPool *>(h)->submit(
+  static_cast<Engine *>(h)->submit(
       path, const_cast<char *>(static_cast<const char *>(buf)), nbytes,
       offset, true);
 }
@@ -304,21 +621,21 @@ void aio_pwrite(void *h, const char *path, const void *buf, long nbytes,
 // full-file rewrite: truncates to offset+nbytes before queueing the chunks
 void aio_pwrite_trunc(void *h, const char *path, const void *buf, long nbytes,
                       long offset) {
-  static_cast<AioPool *>(h)->submit(
+  static_cast<Engine *>(h)->submit(
       path, const_cast<char *>(static_cast<const char *>(buf)), nbytes,
       offset, true, true);
 }
 
-int aio_wait(void *h) { return static_cast<AioPool *>(h)->wait(); }
+int aio_wait(void *h) { return static_cast<Engine *>(h)->wait(); }
 
-int aio_pending(void *h) { return static_cast<AioPool *>(h)->pending(); }
+int aio_pending(void *h) { return static_cast<Engine *>(h)->pending(); }
 
 // observability: chunks that actually went through O_DIRECT / total chunks
 long aio_odirect_ops(void *h) {
-  return static_cast<AioPool *>(h)->odirect_ops();
+  return static_cast<Engine *>(h)->odirect_ops();
 }
 long aio_tasks_total(void *h) {
-  return static_cast<AioPool *>(h)->tasks_total();
+  return static_cast<Engine *>(h)->tasks_total();
 }
 
 // synchronous helpers (reference: aio_read/aio_write free functions)

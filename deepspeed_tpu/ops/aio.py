@@ -32,12 +32,23 @@ class AsyncIOHandle:
 
     def __init__(self, block_size: int = 1 << 20, queue_depth: int = 128,
                  thread_count: int = 4, single_submit: bool = False,
-                 overlap_events: bool = True, use_odirect: bool = False):
+                 overlap_events: bool = True, use_odirect: bool = False,
+                 backend: str = "auto"):
+        """``backend``: "uring" (io_uring — real kernel queue depth,
+        registered O_DIRECT buffers), "threads" (pread/pwrite worker
+        pool), or "auto" (io_uring when the kernel/sandbox allows it;
+        silently falls back otherwise — ``self.backend`` reports what
+        was actually built)."""
+        assert backend in ("auto", "uring", "threads"), backend
         lib = AsyncIOBuilder().load()
-        lib.aio_create2.restype = ctypes.c_void_p
-        lib.aio_create2.argtypes = [ctypes.c_int, ctypes.c_long,
+        lib.aio_create3.restype = ctypes.c_void_p
+        lib.aio_create3.argtypes = [ctypes.c_int, ctypes.c_long,
                                     ctypes.c_int, ctypes.c_int,
-                                    ctypes.c_int, ctypes.c_int]
+                                    ctypes.c_int, ctypes.c_int,
+                                    ctypes.c_int]
+        lib.aio_backend.argtypes = [ctypes.c_void_p]
+        lib.aio_backend.restype = ctypes.c_int
+        lib.aio_uring_available.restype = ctypes.c_int
         lib.aio_destroy.argtypes = [ctypes.c_void_p]
         for fn in ("aio_pread", "aio_pwrite", "aio_pwrite_trunc"):
             getattr(lib, fn).argtypes = [
@@ -52,9 +63,12 @@ class AsyncIOHandle:
         lib.aio_tasks_total.argtypes = [ctypes.c_void_p]
         lib.aio_tasks_total.restype = ctypes.c_long
         self._lib = lib
-        self._h = lib.aio_create2(thread_count, block_size, queue_depth,
+        want = {"auto": -1, "threads": 0, "uring": 1}[backend]
+        self._h = lib.aio_create3(thread_count, block_size, queue_depth,
                                   int(single_submit), int(overlap_events),
-                                  int(use_odirect))
+                                  int(use_odirect), want)
+        self.backend = ("uring" if lib.aio_backend(self._h) == 1
+                        else "threads")
         self.block_size = block_size
         self.queue_depth = queue_depth
         self.thread_count = thread_count
@@ -72,7 +86,8 @@ class AsyncIOHandle:
                   thread_count=aio_cfg.thread_count,
                   single_submit=aio_cfg.single_submit,
                   overlap_events=aio_cfg.overlap_events,
-                  use_odirect=getattr(aio_cfg, "use_odirect", False))
+                  use_odirect=getattr(aio_cfg, "use_odirect", False),
+                  backend=getattr(aio_cfg, "backend", "auto"))
         kw.update(overrides)
         return cls(**kw)
 

@@ -53,6 +53,8 @@ class OpBuilder:
         h = hashlib.sha256()
         for p in self.source_paths():
             h.update(p.read_bytes())
+        for s in getattr(self, "hash_extra_sources", []):
+            h.update((NATIVE_DIR / s).read_bytes())
         h.update(" ".join(self.extra_flags).encode())
         return h.hexdigest()[:16]
 
@@ -88,3 +90,5 @@ class AsyncIOBuilder(OpBuilder):
     """(reference: op_builder/async_io.py)."""
     name = "aio"
     sources = ["aio.cpp"]
+    # headers participate in the source hash so an edit rebuilds the .so
+    hash_extra_sources = ["uring.h"]
