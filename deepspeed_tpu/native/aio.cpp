@@ -322,8 +322,7 @@ public:
               int overlap_events, int use_odirect, bool *ok)
       : block_size_(block_size), single_submit_(single_submit != 0),
         overlap_events_(overlap_events != 0), use_odirect_(use_odirect != 0),
-        stop_(false), pending_(0), errors_(0), odirect_ops_(0),
-        tasks_total_(0) {
+        pending_(0), errors_(0), odirect_ops_(0), tasks_total_(0) {
     if (block_size_ < 1) block_size_ = 1 << 20;
     if (use_odirect_ && block_size_ % kAlign)
       block_size_ = ((block_size_ / kAlign) + 1) * kAlign;
@@ -333,14 +332,21 @@ public:
     if (!*ok) return;
     depth_ = ring_.entries;
     ops_.resize(depth_);
-    for (unsigned i = 0; i < depth_; ++i) free_slots_.push_back((int)i);
+    // descending: free_slots_.back() hands out LOW slots first, which
+    // is where the capped pinned bounce pool lives
+    for (unsigned i = depth_; i > 0; --i)
+      free_slots_.push_back((int)(i - 1));
     if (use_odirect_) {
-      // one pinned aligned buffer per ring slot, registered once — the
-      // fixed-buffer pool O_DIRECT chunks do zero-copy kernel DMA into
-      bounce_.resize(depth_, nullptr);
-      std::vector<struct iovec> iov(depth_);
+      // pinned aligned buffers registered once — the fixed-buffer pool
+      // O_DIRECT chunks do zero-copy kernel DMA into.  Capped: pinning
+      // queue_depth x block_size (up to 1 GB) eagerly would waste pages
+      // whenever the filesystem rejects O_DIRECT; chunks landing in
+      // slots past the pool simply run buffered
+      npinned_ = depth_ < 64 ? depth_ : 64;
+      bounce_.resize(npinned_, nullptr);
+      std::vector<struct iovec> iov(npinned_);
       bool all = true;
-      for (unsigned i = 0; i < depth_; ++i) {
+      for (unsigned i = 0; i < npinned_; ++i) {
         if (posix_memalign(reinterpret_cast<void **>(&bounce_[i]), kAlign,
                            block_size_))
           bounce_[i] = nullptr;
@@ -350,7 +356,7 @@ public:
       }
       registered_ =
           all && uring::sys_register(ring_.fd, IORING_REGISTER_BUFFERS,
-                                     iov.data(), depth_) == 0;
+                                     iov.data(), npinned_) == 0;
       if (!registered_) use_odirect_ = false;
     }
     reaper_ = std::thread([this] { reap(); });
@@ -364,15 +370,16 @@ public:
     if (reaper_.joinable()) {
       {
         std::lock_guard<std::mutex> lk(mu_);
-        stop_ = true;
         struct io_uring_sqe sqe;
         std::memset(&sqe, 0, sizeof(sqe));
         sqe.opcode = IORING_OP_NOP;
         sqe.user_data = ~0ull;           // stop sentinel
         while (!ring_.push(sqe))
           uring::sys_enter(ring_.fd, 0, 1, IORING_ENTER_GETEVENTS);
-        uring::sys_enter(ring_.fd, 1, 0, 0);
-      }
+        while (uring::sys_enter(ring_.fd, 1, 0, 0) < 0 &&
+               (errno == EINTR || errno == EAGAIN || errno == EBUSY))
+          ;                       // the sentinel MUST reach the kernel —
+      }                           // reaper_.join() hangs otherwise
       reaper_.join();
     }
     for (char *b : bounce_) free(b);
@@ -401,10 +408,18 @@ public:
       op.off = c.off;
       op.done = 0;
       op.write = write;
-      op.direct = c.direct && registered_ && c.len <= block_size_;
+      op.direct = c.direct && registered_ && c.len <= block_size_ &&
+                  (unsigned)slot < npinned_;
       pending_.fetch_add(1);
       tasks_total_.fetch_add(1);
-      if (op.direct && write) std::memcpy(bounce_[slot], op.user, op.len);
+      if (op.direct && write) {
+        // the slot is exclusively ours: stage the bounce copy OUTSIDE
+        // the lock so concurrent submitters/reaper aren't serialized
+        // behind a memcpy
+        lk.unlock();
+        std::memcpy(bounce_[slot], op.user, op.len);
+        lk.lock();
+      }
       push_locked(slot);
     }
     if (!overlap_events_) {
@@ -463,10 +478,17 @@ private:
         uring::sys_enter(ring_.fd, 0, 1, IORING_ENTER_GETEVENTS);
         continue;
       }
-      // fatal: the SQE may or may not ever be consumed — poison the
-      // engine so no slot is ever reused against a ghost completion
+      // fatal: the SQE may or may not ever be consumed later — poison
+      // the engine and LEAK the slot (never back on the free list), so
+      // a ghost completion can't race a reused slot; account the op as
+      // finished so wait() returns with the error
       dead_.store(true);
-      retire_locked(slot, true);
+      errors_.fetch_add(1);
+      ops_[slot].req.reset();
+      if (pending_.fetch_sub(1) == 1) {
+        std::lock_guard<std::mutex> dlk(done_mu_);
+        done_cv_.notify_all();
+      }
       return;
     }
   }
@@ -508,11 +530,14 @@ private:
         }
         continue;
       }
-      std::lock_guard<std::mutex> lk(mu_);
+      std::vector<int> drained;
+      std::unique_lock<std::mutex> lk(mu_);
       for (int i = 0; i < n; ++i) {
         if (cqe[i].user_data == ~0ull) return;          // stop sentinel
         int slot = (int)cqe[i].user_data;
+        if (slot < 0 || (unsigned)slot >= depth_) continue;
         UOp &op = ops_[slot];
+        if (!op.req) continue;     // ghost CQE for a leaked/fatal slot
         long res = (long)cqe[i].res;
         if (res < 0) {
           if (op.direct) {
@@ -537,8 +562,23 @@ private:
             op.done = 0;
           }
           push_locked(slot);                      // short op: resubmit
-        } else {
+        } else if (op.direct && !op.write) {
+          drained.push_back(slot);   // bounce->user copy happens below,
+        } else {                     // outside the lock
           retire_locked(slot, false);
+        }
+      }
+      if (!drained.empty()) {
+        lk.unlock();
+        for (int slot : drained) {
+          UOp &op = ops_[slot];      // slot still owned: safe unlocked
+          std::memcpy(op.user, bounce_[slot], op.len);
+        }
+        lk.lock();
+        for (int slot : drained) {
+          ops_[slot].direct = false;     // copy already done
+          retire_locked(slot, false);
+          odirect_ops_.fetch_add(1);     // it DID go through O_DIRECT
         }
       }
     }
@@ -547,8 +587,8 @@ private:
   long block_size_;
   bool single_submit_, overlap_events_, use_odirect_;
   bool registered_ = false;
-  bool stop_;
   unsigned depth_ = 0;
+  unsigned npinned_ = 0;
   uring::Ring ring_;
   std::vector<UOp> ops_;
   std::vector<char *> bounce_;
