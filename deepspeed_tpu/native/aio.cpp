@@ -398,7 +398,13 @@ public:
                               req->fd_direct >= 0);
     for (const auto &c : chunks) {
       std::unique_lock<std::mutex> lk(mu_);
-      slot_cv_.wait(lk, [this] { return !free_slots_.empty(); });
+      slot_cv_.wait(lk, [this] {
+        return dead_.load() || !free_slots_.empty();
+      });
+      if (dead_.load()) {        // ring died mid-request: fail the rest
+        errors_.fetch_add(1);
+        break;
+      }
       int slot = free_slots_.back();
       free_slots_.pop_back();
       UOp &op = ops_[slot];
@@ -415,10 +421,12 @@ public:
       if (op.direct && write) {
         // the slot is exclusively ours: stage the bounce copy OUTSIDE
         // the lock so concurrent submitters/reaper aren't serialized
-        // behind a memcpy
+        // behind a memcpy (op.staging keeps the fatal sweep off it)
+        op.staging = true;
         lk.unlock();
         std::memcpy(bounce_[slot], op.user, op.len);
         lk.lock();
+        op.staging = false;
       }
       push_locked(slot);
     }
@@ -446,10 +454,17 @@ private:
     long len = 0, off = 0, done = 0;
     bool write = false;
     bool direct = false;
-  };
+    bool staging = false;   // claimed, memcpy in progress OUTSIDE mu_ —
+  };                        // the fatal sweep must not touch it
 
   // fill + submit the SQE for ops_[slot]'s remaining span (mu_ held)
   void push_locked(int slot) {
+    if (dead_.load()) {
+      // nothing was pushed for this span yet, so the slot can't see a
+      // ghost completion — retire it normally with an error
+      retire_locked(slot, true);
+      return;
+    }
     UOp &op = ops_[slot];
     struct io_uring_sqe sqe;
     std::memset(&sqe, 0, sizeof(sqe));
@@ -481,32 +496,36 @@ private:
       // fatal: the SQE may or may not ever be consumed later — poison
       // the engine and LEAK the slot (never back on the free list), so
       // a ghost completion can't race a reused slot; account the op as
-      // finished so wait() returns with the error
+      // finished so wait() returns with the error, and wake slot
+      // waiters so multi-chunk submits observe dead_ instead of
+      // blocking forever
       dead_.store(true);
-      errors_.fetch_add(1);
-      ops_[slot].req.reset();
-      if (pending_.fetch_sub(1) == 1) {
-        std::lock_guard<std::mutex> dlk(done_mu_);
-        done_cv_.notify_all();
-      }
+      account_done_locked(slot, true);
+      slot_cv_.notify_all();
       return;
+    }
+  }
+
+  // completion accounting shared by every finish path (mu_ held)
+  void account_done_locked(int slot, bool error) {
+    UOp &op = ops_[slot];
+    if (error) errors_.fetch_add(1);
+    op.req.reset();            // close fds when the last chunk retires
+    if (pending_.fetch_sub(1) == 1) {
+      std::lock_guard<std::mutex> dlk(done_mu_);
+      done_cv_.notify_all();
     }
   }
 
   void retire_locked(int slot, bool error) {
     UOp &op = ops_[slot];
-    if (error) errors_.fetch_add(1);
     if (!error && op.direct) {
       if (!op.write) std::memcpy(op.user, bounce_[slot], op.len);
       odirect_ops_.fetch_add(1);
     }
-    op.req.reset();            // close fds when the last chunk retires
+    account_done_locked(slot, error);
     free_slots_.push_back(slot);
     slot_cv_.notify_one();
-    if (pending_.fetch_sub(1) == 1) {
-      std::lock_guard<std::mutex> dlk(done_mu_);
-      done_cv_.notify_all();
-    }
   }
 
   void reap() {
@@ -525,8 +544,10 @@ private:
           dead_.store(true);
           std::lock_guard<std::mutex> lk(mu_);
           for (unsigned i = 0; i < depth_; ++i)
-            if (ops_[i].req) retire_locked((int)i, true);
-          return;
+            if (ops_[i].req && !ops_[i].staging)
+              retire_locked((int)i, true);   // staging slots belong to
+          slot_cv_.notify_all();             // their submitter, which
+          return;                            // sees dead_ in push_locked
         }
         continue;
       }
