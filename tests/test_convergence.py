@@ -1,0 +1,113 @@
+"""End-to-end convergence sanity on REAL text (reference:
+tests/model/Megatron_GPT2/run_sanity_check.py — the loss-goes-down check
+the shape-level suite cannot replace).  Char-level GPT-2 on the bundled
+corpus (tests/data/corpus.txt): deterministic seed, loss must fall below
+an absolute threshold in N steps, and a mid-run checkpoint resume must
+continue the SAME trajectory bit-for-bit.  Nightly tier."""
+
+import os
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import build_model
+
+pytestmark = pytest.mark.nightly
+
+CORPUS = os.path.join(os.path.dirname(__file__), "data", "corpus.txt")
+SEQ = 128
+STEPS = 60
+
+
+def _batches(batch_size, steps, seed=0):
+    """Deterministic char-level LM batches from the bundled corpus."""
+    data = np.frombuffer(open(CORPUS, "rb").read(), np.uint8)
+    r = np.random.RandomState(seed)
+    for _ in range(steps):
+        starts = r.randint(0, len(data) - SEQ - 1, batch_size)
+        yield {"input_ids": np.stack([data[s:s + SEQ] for s in starts])
+               .astype(np.int32)}
+
+
+def _config(**extra):
+    return {
+        "train_micro_batch_size_per_device": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 3e-3, "weight_decay": 0.01}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_num_steps": 10,
+                                 "warmup_max_lr": 3e-3}},
+        "gradient_clipping": 1.0,
+        "mesh": {"data": 8},
+        "steps_per_print": 1000,
+        **extra,
+    }
+
+
+def _model():
+    return build_model("gpt2", vocab_size=256, num_layers=2, d_model=128,
+                       num_heads=4, max_seq_len=SEQ, seed=7)
+
+
+def test_loss_falls_on_real_text():
+    """Char-level entropy of English text is ~4.5 bits (~3.1 nats);
+    random-init loss is ln(256) = 5.55.  60 steps of batch-16 must get
+    under 3.0 — memorization-level progress a shape-preserving optimizer
+    bug (wrong lr wiring, dead grads, stale masters) cannot fake."""
+    eng = ds.initialize(model=_model(), config=_config())
+    losses = [float(eng.train_batch(b)["loss"])
+              for b in _batches(eng.train_batch_size, STEPS)]
+    print(f"\nconvergence: first {losses[0]:.3f} min {min(losses):.3f} "
+          f"last {losses[-1]:.3f}")
+    assert losses[0] > 4.5            # sanity: actually started cold
+    assert min(losses[-10:]) < 3.0, losses[-10:]
+
+
+def test_resume_continues_identical_trajectory(tmp_path):
+    """Train A for 2k steps saving at k; train B resumed from the
+    checkpoint on the same data stream: B's losses must match A's
+    post-checkpoint losses exactly (optimizer state, scheduler step and
+    data order all survive the round-trip)."""
+    k = 12
+    batches = list(_batches(16, 2 * k, seed=1))
+
+    eng_a = ds.initialize(model=_model(), config=_config())
+    a_losses = []
+    for i, b in enumerate(batches):
+        a_losses.append(float(eng_a.train_batch(b)["loss"]))
+        if i == k - 1:
+            eng_a.save_checkpoint(str(tmp_path), tag="mid")
+
+    eng_b = ds.initialize(model=_model(), config=_config())
+    eng_b.load_checkpoint(str(tmp_path), tag="mid")
+    b_losses = [float(eng_b.train_batch(b)["loss"])
+                for b in batches[k:]]
+    np.testing.assert_allclose(b_losses, a_losses[k:], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_resume_on_different_mesh(tmp_path):
+    """Elastic resume: the mid-run checkpoint taken on a data=8 mesh
+    resumes on data=4 x fsdp=2 (universal checkpoint — any-mesh by
+    construction) and keeps converging with a closely matching loss."""
+    k = 10
+    batches = list(_batches(16, k + 6, seed=2))
+    eng_a = ds.initialize(model=_model(), config=_config())
+    a_losses = []
+    for i, b in enumerate(batches):
+        a_losses.append(float(eng_a.train_batch(b)["loss"]))
+        if i == k - 1:
+            eng_a.save_checkpoint(str(tmp_path), tag="elastic")
+
+    cfg2 = _config(mesh={"data": 4, "fsdp": 2},
+                   zero_optimization={"stage": 3})
+    eng_b = ds.initialize(model=_model(), config=cfg2)
+    eng_b.load_checkpoint(str(tmp_path), tag="elastic")
+    b_losses = [float(eng_b.train_batch(b)["loss"])
+                for b in batches[k:]]
+    # different mesh => different reduction order; trajectories track
+    # closely but not bitwise
+    np.testing.assert_allclose(b_losses, a_losses[k:], rtol=2e-2)
+    assert b_losses[-1] < a_losses[k - 1] + 0.05    # still descending
