@@ -1,0 +1,167 @@
+"""Collective-sweep microbenchmark CLI — the ``ds_bench`` analog.
+
+Reference: ``bin/ds_bench`` driving the communication benchmark suite
+(all_reduce/all_gather/reduce_scatter/all_to_all/broadcast over a
+doubling message-size sweep, reporting latency + algbw/busbw per size —
+the pod-bringup tool).  TPU-native: collectives run as jitted ``psum``/
+``all_gather``/``psum_scatter``/``all_to_all`` over a named mesh axis,
+so the sweep measures exactly the XLA collectives training uses, on ICI
+when the axis spans a slice and on DCN when it spans hosts.
+
+Usage (single host, all local devices)::
+
+    python -m deepspeed_tpu.comm.bench --ops all_reduce,all_gather \
+        --maxsize 28 --trials 20
+
+Multi-host: launch one process per host with the runner
+(``python -m deepspeed_tpu.launcher.runner --hostfile ...``); the mesh
+then spans the pod and the sweep exercises the cross-host fabric.
+
+Timing barrier: a scalar fetch after ``block_until_ready`` — on
+tunneled/virtualized chips ``block_until_ready`` alone is advisory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .comms_logging import calc_bw_log, convert_size
+
+OPS = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+       "broadcast")
+
+
+def _build_op(op: str, mesh, axis: str):
+    """One jitted collective over ``axis``; input sharded on dim 0 for
+    the scatter/gather family, replicated for all_reduce/broadcast."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.shape[axis]
+    repl = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P(axis))
+
+    def wrap(body, in_spec):
+        f = jax.shard_map(body, mesh=mesh, in_specs=in_spec,
+                          out_specs=in_spec, check_vma=False)
+        return jax.jit(f), (repl if in_spec == P() else shard)
+
+    if op == "all_reduce":
+        return wrap(lambda x: jax.lax.psum(x, axis), P())
+    if op == "all_gather":
+        # per-device shard -> full tensor, then keep the local slice so
+        # input/output specs match (steady-state ZeRO gather shape)
+        def body(x):
+            g = jax.lax.all_gather(x, axis, tiled=True)
+            return jax.lax.dynamic_slice_in_dim(
+                g, jax.lax.axis_index(axis) * x.shape[0], x.shape[0])
+        return wrap(body, P(axis))
+    if op == "reduce_scatter":
+        def body(x):
+            s = jax.lax.psum_scatter(x, axis, scatter_dimension=0,
+                                     tiled=True)
+            return jnp.concatenate([s] * n, axis=0)
+        return wrap(body, P(axis))
+    if op == "all_to_all":
+        return wrap(lambda x: jax.lax.all_to_all(
+            x.reshape(n, -1), axis, split_axis=0, concat_axis=0,
+            tiled=False).reshape(x.shape), P(axis))
+    if op == "broadcast":
+        def body(x):
+            root = jnp.where(jax.lax.axis_index(axis) == 0, x,
+                             jnp.zeros_like(x))
+            return jax.lax.psum(root, axis)
+        return wrap(body, P())
+    raise ValueError(f"unknown op {op!r} (choose from {OPS})")
+
+
+def sweep(ops: List[str], min_pow: int = 12, max_pow: int = 26,
+          trials: int = 10, warmups: int = 3, dtype: str = "bfloat16",
+          axis: str = "x", mesh=None,
+          print_table: bool = True) -> List[Dict]:
+    """Run the sweep; returns one record per (op, size) with latency
+    and algbw/busbw in Gbps (NCCL-style accounting)."""
+    dt = jnp.dtype(dtype)
+    if mesh is None:
+        devs = np.asarray(jax.devices())
+        mesh = jax.sharding.Mesh(devs, (axis,))
+    n = mesh.shape[axis]
+    out: List[Dict] = []
+    for op in ops:
+        fn, in_sh = _build_op(op, mesh, axis)
+        if print_table:
+            print(f"\n---- {op} over {n} devices "
+                  f"({jax.devices()[0].platform}) ----")
+            print(f"{'size':>10} {'latency':>12} {'algbw Gbps':>12} "
+                  f"{'busbw Gbps':>12}")
+        for p in range(min_pow, max_pow + 1):
+            nbytes = 1 << p
+            elems = max(n * n, nbytes // dt.itemsize)
+            # reduce_scatter/all_to_all split the LOCAL shard n ways
+            # again, so round to a multiple of n^2 (matters on
+            # non-power-of-two meshes)
+            elems = (elems // (n * n)) * (n * n)
+            x = jax.device_put(
+                jnp.ones((elems,), dt), in_sh)
+            for _ in range(warmups):
+                x = fn(x)
+            jax.block_until_ready(x)
+            float(jnp.sum(x[:1]))           # real barrier (tunnel-safe)
+            t0 = time.perf_counter()
+            for _ in range(trials):
+                x = fn(x)
+            jax.block_until_ready(x)
+            float(jnp.sum(x[:1]))
+            lat = (time.perf_counter() - t0) / trials
+            size_bytes = elems * dt.itemsize
+            algbw, busbw = calc_bw_log(op, size_bytes, lat, n)
+            rec = dict(op=op, bytes=size_bytes, latency_us=lat * 1e6,
+                       algbw_gbps=round(algbw, 2),
+                       busbw_gbps=round(busbw, 2), devices=n)
+            out.append(rec)
+            if print_table:
+                print(f"{convert_size(size_bytes):>10} "
+                      f"{lat * 1e6:>10.1f}us {algbw:>12.2f} "
+                      f"{busbw:>12.2f}")
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="deepspeed_tpu.comm.bench",
+        description="collective sweep microbenchmark (ds_bench analog)")
+    ap.add_argument("--ops", default="all_reduce",
+                    help=f"comma list from {','.join(OPS)} or 'all'")
+    ap.add_argument("--minsize", type=int, default=12,
+                    help="log2 of smallest message bytes")
+    ap.add_argument("--maxsize", type=int, default=26,
+                    help="log2 of largest message bytes")
+    ap.add_argument("--trials", type=int, default=10)
+    ap.add_argument("--warmups", type=int, default=3)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON line per record instead of a table")
+    ap.add_argument("--multihost", action="store_true",
+                    help="call jax.distributed.initialize() first "
+                         "(under the launcher/runner env)")
+    args = ap.parse_args(argv)
+    if args.multihost:
+        jax.distributed.initialize()
+    ops = list(OPS) if args.ops == "all" else args.ops.split(",")
+    recs = sweep(ops, args.minsize, args.maxsize, args.trials,
+                 args.warmups, args.dtype, print_table=not args.json)
+    if args.json:
+        for r in recs:
+            print(json.dumps(r))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

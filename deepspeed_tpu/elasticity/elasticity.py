@@ -88,18 +88,11 @@ def compute_elastic_config(ds_config: Dict, target_deviation: float = 0.0,
     if any(mb <= 0 for mb in micro_batches):
         raise ElasticityError(f"bad micro_batch_sizes {micro_batches}")
 
-    max_acc = max_batch // min(micro_batches)
-    candidates = [b for b in get_candidate_batch_sizes(micro_batches, max_acc)
-                  if b <= max_batch]
     if version >= 0.2:
-        # v0.2 restriction: device count must also satisfy the
-        # min/max window exactly (reference: _get_compatible_gpus_v02)
-        candidates = [b for b in candidates
-                      if get_valid_devices(b, micro_batches, min_dev,
-                                           max_dev)]
-    final_batch, valid = _best_candidate(candidates, micro_batches,
-                                         min_dev, max_dev, prefer_larger)
-
+        return _plan_v02(ecfg, micro_batches, max_batch, min_dev, max_dev,
+                         prefer_larger, world_size)
+    final_batch, valid = _plan_v01(micro_batches, max_batch, min_dev,
+                                   max_dev, prefer_larger)
     if world_size > 0:
         if world_size not in valid:
             raise ElasticityError(
@@ -110,6 +103,69 @@ def compute_elastic_config(ds_config: Dict, target_deviation: float = 0.0,
                 return final_batch, valid, mb
         raise ElasticityError(
             f"no micro batch fits batch={final_batch} world={world_size}")
+    return final_batch, valid
+
+
+def _plan_v01(micro_batches: Sequence[int], max_batch: int, min_dev: int,
+              max_dev: int, prefer_larger: bool) -> Tuple[int, List[int]]:
+    """v0.1 heuristic (reference: _get_compatible_gpus_v01): every
+    micro batch scaled by every accumulation step up to the cap; keep
+    the candidate with the most compatible device counts.  (The
+    reference also seeds the LCM of the micro batches, but every
+    lcm*k <= cap is already generated as min(micro)*k', so the extra
+    base is provably redundant.)"""
+    max_acc = max_batch // min(micro_batches)
+    candidates = [b for b in
+                  get_candidate_batch_sizes(micro_batches, max_acc)
+                  if b <= max_batch]
+    return _best_candidate(candidates, micro_batches, min_dev, max_dev,
+                           prefer_larger)
+
+
+def _plan_v02(ecfg: Dict, micro_batches: Sequence[int], max_batch: int,
+              min_dev: int, max_dev: int, prefer_larger: bool,
+              world_size: int):
+    """v0.2 (reference: _get_compatible_gpus_v02): model-parallel-aware
+    planning at NODE granularity — each node contributes
+    ``devices_per_node // model_parallel_size`` data replicas, so the
+    v0.1 search runs over node counts with the batch cap scaled down by
+    the per-node DP degree, then scales back to device counts."""
+    mp = int(ecfg.get("model_parallel_size", 1))
+    dpn = int(ecfg.get("devices_per_node",
+                       ecfg.get("num_gpus_per_node", 1)))
+    if dpn % mp:
+        raise ElasticityError(
+            f"elasticity v0.2: devices_per_node={dpn} must divide by "
+            f"model_parallel_size={mp}")
+    dp_per_node = dpn // mp
+    max_nodes = max_dev // dpn
+    if max_nodes < 1:
+        raise ElasticityError(
+            f"elasticity v0.2: max_devices={max_dev} cannot fit one "
+            f"{dpn}-device node")
+    min_nodes = max(-(-min_dev // dpn), 1)      # ceiling: respect floor
+    node_batch, valid_nodes = _plan_v01(
+        micro_batches, max_batch // dp_per_node,
+        min_nodes, max_nodes, prefer_larger)
+    final_batch = node_batch * dp_per_node
+    valid = [n * dpn for n in valid_nodes]
+    if world_size > 0:
+        if world_size not in valid:
+            raise ElasticityError(
+                f"world size {world_size} incompatible with elastic batch "
+                f"{final_batch} (valid device counts: {valid})")
+        dp_world = world_size // mp
+        micro = None
+        for mb in micro_batches:
+            if (final_batch // dp_world) % mb == 0:
+                if micro is None or (mb > micro if prefer_larger
+                                     else mb < micro):
+                    micro = mb
+        if micro is None:
+            raise ElasticityError(
+                f"no micro batch fits batch={final_batch} "
+                f"world={world_size} mp={mp}")
+        return final_batch, valid, micro
     return final_batch, valid
 
 

@@ -321,3 +321,71 @@ class TestLayerReductionDistillation:
                 "compression_training": {"layer_reduction": {
                     "enabled": True, "keep_number_layer": 4,
                     "teacher_layer": [0, 1, 2, 99]}}})
+
+
+class TestElasticityV02:
+    """v0.2 planning (reference: _get_compatible_gpus_v02) — node
+    granularity with model-parallel awareness."""
+
+    def _cfg(self, **kw):
+        e = {"enabled": True, "version": 0.2,
+             "micro_batch_sizes": [2, 4], "max_train_batch_size": 512,
+             "min_devices": 8, "max_devices": 64,
+             "devices_per_node": 8, **kw}
+        return {"elasticity": e}
+
+    def test_node_granularity(self):
+        from deepspeed_tpu.elasticity.elasticity import \
+            compute_elastic_config
+        batch, valid = compute_elastic_config(self._cfg())
+        # every valid count is a whole number of 8-device nodes
+        assert valid and all(v % 8 == 0 for v in valid)
+        assert batch <= 512
+
+    def test_model_parallel_scaling(self):
+        from deepspeed_tpu.elasticity.elasticity import \
+            compute_elastic_config
+        b_mp4, v_mp4, micro = compute_elastic_config(
+            self._cfg(model_parallel_size=4), world_size=16)
+        # mp=4 on 8-dev nodes => 2 data replicas per node
+        assert b_mp4 <= 512 and b_mp4 % 2 == 0
+        assert 16 in v_mp4 and all(v % 8 == 0 for v in v_mp4)
+        dp_world = 16 // 4
+        assert (b_mp4 // dp_world) % micro == 0
+
+    def test_mp_must_divide_node(self):
+        import pytest
+        from deepspeed_tpu.elasticity.elasticity import (
+            ElasticityError, compute_elastic_config)
+        with pytest.raises(ElasticityError, match="divide"):
+            compute_elastic_config(self._cfg(model_parallel_size=3))
+
+    def test_incompatible_world_rejected(self):
+        import pytest
+        from deepspeed_tpu.elasticity.elasticity import (
+            ElasticityError, compute_elastic_config)
+        with pytest.raises(ElasticityError, match="incompatible"):
+            compute_elastic_config(self._cfg(), world_size=12)  # 1.5 nodes
+
+
+class TestCommBench:
+    def test_sweep_all_ops(self, devices):
+        """ds_bench analog: every collective sweeps and reports busbw."""
+        from deepspeed_tpu.comm.bench import OPS, sweep
+        recs = sweep(list(OPS), min_pow=12, max_pow=13, trials=2,
+                     warmups=1, print_table=False)
+        assert len(recs) == len(OPS) * 2
+        for r in recs:
+            assert r["devices"] == 8
+            assert r["busbw_gbps"] > 0
+            assert r["latency_us"] > 0
+
+    def test_cli_json(self, devices, capsys):
+        import json as js
+        from deepspeed_tpu.comm.bench import main
+        main(["--ops", "all_reduce", "--minsize", "12", "--maxsize",
+              "12", "--trials", "2", "--json"])
+        lines = [l for l in capsys.readouterr().out.splitlines()
+                 if l.startswith("{")]
+        rec = js.loads(lines[0])
+        assert rec["op"] == "all_reduce" and rec["busbw_gbps"] > 0
