@@ -915,3 +915,78 @@ def load_hf_distilbert(cfg, state_dict: Dict[str, Any],
         params = jax.tree.map(lambda x: np.asarray(x, dtype), params)
     logger.info("converted %d HF tensors (distilbert encoder)", len(sd))
     return params
+
+
+def load_hf_clip(cfg, state_dict: Dict[str, Any], dtype=None) -> Dict:
+    """HF CLIPModel state dict → ``models/clip.py`` tree (reference
+    container: module_inject/containers/clip.py:13 — both towers are
+    CLIPEncoderLayers).  ``cfg``: a
+    :class:`~deepspeed_tpu.models.clip.CLIPConfig`."""
+    sd = state_dict
+
+    def tower(pre, tw):
+        H, D, nl = tw.num_heads, tw.width // tw.num_heads, tw.num_layers
+        Lf = pre + "encoder.layers.{}."
+        return {
+            "ln1": {"scale": _stack(sd, Lf + "layer_norm1.weight", nl),
+                    "bias": _stack(sd, Lf + "layer_norm1.bias", nl)},
+            "ln2": {"scale": _stack(sd, Lf + "layer_norm2.weight", nl),
+                    "bias": _stack(sd, Lf + "layer_norm2.bias", nl)},
+            "attn": {
+                **{wn: _stack(sd, Lf + f"self_attn.{hn}_proj.weight",
+                              nl, lambda w: _qkv_heads(w, H, D, True))
+                   for wn, hn in (("wq", "q"), ("wk", "k"), ("wv", "v"))},
+                **{bn: _stack(sd, Lf + f"self_attn.{hn}_proj.bias", nl,
+                              lambda b: b.reshape(H, D))
+                   for bn, hn in (("bq", "q"), ("bk", "k"), ("bv", "v"))},
+                "wo": _stack(sd, Lf + "self_attn.out_proj.weight", nl,
+                             lambda w: _o_heads(w, H, D, True)),
+                "bo": _stack(sd, Lf + "self_attn.out_proj.bias", nl),
+            },
+            "mlp": {
+                "wi": _stack(sd, Lf + "mlp.fc1.weight", nl,
+                             lambda w: w.T),
+                "bi": _stack(sd, Lf + "mlp.fc1.bias", nl),
+                "wo": _stack(sd, Lf + "mlp.fc2.weight", nl,
+                             lambda w: w.T),
+                "bo": _stack(sd, Lf + "mlp.fc2.bias", nl),
+            },
+        }
+
+    v = "vision_model."
+    t = "text_model."
+    # HF's key really is spelled "pre_layrnorm"
+    pre_ln = v + ("pre_layrnorm" if v + "pre_layrnorm.weight" in sd
+                  else "pre_layernorm")
+    params = {
+        "visual": {
+            "patch_embed": {"kernel": np.transpose(
+                _np(sd[v + "embeddings.patch_embedding.weight"]),
+                (2, 3, 1, 0))},                      # OIHW -> HWIO
+            "class_embed": _np(sd[v + "embeddings.class_embedding"]),
+            "pos_embed": _np(
+                sd[v + "embeddings.position_embedding.weight"]),
+            "ln_pre": {"scale": _np(sd[pre_ln + ".weight"]),
+                       "bias": _np(sd[pre_ln + ".bias"])},
+            "blocks": tower(v, cfg.vision),
+            "ln_post": {"scale": _np(sd[v + "post_layernorm.weight"]),
+                        "bias": _np(sd[v + "post_layernorm.bias"])},
+            "proj": _np(sd["visual_projection.weight"]).T,
+        },
+        "text": {
+            "embed": {"table": _np(
+                sd[t + "embeddings.token_embedding.weight"])},
+            "pos_embed": _np(
+                sd[t + "embeddings.position_embedding.weight"]),
+            "blocks": tower(t, cfg.text),
+            "ln_final": {"scale": _np(sd[t + "final_layer_norm.weight"]),
+                         "bias": _np(sd[t + "final_layer_norm.bias"])},
+            "proj": _np(sd["text_projection.weight"]).T,
+        },
+        "logit_scale": _np(sd["logit_scale"]),
+    }
+    if dtype is not None:
+        import jax
+        params = jax.tree.map(lambda x: np.asarray(x, dtype), params)
+    logger.info("converted %d HF tensors (clip dual-tower)", len(sd))
+    return params

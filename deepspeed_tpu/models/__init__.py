@@ -5,9 +5,11 @@ from .presets import PRESETS, build_config, build_model
 from .encoder import Encoder, EncoderConfig
 from .diffusion import (AutoencoderKL, UNet2DCondition, UNetConfig,
                         VAEConfig)
+from .clip import CLIP, CLIPConfig
 
 __all__ = ["layers", "Model", "TransformerConfig", "apply", "init_params",
            "cross_entropy_loss", "lm_loss_fn", "block_apply",
            "PRESETS", "build_config", "build_model",
            "Encoder", "EncoderConfig",
-           "AutoencoderKL", "UNet2DCondition", "UNetConfig", "VAEConfig"]
+           "AutoencoderKL", "UNet2DCondition", "UNetConfig", "VAEConfig",
+           "CLIP", "CLIPConfig"]

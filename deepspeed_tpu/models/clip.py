@@ -1,0 +1,249 @@
+"""CLIP dual-tower (vision transformer + causal text transformer).
+
+TPU-native analog of the reference's CLIP serving support
+(``module_inject/containers/clip.py:13`` — policy injection into HF
+CLIPEncoderLayer; both towers share that layer shape).  Implemented as
+one shared pre-LN residual block applied with ``lax.scan`` over stacked
+layer params (the repo's standard scan layout): the vision tower runs it
+bidirectionally over patch tokens + a class token, the text tower runs
+it causally over BPE tokens; each pools (class token / EOT token),
+projects into the shared embedding space, and similarity is the
+logit-scaled cosine — ``encode_image`` / ``encode_text`` /
+``similarity`` are the serving surface (embedding / retrieval class).
+
+QuickGELU (x * sigmoid(1.702 x)) matches OpenAI CLIP checkpoints.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import layers as L
+
+
+def quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+@dataclasses.dataclass
+class CLIPTowerConfig:
+    width: int
+    num_layers: int
+    num_heads: int
+    d_ff: Optional[int] = None       # None => 4*width
+
+    def __post_init__(self):
+        if self.d_ff is None:
+            self.d_ff = 4 * self.width
+
+
+@dataclasses.dataclass
+class CLIPConfig:
+    embed_dim: int = 512
+    # vision
+    image_size: int = 224
+    patch_size: int = 32
+    vision: CLIPTowerConfig = None
+    # text
+    vocab_size: int = 49408
+    max_text_len: int = 77
+    text: CLIPTowerConfig = None
+    eps: float = 1e-5
+
+    def __post_init__(self):
+        if self.vision is None:
+            self.vision = CLIPTowerConfig(width=768, num_layers=12,
+                                          num_heads=12)
+        if self.text is None:
+            self.text = CLIPTowerConfig(width=512, num_layers=12,
+                                        num_heads=8)
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+def _block_init(key, tw: CLIPTowerConfig):
+    w, H, dff = tw.width, tw.num_heads, tw.d_ff
+    D = w // H
+    k = jax.random.split(key, 6)
+    ln = lambda: {"scale": jnp.ones((w,)), "bias": jnp.zeros((w,))}
+    return {
+        "ln1": ln(), "ln2": ln(),
+        "attn": {
+            "wq": jax.random.normal(k[0], (w, H, D)) / math.sqrt(w),
+            "wk": jax.random.normal(k[1], (w, H, D)) / math.sqrt(w),
+            "wv": jax.random.normal(k[2], (w, H, D)) / math.sqrt(w),
+            "wo": jax.random.normal(k[3], (H, D, w)) / math.sqrt(w),
+            "bq": jnp.zeros((H, D)), "bk": jnp.zeros((H, D)),
+            "bv": jnp.zeros((H, D)), "bo": jnp.zeros((w,)),
+        },
+        "mlp": {
+            "wi": jax.random.normal(k[4], (w, dff)) / math.sqrt(w),
+            "bi": jnp.zeros((dff,)),
+            "wo": jax.random.normal(k[5], (dff, w)) / math.sqrt(dff),
+            "bo": jnp.zeros((w,)),
+        },
+    }
+
+
+def _tower_blocks_init(key, tw: CLIPTowerConfig):
+    ks = jax.random.split(key, tw.num_layers)
+    per = [_block_init(k, tw) for k in ks]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per)
+
+
+def _tower_apply(cfg: CLIPConfig, tw: CLIPTowerConfig, blocks, x,
+                 causal: bool):
+    """Shared pre-LN residual stack (the CLIPEncoderLayer shape):
+    x += attn(LN(x)); x += mlp(LN(x)) — scan over stacked layers."""
+    H = tw.num_heads
+    D = tw.width // H
+    dt = x.dtype
+    norm = lambda p, v: L.layernorm(p, v, eps=cfg.eps)   # noqa: E731
+
+    def body(h, lp):
+        a = norm(lp["ln1"], h)
+        ap = lp["attn"]
+        q = jnp.einsum("bsd,dhk->bshk", a, ap["wq"].astype(dt)) \
+            + ap["bq"].astype(dt)
+        k = jnp.einsum("bsd,dhk->bshk", a, ap["wk"].astype(dt)) \
+            + ap["bk"].astype(dt)
+        v = jnp.einsum("bsd,dhk->bshk", a, ap["wv"].astype(dt)) \
+            + ap["bv"].astype(dt)
+        o = L.causal_attention(q, k, v, causal=causal)
+        o = jnp.einsum("bshk,hkd->bsd", o, ap["wo"].astype(dt)) \
+            + ap["bo"].astype(dt)
+        h = h + o
+        m = norm(lp["ln2"], h)
+        mp = lp["mlp"]
+        u = quick_gelu(m @ mp["wi"].astype(dt) + mp["bi"].astype(dt))
+        h = h + (u @ mp["wo"].astype(dt) + mp["bo"].astype(dt))
+        return h, None
+
+    x, _ = jax.lax.scan(body, x, blocks)
+    return x
+
+
+def init_params(cfg: CLIPConfig, key) -> Dict[str, Any]:
+    (kv, kt, kvb, ktb, k3, k4, k5, k6,
+     k7) = jax.random.split(key, 9)
+    vw, tw = cfg.vision.width, cfg.text.width
+    P = cfg.patch_size
+    return {
+        "visual": {
+            "patch_embed": {"kernel": jax.random.normal(
+                kv, (P, P, 3, vw)) / math.sqrt(P * P * 3)},
+            "class_embed": jax.random.normal(k3, (vw,)) * 0.02,
+            "pos_embed": jax.random.normal(
+                k4, (cfg.num_patches + 1, vw)) * 0.02,
+            "ln_pre": {"scale": jnp.ones((vw,)), "bias": jnp.zeros((vw,))},
+            "blocks": _tower_blocks_init(kvb, cfg.vision),
+            "ln_post": {"scale": jnp.ones((vw,)),
+                        "bias": jnp.zeros((vw,))},
+            "proj": jax.random.normal(k5, (vw, cfg.embed_dim))
+            / math.sqrt(vw),
+        },
+        "text": {
+            "embed": {"table": jax.random.normal(
+                kt, (cfg.vocab_size, tw)) * 0.02},
+            "pos_embed": jax.random.normal(
+                k6, (cfg.max_text_len, tw)) * 0.01,
+            "blocks": _tower_blocks_init(ktb, cfg.text),
+            "ln_final": {"scale": jnp.ones((tw,)),
+                         "bias": jnp.zeros((tw,))},
+            "proj": jax.random.normal(k7, (tw, cfg.embed_dim))
+            / math.sqrt(tw),
+        },
+        "logit_scale": jnp.asarray(np.log(1 / 0.07), jnp.float32),
+    }
+
+
+def encode_image(cfg: CLIPConfig, params, images) -> jnp.ndarray:
+    """images [B, H, W, 3] (NHWC) → [B, embed_dim] (unnormalized)."""
+    vp = params["visual"]
+    dt = images.dtype
+    x = jax.lax.conv_general_dilated(
+        images, vp["patch_embed"]["kernel"].astype(dt),
+        window_strides=(cfg.patch_size, cfg.patch_size), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    B = x.shape[0]
+    x = x.reshape(B, -1, cfg.vision.width)              # [B, P², W]
+    cls = jnp.broadcast_to(vp["class_embed"].astype(dt),
+                           (B, 1, cfg.vision.width))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + vp["pos_embed"][: x.shape[1]].astype(dt)
+    x = L.layernorm(vp["ln_pre"], x, eps=cfg.eps)
+    x = _tower_apply(cfg, cfg.vision, vp["blocks"], x, causal=False)
+    pooled = L.layernorm(vp["ln_post"], x[:, 0], eps=cfg.eps)
+    return pooled @ vp["proj"].astype(dt)
+
+
+def encode_text(cfg: CLIPConfig, params, input_ids) -> jnp.ndarray:
+    """input_ids [B, S] → [B, embed_dim]; pools at the EOT token, which
+    in CLIP's vocabulary is the highest token id in the sequence."""
+    tp = params["text"]
+    x = L.embed(tp["embed"], input_ids)
+    dt = x.dtype
+    x = x + tp["pos_embed"][: x.shape[1]].astype(dt)
+    x = _tower_apply(cfg, cfg.text, tp["blocks"], x, causal=True)
+    x = L.layernorm(tp["ln_final"], x, eps=cfg.eps)
+    eot = jnp.argmax(input_ids, axis=-1)
+    pooled = jnp.take_along_axis(
+        x, eot[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    return pooled @ tp["proj"].astype(dt)
+
+
+def similarity(cfg: CLIPConfig, params, images, input_ids):
+    """→ (logits_per_image [B_img, B_txt], logits_per_text)."""
+    ie = encode_image(cfg, params, images)
+    te = encode_text(cfg, params, input_ids)
+    ie = ie / jnp.linalg.norm(ie, axis=-1, keepdims=True)
+    te = te / jnp.linalg.norm(te, axis=-1, keepdims=True)
+    scale = jnp.exp(params["logit_scale"]).astype(ie.dtype)
+    lpi = scale * ie @ te.T
+    return lpi, lpi.T
+
+
+class CLIP:
+    """Model wrapper: jitted encode/similarity serving surface."""
+
+    def __init__(self, config: CLIPConfig = None, seed: int = 0,
+                 dtype=jnp.float32):
+        self.config = config or CLIPConfig()
+        self.params = init_params(self.config, jax.random.PRNGKey(seed))
+        if dtype != jnp.float32:
+            self.params = jax.tree.map(
+                lambda x: x.astype(dtype)
+                if x.dtype == jnp.float32 else x, self.params)
+        self._build_jits()
+
+    @classmethod
+    def from_params(cls, config: CLIPConfig, params):
+        self = cls.__new__(cls)
+        self.config = config
+        self.params = params
+        self._build_jits()
+        return self
+
+    def _build_jits(self):
+        cfg = self.config
+        self._img = jax.jit(lambda p, im: encode_image(cfg, p, im))
+        self._txt = jax.jit(lambda p, ids: encode_text(cfg, p, ids))
+        self._sim = jax.jit(
+            lambda p, im, ids: similarity(cfg, p, im, ids))
+
+    def encode_image(self, images):
+        return self._img(self.params, images)
+
+    def encode_text(self, input_ids):
+        return self._txt(self.params, input_ids)
+
+    def similarity(self, images, input_ids):
+        return self._sim(self.params, images, input_ids)

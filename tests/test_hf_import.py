@@ -403,3 +403,77 @@ class TestNewFamilies:
         ref = np.asarray(m.apply(m.params, jnp.asarray(IDS),
                                  dtype=jnp.float32))
         np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+
+
+class TestCLIP:
+    """CLIP dual-tower (reference container:
+    module_inject/containers/clip.py:13)."""
+
+    def _pair(self):
+        from transformers import CLIPConfig as HFCLIPConfig, CLIPModel
+        from deepspeed_tpu.models.clip import (CLIP, CLIPConfig,
+                                               CLIPTowerConfig)
+        from deepspeed_tpu.checkpoint.hf import load_hf_clip
+        torch.manual_seed(0)
+        from transformers import CLIPTextConfig, CLIPVisionConfig
+        hf = CLIPModel(HFCLIPConfig.from_text_vision_configs(
+            CLIPTextConfig(
+                vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                max_position_embeddings=32, hidden_act="quick_gelu",
+                attention_dropout=0.0,
+                # our encode_text pools at the highest token id (the
+                # original-CLIP EOT convention); align HF's eos pooling
+                eos_token_id=255),
+            CLIPVisionConfig(
+                hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                image_size=32, patch_size=8, hidden_act="quick_gelu",
+                attention_dropout=0.0),
+            projection_dim=48)).eval()
+        cfg = CLIPConfig(
+            embed_dim=48, image_size=32, patch_size=8, vocab_size=256,
+            max_text_len=32,
+            vision=CLIPTowerConfig(width=64, num_layers=2, num_heads=4,
+                                   d_ff=128),
+            text=CLIPTowerConfig(width=64, num_layers=2, num_heads=4,
+                                 d_ff=128))
+        m = CLIP.from_params(cfg, jax.tree.map(
+            jnp.asarray, load_hf_clip(cfg, hf.state_dict())))
+        return hf, m
+
+    def test_dual_tower_parity(self):
+        hf, m = self._pair()
+        r = np.random.RandomState(0)
+        imgs = r.randn(2, 32, 32, 3).astype(np.float32)
+        ids = r.randint(1, 250, (3, 10)).astype(np.int64)
+        ids[:, -1] = 255                       # EOT = highest id
+        with torch.no_grad():
+            out = hf(input_ids=torch.tensor(ids),
+                     pixel_values=torch.tensor(
+                         np.transpose(imgs, (0, 3, 1, 2))))
+            # forward() returns NORMALIZED embeds; the unnormalized
+            # tower outputs come from get_*_features
+            img_ref = hf.get_image_features(torch.tensor(
+                np.transpose(imgs, (0, 3, 1, 2)))).numpy()
+            txt_ref = hf.get_text_features(torch.tensor(ids)).numpy()
+        np.testing.assert_allclose(
+            np.asarray(m.encode_image(jnp.asarray(imgs))),
+            img_ref, atol=2e-3, rtol=1e-3)
+        np.testing.assert_allclose(
+            np.asarray(m.encode_text(jnp.asarray(ids))),
+            txt_ref, atol=2e-3, rtol=1e-3)
+        lpi, lpt = m.similarity(jnp.asarray(imgs), jnp.asarray(ids))
+        np.testing.assert_allclose(np.asarray(lpi),
+                                   out.logits_per_image.numpy(),
+                                   atol=5e-3, rtol=1e-3)
+
+    def test_retrieval_smoke(self):
+        """Serving surface: embed a gallery, rank against a query."""
+        _, m = self._pair()
+        r = np.random.RandomState(1)
+        gallery = jnp.asarray(r.randn(4, 32, 32, 3), jnp.float32)
+        q = np.full((1, 8), 5, np.int64); q[0, -1] = 255
+        lpi, _ = m.similarity(gallery, jnp.asarray(q))
+        assert lpi.shape == (4, 1)
+        assert np.isfinite(np.asarray(lpi)).all()
