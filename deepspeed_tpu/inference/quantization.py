@@ -61,6 +61,11 @@ def _quantize_stacked(w: jax.Array, bits: int,
             return quantize_rowwise4(w, contract_dims=contract_dims,
                                      lead_dims=1)
         # odd contraction cannot pack strided halves — grouped fallback
+    if bits == 6 and w.shape[-1] % 4 == 0:
+        # REAL 0.75-byte/weight packed fp6 (reference: fp_quantize.cu);
+        # indivisible trailing dims fall back to the emulated layout
+        from ..ops.quant import quantize_rowwise6
+        return quantize_rowwise6(w, lead_dims=1)
     groups = default_groups(w[0].size)
     if bits in MINIFLOAT_BY_BITS:
         fmt = MINIFLOAT_BY_BITS[bits]

@@ -103,8 +103,6 @@ def _tower_apply(cfg: CLIPConfig, tw: CLIPTowerConfig, blocks, x,
                  causal: bool):
     """Shared pre-LN residual stack (the CLIPEncoderLayer shape):
     x += attn(LN(x)); x += mlp(LN(x)) — scan over stacked layers."""
-    H = tw.num_heads
-    D = tw.width // H
     dt = x.dtype
     norm = lambda p, v: L.layernorm(p, v, eps=cfg.eps)   # noqa: E731
 

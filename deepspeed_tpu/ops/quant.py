@@ -483,7 +483,10 @@ MINIFLOAT_BY_BITS = {6: "fp6_e3m2", 12: "fp12_e4m7"}
 
 
 def dequantize_any(qt: "QuantizedTensor", dtype=None) -> jax.Array:
-    """Dispatch on bit width: grouped-int (4/8) vs minifloat (6/12)."""
+    """Dispatch on layout/bit width: packed row-wise fp6, emulated
+    minifloat (6/12), or grouped/row-wise int (4/8)."""
+    if qt.layout == "rowwise6":
+        return dequantize_rowwise6(qt, dtype)
     if qt.bits in MINIFLOAT_BY_BITS:
         return minifloat_dequantize(qt, dtype)
     return dequantize(qt, dtype)
@@ -528,6 +531,72 @@ def minifloat_dequantize(qt: QuantizedTensor, dtype=None) -> jax.Array:
     mag = tab[jnp.where(code < 0, -code - 1, code)]
     val = jnp.where(code < 0, -mag, mag) * qt.scale
     return val.reshape(qt.shape).astype(dtype or qt.dtype)
+
+
+def _pack_6bit(u: jax.Array) -> jax.Array:
+    """[..., N] 6-bit codes (0..63) → [..., 3N/4] bytes: 4 codes per
+    3 bytes, little-endian bit order."""
+    g = u.astype(jnp.uint32).reshape(*u.shape[:-1], -1, 4)
+    word = (g[..., 0] | (g[..., 1] << 6) | (g[..., 2] << 12)
+            | (g[..., 3] << 18))                     # 24 bits
+    b = jnp.stack([word & 0xFF, (word >> 8) & 0xFF, (word >> 16) & 0xFF],
+                  axis=-1).astype(jnp.uint8)
+    return b.reshape(*u.shape[:-1], -1)
+
+
+def _unpack_6bit(p: jax.Array) -> jax.Array:
+    """[..., 3M] bytes → [..., 4M] 6-bit codes."""
+    b = p.astype(jnp.uint32).reshape(*p.shape[:-1], -1, 3)
+    word = b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16)
+    codes = jnp.stack([word & 0x3F, (word >> 6) & 0x3F,
+                       (word >> 12) & 0x3F, (word >> 18) & 0x3F],
+                      axis=-1)
+    return codes.reshape(*p.shape[:-1], -1).astype(jnp.int32)
+
+
+def quantize_rowwise6(x: jax.Array, lead_dims: int = 0) -> QuantizedTensor:
+    """REAL packed FP6 weight storage — 0.75 byte/element (reference:
+    csrc/fp_quantizer/fp_quantize.cu + the cuda_linear FP6 GEMM's
+    prepacked weights; the emulated :func:`minifloat_quantize` spends a
+    whole int8 per value).  Sign-magnitude e3m2 codes (1+5 bits) packed
+    four-per-three-bytes along the LAST dim, symmetric per-leading-row
+    scales like the other serving layouts.  Trailing dim must divide
+    by 4."""
+    eb, mb, _ = _MINIFLOAT_FORMATS["fp6_e3m2"]
+    table = _minifloat_table(eb, mb)
+    fmax = float(table[-1])
+    orig_shape, orig_dtype = tuple(x.shape), x.dtype
+    assert orig_shape[-1] % 4 == 0, orig_shape
+    assert x.ndim > lead_dims + 1, (
+        "rowwise6 needs at least one data dim beyond the scale rows "
+        f"(shape {orig_shape}, lead_dims={lead_dims})")
+    red = tuple(range(lead_dims + 1, x.ndim))
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=red,
+                    keepdims=False) / fmax
+    scale = jnp.where(scale == 0, 1.0, scale)
+    S = scale.shape[-1]
+    sb = scale.reshape(*scale.shape, *([1] * (x.ndim - lead_dims - 1)))
+    t = x.astype(jnp.float32) / sb
+    mids = jnp.asarray((table[1:] + table[:-1]) / 2.0)
+    mag = jnp.searchsorted(mids, jnp.abs(t)).astype(jnp.uint32)
+    ucode = jnp.where(t < 0, mag | 0x20, mag)        # bit 5 = sign
+    return QuantizedTensor(_pack_6bit(ucode),
+                           scale.reshape(*scale.shape[:lead_dims], S, 1),
+                           None, 6, orig_shape, orig_dtype,
+                           layout="rowwise6")
+
+
+def dequantize_rowwise6(qt: QuantizedTensor, dtype=None) -> jax.Array:
+    out_dt = dtype or qt.dtype
+    eb, mb, _ = _MINIFLOAT_FORMATS["fp6_e3m2"]
+    tab = jnp.asarray(_minifloat_table(eb, mb))
+    codes = _unpack_6bit(qt.data)                    # [..., N]
+    mag = tab[codes & 0x1F]
+    val = jnp.where((codes & 0x20) != 0, -mag, mag)
+    s = qt.scale.reshape(*qt.scale.shape[:-1])       # [*lead, S]
+    val = val.reshape(*s.shape, -1, codes.shape[-1])
+    out = val * s[..., None, None]
+    return out.reshape(qt.shape).astype(out_dt)
 
 
 def selective_dequantize(qt: QuantizedTensor, rows: jax.Array,
