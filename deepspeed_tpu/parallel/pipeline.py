@@ -79,11 +79,26 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r} "
                          "(gpipe | 1f1b)")
-    if cfg.position == "alibi" or cfg.embed_norm:
-        raise ValueError(
-            "pipeline parallelism does not support ALiBi/embed-norm "
-            "models yet (bloom family): the stage embed path has no "
-            "bias/ln_embed plumbing")
+    if cfg.position == "alibi":
+        if sp > 1:
+            # inside the Ulysses shard_map the wrapper would derive
+            # slopes from the LOCAL head count (wrong geometric series)
+            raise ValueError(
+                "pipeline x sequence parallelism does not compose with "
+                "position='alibi' (per-head slopes would be computed "
+                "on the head shard)")
+        if attention_fn is L.causal_attention:
+            # direct callers that never resolved the model's attention:
+            # the ALiBi bias (and any custom attn_scale) must not
+            # silently vanish under PP — mirror _resolve_attention
+            base = L.causal_attention
+            if cfg.attn_scale is not None:
+                s = cfg.attn_scale
+
+                def base(q, k, v, mask=None, **kw):
+                    return L.causal_attention(q, k, v, mask=mask,
+                                              scale=s, **kw)
+            attention_fn = L.make_alibi_attention(base)
 
     if sp > 1:
         if cfg.num_heads % sp or cfg.num_kv_heads % sp:
@@ -134,6 +149,8 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
     def embed_in(shared, ids, pos0, seq_local):
         dt = shared["embed"]["table"].dtype
         x0 = L.embed(shared["embed"], ids).astype(dt)
+        if cfg.embed_norm:          # bloom word_embeddings_layernorm
+            x0 = norm(shared["ln_embed"], x0)
         if cfg.position == "learned":
             tab = lax.dynamic_slice_in_dim(shared["pos_embed"]["table"],
                                            pos0, seq_local)
