@@ -253,8 +253,12 @@ class InferenceEngine:
 
         if self._quant is None:
             shapes = jax.tree.map(lambda x: tuple(x.shape), self.params)
-            specs = shd.tree_specs(self.model.param_axes, topo,
-                                   shapes=shapes)
+            axes = self.model.param_axes
+            if self._stream is not None:
+                # block weights were spilled to the NVMe store; only the
+                # resident remainder needs placement
+                axes = {k: v for k, v in axes.items() if k in self.params}
+            specs = shd.tree_specs(axes, topo, shapes=shapes)
             is_spec = lambda s: isinstance(s, P)   # noqa: E731
             specs = jax.tree.map(
                 lambda s, x: shd.add_fsdp_to_spec(s, tuple(x.shape), topo,
@@ -272,13 +276,14 @@ class InferenceEngine:
         weight_quant) to the NVMe store; the forward streams them back
         one layer at a time.  HBM then holds: embeddings/head/norms, the
         KV cache, and ONE layer's weights."""
-        if self.topology is not None:
-            raise ValueError("weight_stream is single-device (io_callback "
-                             "does not compose with SPMD meshes yet)")
         from .weight_stream import NVMeWeightStore
 
         store = NVMeWeightStore(self.icfg.weight_stream,
                                 self.cfg.num_layers)
+        if self.topology is not None:
+            # SPMD serving: the fetch callback pins to one mesh device;
+            # GSPMD broadcasts each layer to the mesh at first use
+            store.spmd_device = self.topology.mesh.devices.flat[0]
         record: Dict[str, object] = {"dense": self.params.pop("blocks")}
         store.qmeta = None
         if self._quant is not None and self._quant.get("blocks"):

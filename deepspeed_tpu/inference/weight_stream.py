@@ -34,6 +34,11 @@ class NVMeWeightStore:
     # packed row-wise int4)
     mixed_gemm_eligible = False
     qmeta = None
+    # set by the engine for SPMD serving: the fetch callback must pin to
+    # ONE device (XLA's partitioner rejects replicated side-effecting
+    # HLOs and ordered tokens inside sharded loops); the fetched layer
+    # is broadcast to the mesh by GSPMD at its first partitioned use
+    spmd_device = None
 
     def __init__(self, path: str, num_layers: int):
         self.dir = path
@@ -92,6 +97,13 @@ class NVMeWeightStore:
     def fetch_layer(self, li):
         """In-graph: returns this layer's payload pytree (device arrays
         materialized from the host callback)."""
-        flat = jax.experimental.io_callback(
-            self._fetch_host, self._shapes, li, ordered=True)
+        if self.spmd_device is not None:
+            from jax.sharding import SingleDeviceSharding
+            flat = jax.experimental.io_callback(
+                self._fetch_host, self._shapes, li,
+                sharding=SingleDeviceSharding(self.spmd_device),
+                ordered=False)   # pure idempotent reads: order-free
+        else:
+            flat = jax.experimental.io_callback(
+                self._fetch_host, self._shapes, li, ordered=True)
         return jax.tree.unflatten(self._treedef, list(flat))

@@ -148,3 +148,18 @@ def test_tp_kv_quant_parity(devices, model):
     tp_pallas = run(model, icfg(kv_quant="int8", attn_impl="pallas"),
                     topology=topo_tp4_fsdp2(devices))
     assert ref == tp_pallas
+
+
+def test_tp_weight_stream_parity(devices, model, tmp_path):
+    """NVMe per-layer weight streaming under TP (previously a loud
+    single-device reject): the fetch callback pins to one mesh device
+    and GSPMD broadcasts each layer at first use; tokens match the
+    single-device engine exactly (fp and int8, incl. the mixed kernel)."""
+    for name, kw in (("fp", {}),
+                     ("int8", {"weight_quant": "int8"}),
+                     ("mixed", {"weight_quant": "int8",
+                                "mixed_gemm": "on"})):
+        ref = run(model, icfg(**kw))        # same numerics single-device
+        tp = run(model, icfg(weight_stream=str(tmp_path / name), **kw),
+                 topology=topo_tp4_fsdp2(devices))
+        assert tp == ref, name
