@@ -230,3 +230,27 @@ class TestWeightStream:
         out = self._gen(eng, prompts)
         assert eng._mixed_gemm_active
         assert out == ref
+
+
+class TestStreamedMoEServing:
+    def test_streamed_moe_matches_resident(self, tmp_path):
+        """NVMe weight streaming with an MoE model: the streamed layer
+        sweep rebuilds the gate/experts/shared groups and moe_ffn
+        consumes them dense — tokens match the resident engine exactly
+        (fp and int8)."""
+        m = build_model("mixtral-tiny", vocab_size=128, num_layers=2,
+                        d_model=64, num_heads=4, num_kv_heads=2, d_ff=128,
+                        num_experts=4, capacity_factor=4.0,
+                        eval_capacity_factor=4.0)
+        base = dict(token_budget=32, max_seqs=4, kv_block_size=16,
+                    num_kv_blocks=64, param_dtype=jnp.float32,
+                    kv_dtype=jnp.float32)
+        gr = SamplingParams(temperature=0.0, max_new_tokens=5)
+        for name, kw in (("fp", {}), ("int8", {"weight_quant": "int8"})):
+            ref = InferenceEngine(m, InferenceConfig(**base, **kw)
+                                  ).generate({0: [1, 2, 3]}, gr)[0]
+            out = InferenceEngine(
+                m, InferenceConfig(**base, **kw,
+                                   weight_stream=str(tmp_path / name))
+                ).generate({0: [1, 2, 3]}, gr)[0]
+            assert out == ref, name
