@@ -66,6 +66,10 @@ def _quantize_stacked(w: jax.Array, bits: int,
         # indivisible trailing dims fall back to the emulated layout
         from ..ops.quant import quantize_rowwise6
         return quantize_rowwise6(w, lead_dims=1)
+    if bits == 12 and w.shape[-1] % 2 == 0:
+        # packed fp12: 1.5 byte/weight instead of the int16 container
+        from ..ops.quant import quantize_rowwise12
+        return quantize_rowwise12(w, lead_dims=1)
     groups = default_groups(w[0].size)
     if bits in MINIFLOAT_BY_BITS:
         fmt = MINIFLOAT_BY_BITS[bits]

@@ -487,6 +487,8 @@ def dequantize_any(qt: "QuantizedTensor", dtype=None) -> jax.Array:
     minifloat (6/12), or grouped/row-wise int (4/8)."""
     if qt.layout == "rowwise6":
         return dequantize_rowwise6(qt, dtype)
+    if qt.layout == "rowwise12":
+        return dequantize_rowwise12(qt, dtype)
     if qt.bits in MINIFLOAT_BY_BITS:
         return minifloat_dequantize(qt, dtype)
     return dequantize(qt, dtype)
@@ -533,42 +535,53 @@ def minifloat_dequantize(qt: QuantizedTensor, dtype=None) -> jax.Array:
     return val.reshape(qt.shape).astype(dtype or qt.dtype)
 
 
-def _pack_6bit(u: jax.Array) -> jax.Array:
-    """[..., N] 6-bit codes (0..63) → [..., 3N/4] bytes: 4 codes per
-    3 bytes, little-endian bit order."""
-    g = u.astype(jnp.uint32).reshape(*u.shape[:-1], -1, 4)
-    word = (g[..., 0] | (g[..., 1] << 6) | (g[..., 2] << 12)
-            | (g[..., 3] << 18))                     # 24 bits
+def _pack_codes(u: jax.Array, per_word: int, bits: int) -> jax.Array:
+    """[..., N] codes → packed bytes: ``per_word`` codes per 24-bit word
+    (3 bytes), little-endian bit order.  Serves the fp6 (4×6b) and fp12
+    (2×12b) layouts."""
+    g = u.astype(jnp.uint32).reshape(*u.shape[:-1], -1, per_word)
+    word = g[..., 0]
+    for i in range(1, per_word):
+        word = word | (g[..., i] << (bits * i))
     b = jnp.stack([word & 0xFF, (word >> 8) & 0xFF, (word >> 16) & 0xFF],
                   axis=-1).astype(jnp.uint8)
     return b.reshape(*u.shape[:-1], -1)
 
 
-def _unpack_6bit(p: jax.Array) -> jax.Array:
-    """[..., 3M] bytes → [..., 4M] 6-bit codes."""
+def _unpack_codes(p: jax.Array, per_word: int, bits: int) -> jax.Array:
+    """[..., 3M] bytes → [..., per_word*M] codes."""
     b = p.astype(jnp.uint32).reshape(*p.shape[:-1], -1, 3)
     word = b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16)
-    codes = jnp.stack([word & 0x3F, (word >> 6) & 0x3F,
-                       (word >> 12) & 0x3F, (word >> 18) & 0x3F],
-                      axis=-1)
+    mask = (1 << bits) - 1
+    codes = jnp.stack([(word >> (bits * i)) & mask
+                       for i in range(per_word)], axis=-1)
     return codes.reshape(*p.shape[:-1], -1).astype(jnp.int32)
 
 
-def quantize_rowwise6(x: jax.Array, lead_dims: int = 0) -> QuantizedTensor:
-    """REAL packed FP6 weight storage — 0.75 byte/element (reference:
+# (fmt, codes per 24-bit word, code bits, layout tag)
+_PACKED_MINIFLOAT = {
+    "rowwise6": ("fp6_e3m2", 4, 6),
+    "rowwise12": ("fp12_e4m7", 2, 12),
+}
+
+
+def _quantize_rowwise_minifloat(x: jax.Array, layout: str,
+                                lead_dims: int = 0) -> QuantizedTensor:
+    """REAL packed minifloat weight storage (reference:
     csrc/fp_quantizer/fp_quantize.cu + the cuda_linear FP6 GEMM's
-    prepacked weights; the emulated :func:`minifloat_quantize` spends a
-    whole int8 per value).  Sign-magnitude e3m2 codes (1+5 bits) packed
-    four-per-three-bytes along the LAST dim, symmetric per-leading-row
-    scales like the other serving layouts.  Trailing dim must divide
-    by 4."""
-    eb, mb, _ = _MINIFLOAT_FORMATS["fp6_e3m2"]
+    prepacked weights — the emulated :func:`minifloat_quantize` spends a
+    whole integer container per value).  Sign-magnitude codes packed
+    along the LAST dim, symmetric per-leading-row scales like the other
+    serving layouts; fp6 = 0.75 and fp12 = 1.5 bytes/element."""
+    fmt, per_word, bits = _PACKED_MINIFLOAT[layout]
+    eb, mb, _ = _MINIFLOAT_FORMATS[fmt]
     table = _minifloat_table(eb, mb)
     fmax = float(table[-1])
+    sign_bit = 1 << (bits - 1)
     orig_shape, orig_dtype = tuple(x.shape), x.dtype
-    assert orig_shape[-1] % 4 == 0, orig_shape
+    assert orig_shape[-1] % per_word == 0, (orig_shape, per_word)
     assert x.ndim > lead_dims + 1, (
-        "rowwise6 needs at least one data dim beyond the scale rows "
+        f"{layout} needs at least one data dim beyond the scale rows "
         f"(shape {orig_shape}, lead_dims={lead_dims})")
     red = tuple(range(lead_dims + 1, x.ndim))
     scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=red,
@@ -579,24 +592,59 @@ def quantize_rowwise6(x: jax.Array, lead_dims: int = 0) -> QuantizedTensor:
     t = x.astype(jnp.float32) / sb
     mids = jnp.asarray((table[1:] + table[:-1]) / 2.0)
     mag = jnp.searchsorted(mids, jnp.abs(t)).astype(jnp.uint32)
-    ucode = jnp.where(t < 0, mag | 0x20, mag)        # bit 5 = sign
-    return QuantizedTensor(_pack_6bit(ucode),
+    ucode = jnp.where(t < 0, mag | sign_bit, mag)
+    return QuantizedTensor(_pack_codes(ucode, per_word, bits),
                            scale.reshape(*scale.shape[:lead_dims], S, 1),
-                           None, 6, orig_shape, orig_dtype,
-                           layout="rowwise6")
+                           None, eb + mb + 1, orig_shape, orig_dtype,
+                           layout=layout)
 
 
-def dequantize_rowwise6(qt: QuantizedTensor, dtype=None) -> jax.Array:
+def _dequantize_rowwise_minifloat(qt: QuantizedTensor,
+                                  dtype=None) -> jax.Array:
     out_dt = dtype or qt.dtype
-    eb, mb, _ = _MINIFLOAT_FORMATS["fp6_e3m2"]
+    fmt, per_word, bits = _PACKED_MINIFLOAT[qt.layout]
+    eb, mb, _ = _MINIFLOAT_FORMATS[fmt]
     tab = jnp.asarray(_minifloat_table(eb, mb))
-    codes = _unpack_6bit(qt.data)                    # [..., N]
-    mag = tab[codes & 0x1F]
-    val = jnp.where((codes & 0x20) != 0, -mag, mag)
+    sign_bit = 1 << (bits - 1)
+    codes = _unpack_codes(qt.data, per_word, bits)
+    mag = tab[codes & (sign_bit - 1)]
+    val = jnp.where((codes & sign_bit) != 0, -mag, mag)
     s = qt.scale.reshape(*qt.scale.shape[:-1])       # [*lead, S]
     val = val.reshape(*s.shape, -1, codes.shape[-1])
     out = val * s[..., None, None]
     return out.reshape(qt.shape).astype(out_dt)
+
+
+def quantize_rowwise6(x: jax.Array, lead_dims: int = 0) -> QuantizedTensor:
+    return _quantize_rowwise_minifloat(x, "rowwise6", lead_dims)
+
+
+def dequantize_rowwise6(qt: QuantizedTensor, dtype=None) -> jax.Array:
+    return _dequantize_rowwise_minifloat(qt, dtype)
+
+
+def quantize_rowwise12(x: jax.Array, lead_dims: int = 0) -> QuantizedTensor:
+    return _quantize_rowwise_minifloat(x, "rowwise12", lead_dims)
+
+
+def dequantize_rowwise12(qt: QuantizedTensor, dtype=None) -> jax.Array:
+    return _dequantize_rowwise_minifloat(qt, dtype)
+
+
+def _pack_6bit(u):
+    return _pack_codes(u, 4, 6)
+
+
+def _unpack_6bit(p):
+    return _unpack_codes(p, 4, 6)
+
+
+def _pack_12bit(u):
+    return _pack_codes(u, 2, 12)
+
+
+def _unpack_12bit(p):
+    return _unpack_codes(p, 2, 12)
 
 
 def selective_dequantize(qt: QuantizedTensor, rows: jax.Array,

@@ -368,3 +368,41 @@ class TestPackedFP6:
                            SamplingParams(temperature=0.0,
                                           max_new_tokens=6))
         assert len(out[0]) == 6
+
+
+class TestPackedFP12:
+    def test_pack_unpack_lossless(self):
+        from deepspeed_tpu.ops.quant import _pack_12bit, _unpack_12bit
+        u = jnp.arange(4096, dtype=jnp.uint32)[None]
+        assert bool((_unpack_12bit(_pack_12bit(u))
+                     == u.astype(jnp.int32)).all())
+
+    def test_roundtrip_size_and_serving(self):
+        import numpy as np
+        from deepspeed_tpu.inference import (InferenceConfig,
+                                             InferenceEngine,
+                                             SamplingParams)
+        from deepspeed_tpu.models import build_model
+        from deepspeed_tpu.ops.quant import (dequantize_rowwise12,
+                                             quantize_rowwise12)
+        w = jnp.asarray(np.random.RandomState(0).randn(3, 40, 64),
+                        jnp.float32)
+        qt = quantize_rowwise12(w, lead_dims=1)
+        assert qt.layout == "rowwise12"
+        assert qt.data.shape == (3, 40, 96)     # 1.5 byte/element
+        err = float(jnp.abs(dequantize_rowwise12(qt, jnp.float32)
+                            - w).max() / jnp.abs(w).max())
+        assert err < 0.01, err                  # e4m7 precision
+        m = build_model("llama-tiny", vocab_size=128, num_layers=2,
+                        d_model=64, num_heads=4, num_kv_heads=2,
+                        d_ff=128, max_seq_len=128)
+        base = dict(token_budget=32, max_seqs=4, kv_block_size=16,
+                    num_kv_blocks=64, param_dtype=jnp.float32,
+                    kv_dtype=jnp.float32)
+        gr = SamplingParams(temperature=0.0, max_new_tokens=8)
+        ref = InferenceEngine(m, InferenceConfig(**base)).generate(
+            {0: [5, 17, 99, 3]}, gr)[0]
+        out = InferenceEngine(m, InferenceConfig(**base,
+                                                 weight_quant="fp12")
+                              ).generate({0: [5, 17, 99, 3]}, gr)[0]
+        assert out == ref      # 11-bit sign-mag codes: greedy-exact here
