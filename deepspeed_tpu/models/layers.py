@@ -90,15 +90,27 @@ def alibi_slopes(num_heads: int) -> jnp.ndarray:
     return jnp.asarray(slopes, jnp.float32)
 
 
-def make_alibi_attention(base=None):
+def make_alibi_attention(base=None, head_offset=None,
+                         total_heads: Optional[int] = None):
     """Wrap an attention fn with the ALiBi bias.  Uses the key-position
     form ``slope_h * j`` (the query-position term is constant per softmax
-    row and cancels) — exactly HF Bloom's ``build_alibi_tensor``."""
+    row and cancels) — exactly HF Bloom's ``build_alibi_tensor``.
+
+    Under manual head sharding (Ulysses inside ``shard_map``) the local
+    head block is a SLICE of the global geometric slope series:
+    ``total_heads`` fixes the global head count and ``head_offset`` (a
+    zero-arg callable, e.g. ``lambda: axis_index(seq) * H_local``)
+    locates this shard's first head.  Default: local heads ARE the
+    global heads."""
     base_fn = base or causal_attention
 
     def attn(q, k, v, mask=None, **kw):
-        H, Sk = q.shape[2], k.shape[1]
-        bias = alibi_slopes(H)[:, None, None] \
+        Hl, Sk = q.shape[2], k.shape[1]
+        slopes = alibi_slopes(total_heads or Hl)
+        if head_offset is not None:
+            slopes = jax.lax.dynamic_slice_in_dim(
+                slopes, head_offset(), Hl)
+        bias = slopes[:, None, None] \
             * jnp.arange(Sk, dtype=jnp.float32)[None, None, :]
         return base_fn(q, k, v, mask=mask, bias=bias, **kw)
     return attn

@@ -81,13 +81,14 @@ def make_pipelined_loss_fn(cfg: TransformerConfig, topology: MeshTopology,
                          "(gpipe | 1f1b)")
     if cfg.position == "alibi":
         if sp > 1:
-            # inside the Ulysses shard_map the wrapper would derive
-            # slopes from the LOCAL head count (wrong geometric series)
-            raise ValueError(
-                "pipeline x sequence parallelism does not compose with "
-                "position='alibi' (per-head slopes would be computed "
-                "on the head shard)")
-        if attention_fn is L.causal_attention:
+            # replace the model's plain ALiBi wrapper: under the
+            # pipeline's manual seq axis the bias must slice the GLOBAL
+            # slope series at this shard's head offset (the sp>1 branch
+            # below then wraps it with the per-shard Ulysses a2a)
+            from .sequence import make_ulysses_alibi_base
+            attention_fn = make_ulysses_alibi_base(
+                cfg.num_heads, sp, attn_scale=cfg.attn_scale)
+        elif attention_fn is L.causal_attention:
             # direct callers that never resolved the model's attention:
             # the ALiBi bias (and any custom attn_scale) must not
             # silently vanish under PP — mirror _resolve_attention

@@ -177,14 +177,54 @@ def make_ring_attention(topology: MeshTopology, causal: bool = True
     return attn
 
 
+def make_ulysses_alibi_base(num_heads: int, sp: int, tp: int = 1,
+                            attn_scale=None) -> Callable:
+    """ALiBi base attention for INSIDE a Ulysses ``shard_map``: after
+    the head-scatter a2a each rank owns a contiguous slice of the global
+    head set, so the slopes must be the matching slice of the global
+    geometric series — offset = tensor_block + seq_sub_block.
+    ``attn_scale``: a custom softmax scale (cfg.attn_scale) — rebuilt
+    here because this path bypasses the model's resolved wrapper."""
+    from ..models import layers as L
+
+    h_tp = num_heads // tp
+    h_local = h_tp // sp
+
+    def head_offset():
+        off = lax.axis_index(SEQ_AXIS) * h_local
+        if tp > 1:
+            off = off + lax.axis_index(TENSOR_AXIS) * h_tp
+        return off
+
+    base = None
+    if attn_scale is not None:
+        def base(q, k, v, mask=None, **kw):
+            return causal_attention(q, k, v, mask=mask, scale=attn_scale,
+                                    **kw)
+
+    return L.make_alibi_attention(base, head_offset=head_offset,
+                                  total_heads=num_heads)
+
+
 def make_attention(topology: MeshTopology, mode: str = "ulysses",
-                   base_attention: Callable = causal_attention) -> Callable:
-    """(reference config: sequence_parallel.mode)."""
+                   base_attention: Callable = causal_attention,
+                   alibi_heads: int = 0, alibi_scale=None) -> Callable:
+    """(reference config: sequence_parallel.mode).  ``alibi_heads``:
+    global head count of an ALiBi model — builds the head-offset-aware
+    bias inside the Ulysses shard_map (ring mode has no bias operand)."""
     if topology.sp_size == 1:
         return base_attention
     if mode == "ulysses":
+        if alibi_heads:
+            base_attention = make_ulysses_alibi_base(
+                alibi_heads, topology.sp_size, topology.tp_size,
+                attn_scale=alibi_scale)
         return make_ulysses_attention(topology, base_attention)
     if mode == "ring":
+        if alibi_heads:
+            raise ValueError("sequence_parallel.mode='ring' has no "
+                             "additive-bias operand for ALiBi models; "
+                             "use mode='ulysses'")
         return make_ring_attention(topology)
     raise ValueError(f"Unknown sequence-parallel mode {mode!r}")
 

@@ -1901,15 +1901,15 @@ def initialize(loss_fn: Callable = None,
         # Ulysses/ring wrapper over this run's mesh
         seq_size = max(cfg.mesh.seq, cfg.sequence_parallel.size)
         pipe_size = max(cfg.mesh.pipe, cfg.pipeline.stages)
-        if seq_size > 1 and getattr(getattr(model, "config", None),
-                                    "position", None) == "alibi":
-            # inside the Ulysses shard_map the wrapper would derive
-            # slopes from the LOCAL head count (wrong geometric series);
-            # ring mode drops the bias entirely — reject loudly
+        is_alibi = getattr(getattr(model, "config", None),
+                           "position", None) == "alibi"
+        if seq_size > 1 and is_alibi \
+                and cfg.sequence_parallel.mode == "ring":
+            # ring attention carries no additive-bias operand
             raise ConfigError(
-                "sequence parallelism does not compose with "
-                "position='alibi' (per-head slopes would be computed on "
-                "the head shard, not the global head set)")
+                "sequence_parallel.mode='ring' does not compose with "
+                "position='alibi'; use mode='ulysses' (head-offset-aware "
+                "slopes inside the a2a shard_map)")
         # seq parallel WITHOUT pipeline: swap attention in the plain loss.
         # With pipeline, make_pipelined_loss_fn composes seq itself.
         if loss_fn is None and seq_size > 1 and pipe_size == 1 \
@@ -1918,9 +1918,19 @@ def initialize(loss_fn: Callable = None,
             from ..models.transformer import lm_loss_fn
 
             topology = topology or MeshTopology.build(cfg.mesh)
-            base = getattr(model, "attention_fn", None)
+            kw = {}
+            if is_alibi:
+                # bypass the model's plain ALiBi wrapper: the bias must
+                # be built INSIDE the Ulysses shard_map with this
+                # shard's global head offset
+                kw["alibi_heads"] = model.config.num_heads
+                kw["alibi_scale"] = model.config.attn_scale
+            else:
+                base = getattr(model, "attention_fn", None)
+                if base is not None:
+                    kw["base_attention"] = base
             attn = make_attention(topology, cfg.sequence_parallel.mode,
-                                  **({"base_attention": base} if base else {}))
+                                  **kw)
             loss_fn = lm_loss_fn(model.config, attn)
         # pipeline parallelism (gpipe/1f1b) over the pipe axis; seq > 1
         # composes via per-shard Ulysses inside the pipeline shard_map

@@ -294,11 +294,14 @@ class TestBloomPipeline:
                   for _ in range(4)]
         assert losses[-1] < losses[0]
 
-    def test_alibi_seq_still_rejected(self):
-        from deepspeed_tpu.config.config import ConfigError
+    def test_alibi_pipe_x_seq_composes(self):
+        """ALiBi now composes with pipe x seq (head-offset-aware slopes
+        inside the per-shard Ulysses a2a); parity covered in
+        test_sequence_parallel.TestAlibiSequenceParallel."""
         m = self._model()
-        with pytest.raises((ConfigError, ValueError), match="alibi"):
-            ds.initialize(model=m, config=base_cfg(
-                mesh={"data": 1, "pipe": 2, "seq": 2},
-                pipeline={"stages": 2, "num_microbatches": 2},
-                sequence_parallel={"size": 2}))
+        eng = ds.initialize(model=m, config=base_cfg(
+            mesh={"data": 2, "pipe": 2, "seq": 2},
+            pipeline={"stages": 2, "num_microbatches": 2},
+            sequence_parallel={"size": 2}))
+        ids = np.random.RandomState(0).randint(0, 128, (8, 32))
+        assert np.isfinite(float(eng.eval_batch({"input_ids": ids})))
