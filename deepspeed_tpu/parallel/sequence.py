@@ -95,12 +95,15 @@ def make_ulysses_attention(topology: MeshTopology,
 # Ring attention (context parallelism over ICI neighbor links)
 # --------------------------------------------------------------------------
 
-def _block_attn_update(q, k, v, o, m, l, row0, col0, causal, scale):
+def _block_attn_update(q, k, v, o, m, l, row0, col0, causal, scale,
+                       slopes=None):
     """Flash-style streaming-softmax update for one KV block.
 
     q [B,s,H,D] holds global rows [row0, row0+s); k/v [B,s,Hkv,D] global
     cols [col0, col0+s).  o/m/l are the running output, row-max and
-    row-sum (fp32).  Returns updated (o, m, l).
+    row-sum (fp32).  ``slopes``: optional ALiBi per-local-head slopes
+    [Hkv, rep] — the bias is slope * GLOBAL key position, which the ring
+    formulation has by construction (col0).  Returns updated (o, m, l).
     """
     B, S, H, D = q.shape
     Hkv = k.shape[2]
@@ -108,9 +111,13 @@ def _block_attn_update(q, k, v, o, m, l, row0, col0, causal, scale):
     qg = q.reshape(B, S, Hkv, rep, D)
     logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k) * scale
     logits = logits.astype(jnp.float32)
+    cols = col0 + jnp.arange(k.shape[1])
+    if slopes is not None:
+        logits = logits + (slopes[None, :, :, None, None]
+                           * cols[None, None, None, None, :]
+                           .astype(jnp.float32))
     if causal:
         rows = row0 + jnp.arange(S)
-        cols = col0 + jnp.arange(k.shape[1])
         keep = rows[:, None] >= cols[None, :]
         logits = jnp.where(keep[None, None, None], logits, -1e30)
 
@@ -124,29 +131,48 @@ def _block_attn_update(q, k, v, o, m, l, row0, col0, causal, scale):
     return new_o, new_m, new_l
 
 
-def make_ring_attention(topology: MeshTopology, causal: bool = True
-                        ) -> Callable:
+def make_ring_attention(topology: MeshTopology, causal: bool = True,
+                        alibi_heads: int = 0,
+                        attn_scale=None) -> Callable:
     """Blockwise ring attention: Q stays put, KV blocks rotate around the
     ``seq`` axis via ``ppermute`` while a streaming softmax accumulates —
     O(S/sp) memory per device, neighbor-only ICI traffic, arbitrary
     sequence lengths (the >1M-token regime Ulysses alone cannot reach
-    because its head split caps sp at num_heads)."""
+    because its head split caps sp at num_heads).  ``alibi_heads``: the
+    global head count of an ALiBi model — the bias (slope * global key
+    position) folds into each block update; heads stay unsplit on the
+    seq axis here, but a tensor head split slices the slope series."""
     mesh = topology.mesh
     sp = topology.sp_size
     if sp == 1:
+        if alibi_heads:
+            from ..models.layers import make_alibi_attention
+            return make_alibi_attention()
         return causal_attention
+    default_scale = attn_scale
 
     def attn(q, k, v, mask=None, scale=None):
         if mask is not None:
             raise NotImplementedError(
                 "ring attention currently supports causal masking only")
-        scale_ = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+        scale_ = scale if scale is not None else default_scale
+        scale_ = scale_ if scale_ is not None \
+            else 1.0 / math.sqrt(q.shape[-1])
 
         def local(q, k, v):
             B, s, H, D = q.shape
             Hkv = k.shape[2]
             idx = lax.axis_index(SEQ_AXIS)
             row0 = idx * s
+
+            slopes = None
+            if alibi_heads:
+                from ..models.layers import alibi_slopes
+                sl = alibi_slopes(alibi_heads)
+                if H != alibi_heads:   # tensor axis split the heads
+                    off = lax.axis_index(TENSOR_AXIS) * H
+                    sl = lax.dynamic_slice_in_dim(sl, off, H)
+                slopes = sl.reshape(Hkv, H // Hkv)
 
             o = jnp.zeros((B, Hkv, H // Hkv, s, D), jnp.float32)
             m = jnp.full((B, Hkv, H // Hkv, s), -jnp.inf, jnp.float32)
@@ -157,7 +183,8 @@ def make_ring_attention(topology: MeshTopology, causal: bool = True
                 o, m, l, k, v = carry
                 src = (idx - i) % sp          # global block we hold now
                 o, m, l = _block_attn_update(
-                    q, k, v, o, m, l, row0, src * s, causal, scale_)
+                    q, k, v, o, m, l, row0, src * s, causal, scale_,
+                    slopes=slopes)
                 k = lax.ppermute(k, SEQ_AXIS, perm)
                 v = lax.ppermute(v, SEQ_AXIS, perm)
                 return o, m, l, k, v
@@ -210,8 +237,9 @@ def make_attention(topology: MeshTopology, mode: str = "ulysses",
                    base_attention: Callable = causal_attention,
                    alibi_heads: int = 0, alibi_scale=None) -> Callable:
     """(reference config: sequence_parallel.mode).  ``alibi_heads``:
-    global head count of an ALiBi model — builds the head-offset-aware
-    bias inside the Ulysses shard_map (ring mode has no bias operand)."""
+    global head count of an ALiBi model — Ulysses builds the
+    head-offset-aware bias inside its shard_map; ring folds
+    slope * global-key-position into each block update."""
     if topology.sp_size == 1:
         return base_attention
     if mode == "ulysses":
@@ -221,11 +249,8 @@ def make_attention(topology: MeshTopology, mode: str = "ulysses",
                 attn_scale=alibi_scale)
         return make_ulysses_attention(topology, base_attention)
     if mode == "ring":
-        if alibi_heads:
-            raise ValueError("sequence_parallel.mode='ring' has no "
-                             "additive-bias operand for ALiBi models; "
-                             "use mode='ulysses'")
-        return make_ring_attention(topology)
+        return make_ring_attention(topology, alibi_heads=alibi_heads,
+                                   attn_scale=alibi_scale)
     raise ValueError(f"Unknown sequence-parallel mode {mode!r}")
 
 

@@ -1903,13 +1903,6 @@ def initialize(loss_fn: Callable = None,
         pipe_size = max(cfg.mesh.pipe, cfg.pipeline.stages)
         is_alibi = getattr(getattr(model, "config", None),
                            "position", None) == "alibi"
-        if seq_size > 1 and is_alibi \
-                and cfg.sequence_parallel.mode == "ring":
-            # ring attention carries no additive-bias operand
-            raise ConfigError(
-                "sequence_parallel.mode='ring' does not compose with "
-                "position='alibi'; use mode='ulysses' (head-offset-aware "
-                "slopes inside the a2a shard_map)")
         # seq parallel WITHOUT pipeline: swap attention in the plain loss.
         # With pipeline, make_pipelined_loss_fn composes seq itself.
         if loss_fn is None and seq_size > 1 and pipe_size == 1 \

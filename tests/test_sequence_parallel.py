@@ -194,10 +194,30 @@ class TestAlibiSequenceParallel:
                 {"input_ids": ids}))
         assert pps == pytest.approx(ref, rel=1e-3)
 
-    def test_ring_alibi_rejected(self):
+    def test_ring_alibi_matches_dp(self):
+        """Ring attention folds slope * GLOBAL key position into each
+        block update (col0 is global by construction)."""
         import deepspeed_tpu as ds
-        from deepspeed_tpu.config.config import ConfigError
-        with pytest.raises((ConfigError, ValueError), match="alibi"):
-            ds.initialize(model=self._model(), config=self._cfg(
-                mesh={"data": 4, "seq": 2},
-                sequence_parallel={"size": 2, "mode": "ring"}))
+        m = self._model()
+        ids = np.random.RandomState(0).randint(0, 128, (8, 32))
+        ref = float(ds.initialize(model=m, config=self._cfg(
+            mesh={"data": 8})).eval_batch({"input_ids": ids}))
+        ring = float(ds.initialize(model=m, config=self._cfg(
+            mesh={"data": 4, "seq": 2},
+            sequence_parallel={"size": 2, "mode": "ring"})).eval_batch(
+                {"input_ids": ids}))
+        assert ring == pytest.approx(ref, rel=1e-3)
+
+    def test_ring_alibi_tp_matches_dp(self):
+        """ring + ALiBi + tensor head split: the slope series slices at
+        the tensor-axis head offset inside the ring shard_map."""
+        import deepspeed_tpu as ds
+        m = self._model()
+        ids = np.random.RandomState(0).randint(0, 128, (8, 32))
+        ref = float(ds.initialize(model=m, config=self._cfg(
+            mesh={"data": 8})).eval_batch({"input_ids": ids}))
+        ring_tp = float(ds.initialize(model=m, config=self._cfg(
+            mesh={"data": 2, "seq": 2, "tensor": 2},
+            sequence_parallel={"size": 2, "mode": "ring"})).eval_batch(
+                {"input_ids": ids}))
+        assert ring_tp == pytest.approx(ref, rel=1e-3)
