@@ -96,7 +96,7 @@ def make_ulysses_attention(topology: MeshTopology,
 # --------------------------------------------------------------------------
 
 def _block_attn_update(q, k, v, o, m, l, row0, col0, causal, scale,
-                       slopes=None):
+                       slopes=None, kv_mask=None):
     """Flash-style streaming-softmax update for one KV block.
 
     q [B,s,H,D] holds global rows [row0, row0+s); k/v [B,s,Hkv,D] global
@@ -120,6 +120,9 @@ def _block_attn_update(q, k, v, o, m, l, row0, col0, causal, scale,
         rows = row0 + jnp.arange(S)
         keep = rows[:, None] >= cols[None, :]
         logits = jnp.where(keep[None, None, None], logits, -1e30)
+    if kv_mask is not None:                     # [B, s] padding mask of
+        logits = jnp.where(                     # the block we hold now
+            kv_mask[:, None, None, None, :].astype(bool), logits, -1e30)
 
     blk_max = logits.max(axis=-1)                        # [B,Hkv,rep,q]
     new_m = jnp.maximum(m, blk_max)
@@ -152,14 +155,13 @@ def make_ring_attention(topology: MeshTopology, causal: bool = True,
     default_scale = attn_scale
 
     def attn(q, k, v, mask=None, scale=None):
-        if mask is not None:
-            raise NotImplementedError(
-                "ring attention currently supports causal masking only")
         scale_ = scale if scale is not None else default_scale
         scale_ = scale_ if scale_ is not None \
             else 1.0 / math.sqrt(q.shape[-1])
+        have_mask = mask is not None
 
-        def local(q, k, v):
+        def local(q, k, v, *mk):
+            mask = mk[0] if mk else None
             B, s, H, D = q.shape
             Hkv = k.shape[2]
             idx = lax.axis_index(SEQ_AXIS)
@@ -180,26 +182,40 @@ def make_ring_attention(topology: MeshTopology, causal: bool = True,
             perm = [(i, (i + 1) % sp) for i in range(sp)]
 
             def body(i, carry):
-                o, m, l, k, v = carry
+                # the padding mask (when present) rotates with its KV
+                # block; without one the carry omits it entirely — no
+                # dead ppermute on the common unmasked path (have_mask
+                # is a trace-time constant)
+                o, m, l, k, v = carry[:5]
+                km = carry[5] if have_mask else None
                 src = (idx - i) % sp          # global block we hold now
                 o, m, l = _block_attn_update(
                     q, k, v, o, m, l, row0, src * s, causal, scale_,
-                    slopes=slopes)
+                    slopes=slopes, kv_mask=km)
                 k = lax.ppermute(k, SEQ_AXIS, perm)
                 v = lax.ppermute(v, SEQ_AXIS, perm)
-                return o, m, l, k, v
+                nxt = (o, m, l, k, v)
+                if have_mask:
+                    nxt = nxt + (lax.ppermute(km, SEQ_AXIS, perm),)
+                return nxt
 
-            o, m, l, _, _ = lax.fori_loop(0, sp, body, (o, m, l, k, v))
+            init = (o, m, l, k, v) + ((mask,) if have_mask else ())
+            o, m, l = lax.fori_loop(0, sp, body, init)[:3]
             out = o / jnp.maximum(l, 1e-30)[..., None]
             # [B,Hkv,rep,s,D] -> [B,s,H,D]
             out = out.transpose(0, 3, 1, 2, 4).reshape(B, s, H, D)
             return out.astype(q.dtype)
 
         qspec = P(BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, None)
+        in_specs = [qspec, qspec, qspec]
+        operands = [q, k, v]
+        if have_mask:
+            in_specs.append(P(BATCH_AXES, SEQ_AXIS))
+            operands.append(mask)
         return shard_map(local, mesh=mesh,
-                         in_specs=(qspec, qspec, qspec),
+                         in_specs=tuple(in_specs),
                          out_specs=qspec,
-                         check_vma=False)(q, k, v)
+                         check_vma=False)(*operands)
 
     return attn
 

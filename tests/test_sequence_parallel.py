@@ -221,3 +221,28 @@ class TestAlibiSequenceParallel:
             sequence_parallel={"size": 2, "mode": "ring"})).eval_batch(
                 {"input_ids": ids}))
         assert ring_tp == pytest.approx(ref, rel=1e-3)
+
+
+class TestRingPaddingMask:
+    def test_padded_batch_matches_dp(self):
+        """Ring attention with an attention_mask (previously
+        NotImplementedError): the padding mask rotates around the ring
+        with its KV block and folds into each streaming update."""
+        m = build_model("llama-tiny", vocab_size=128, num_layers=4,
+                        d_model=64, num_heads=8, num_kv_heads=4,
+                        d_ff=176, max_seq_len=32, seed=3)
+        cfg = lambda **o: {  # noqa: E731
+            "train_micro_batch_size_per_device": 4,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "steps_per_print": 1000, **o}
+        ids = np.random.RandomState(0).randint(1, 128, (8, 32))
+        mask = np.ones_like(ids, np.float32)
+        mask[:, 24:] = 0.0
+        batch = {"input_ids": ids, "attention_mask": mask}
+        ref = float(ds.initialize(model=m, config=cfg(
+            mesh={"data": 8})).eval_batch(batch))
+        ring = float(ds.initialize(model=m, config=cfg(
+            mesh={"data": 4, "seq": 2},
+            sequence_parallel={"size": 2, "mode": "ring"})).eval_batch(
+                batch))
+        assert ring == pytest.approx(ref, rel=1e-3)
