@@ -202,8 +202,9 @@ def evaluate(exp: Experiment, model_fn: Callable[[str], Any],
             exp.flops_per_step = stats.get("flops")
             if stats.get("peak_bytes"):
                 exp.peak_bytes = int(stats["peak_bytes"])
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("autotune: cost analysis failed (%r); "
+                         "ranking on wall clock only", e)
         # timed region is device-only — host-side batch synthesis must
         # not distort the ranking
         for _ in range(max(warmup - 1, 0)):
@@ -214,7 +215,9 @@ def evaluate(exp: Experiment, model_fn: Callable[[str], Any],
             m = eng.train_batch(staged)
         float(np.asarray(m["loss"]))
         exp.step_time_s = (time.perf_counter() - t0) / steps
-    except Exception as e:  # OOM / unsupported combo / compile failure
+    # recorded, not swallowed: the tuner loop log_dist's every FAILED
+    # experiment with this error string
+    except Exception as e:  # tpulint: disable=silent-except
         exp.error = f"{type(e).__name__}: {str(e).splitlines()[0][:160]}"
     return exp
 

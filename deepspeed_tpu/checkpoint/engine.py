@@ -339,7 +339,8 @@ class AsyncCheckpointSaver:
             self.wait()
         except BaseException as e:          # best-effort at exit
             import sys
-            print(f"async checkpoint failed at exit: {e!r}",
+            # logging may already be torn down at interpreter exit
+            print(f"async checkpoint failed at exit: {e!r}",  # tpulint: disable=print
                   file=sys.stderr)
 
     def submit(self, host_state, ckpt_dir: str, extra: Dict,
@@ -357,7 +358,9 @@ class AsyncCheckpointSaver:
                     with open(os.path.join(save_dir, LATEST), "w") as f:
                         f.write(tag)
                 log_dist(f"async-saved checkpoint {ckpt_dir}")
-            except BaseException as e:          # surfaced on next wait()
+            # deliberately deferred: re-raised to the caller on the next
+            # wait()/submit(), so the failure is never lost
+            except BaseException as e:  # tpulint: disable=silent-except
                 self._error = e
 
         self._thread = threading.Thread(target=work, daemon=False,

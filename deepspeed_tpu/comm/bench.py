@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..compat import shard_map
 from .comms_logging import calc_bw_log, convert_size
 
 OPS = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all",
@@ -49,7 +50,7 @@ def _build_op(op: str, mesh, axis: str):
     shard = NamedSharding(mesh, P(axis))
 
     def wrap(body, in_spec):
-        f = jax.shard_map(body, mesh=mesh, in_specs=in_spec,
+        f = shard_map(body, mesh=mesh, in_specs=in_spec,
                           out_specs=in_spec, check_vma=False)
         return jax.jit(f), (repl if in_spec == P() else shard)
 
@@ -96,7 +97,7 @@ def sweep(ops: List[str], min_pow: int = 12, max_pow: int = 26,
     out: List[Dict] = []
     for op in ops:
         fn, in_sh = _build_op(op, mesh, axis)
-        if print_table:
+        if print_table:  # tpulint: disable-file=print — bench CLI table output
             print(f"\n---- {op} over {n} devices "
                   f"({jax.devices()[0].platform}) ----")
             print(f"{'size':>10} {'latency':>12} {'algbw Gbps':>12} "
@@ -122,9 +123,11 @@ def sweep(ops: List[str], min_pow: int = 12, max_pow: int = 26,
             lat = (time.perf_counter() - t0) / trials
             size_bytes = elems * dt.itemsize
             algbw, busbw = calc_bw_log(op, size_bytes, lat, n)
+            # 4 decimals: sub-0.01 Gbps links (emulated meshes, tunneled
+            # chips) must not quantize to a 0.0 record
             rec = dict(op=op, bytes=size_bytes, latency_us=lat * 1e6,
-                       algbw_gbps=round(algbw, 2),
-                       busbw_gbps=round(busbw, 2), devices=n)
+                       algbw_gbps=round(algbw, 4),
+                       busbw_gbps=round(busbw, 4), devices=n)
             out.append(rec)
             if print_table:
                 print(f"{convert_size(size_bytes):>10} "

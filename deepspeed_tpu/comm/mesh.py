@@ -25,6 +25,7 @@ highest-bandwidth-hungry axes sit innermost.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
 from typing import Dict, Optional, Sequence, Tuple
 
@@ -165,8 +166,11 @@ def _arrange_devices(devices: Sequence[jax.Device], shape: Tuple[int, ...],
             return mesh_utils.create_hybrid_device_mesh(
                 ici_shape, dcn_shape, devices=devices)
         return mesh_utils.create_device_mesh(shape, devices=devices)
-    except Exception:
-        # CPU emulation or exotic topologies: row-major is fine
+    except Exception as e:
+        # CPU emulation or exotic topologies: row-major is fine — but say
+        # so; a silently degraded device order costs ICI bandwidth on TPU
+        log_dist(f"mesh_utils arrangement unavailable ({type(e).__name__}: "
+                 f"{e}); using row-major device order", level=logging.DEBUG)
         return np.asarray(devices).reshape(shape)
 
 

@@ -1,0 +1,66 @@
+"""JAX version compatibility shims.
+
+The framework is written against the modern ``jax.shard_map`` API
+(jax >= 0.6: ``check_vma``, partial-manual ``axis_names``).  Older
+releases only ship ``jax.experimental.shard_map.shard_map`` with the
+``check_rep`` spelling and the inverted ``auto=`` (axes NOT manual)
+parameter.  Every ``shard_map`` in this package imports from here so the
+whole tree runs unmodified on either API.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:                                    # jax >= 0.6
+    from jax import shard_map as _shard_map
+except ImportError:                     # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# the top-level export and the check_rep->check_vma rename did NOT land
+# in the same release — key the kwarg spelling on the actual signature,
+# not on where the function imported from
+import inspect
+
+_MODERN = "check_vma" in inspect.signature(_shard_map).parameters
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis from inside traced code.
+    ``jax.lax.axis_size`` where it exists; the classic constant-folded
+    ``psum(1, axis)`` spelling elsewhere."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
+              axis_names=None):
+    """``jax.shard_map`` with the modern keyword surface on any jax.
+
+    ``axis_names``: the mesh axes the body is manual over (all axes when
+    None) — translated to the legacy ``auto=`` complement on old jax.
+    """
+    kwargs = {}
+    if _MODERN:
+        kwargs["check_vma"] = check_vma
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+    else:
+        kwargs["check_rep"] = check_vma
+        if axis_names is not None:
+            auto = (frozenset(mesh.axis_names) - frozenset(axis_names))
+            # legacy partial-manual (`auto=`) is buggy: it silently
+            # mis-reduces replicated outputs and CHECK-crashes (an
+            # uncatchable process abort) on real auto sharding.  Size-1
+            # axes shard nothing — drop them and run full-manual; a real
+            # auto axis must refuse loudly HERE, not crash in XLA.
+            auto = frozenset(a for a in auto if mesh.shape[a] > 1)
+            if auto:
+                raise NotImplementedError(
+                    f"partial-manual shard_map (auto axes {sorted(auto)})"
+                    " is unreliable on legacy jaxlib 0.4.x: it silently "
+                    "mis-reduces or CHECK-crashes the compiler; upgrade "
+                    "jax or make the region fully manual")
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)

@@ -41,15 +41,17 @@ def main() -> int:
             if lim:
                 lines.append(_row("device memory", GREEN_OK,
                                   f"{lim / 2**30:.1f} GiB"))
-        except Exception:
+        # optional-info probe: absence of the row is the report
+        except Exception:  # tpulint: disable=silent-except
             pass
         try:
             devs[0].memory("pinned_host")
             lines.append(_row("pinned_host memory", GREEN_OK,
                               "(ZeRO-Offload capable)"))
-        except Exception:
+        # failure surfaces as the RED_NO row in the printed report
+        except Exception:  # tpulint: disable=silent-except
             lines.append(_row("pinned_host memory", RED_NO))
-    except Exception as e:
+    except Exception as e:  # tpulint: disable=silent-except
         lines.append(_row("jax", RED_NO, str(e)))
 
     for mod in ("flax", "optax", "orbax.checkpoint", "chex", "einops",
@@ -58,7 +60,7 @@ def main() -> int:
             m = __import__(mod)
             ver = getattr(m, "__version__", "?")
             lines.append(_row(mod, GREEN_OK, ver))
-        except Exception:
+        except Exception:  # tpulint: disable=silent-except
             lines.append(_row(mod, RED_NO))
 
     # native op builders (reference: op compatibility table in ds_report)
@@ -75,11 +77,11 @@ def main() -> int:
         if ok:
             b.load()
             lines.append(_row("async_io build", GREEN_OK))
-    except Exception as e:
+    except Exception as e:  # tpulint: disable=silent-except
         lines.append(_row("async_io build", RED_NO, str(e)[:60]))
 
     lines.append("-" * 60)
-    print("\n".join(lines))
+    print("\n".join(lines))  # tpulint: disable=print — the report IS the output
     return 0
 
 

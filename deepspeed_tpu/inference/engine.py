@@ -302,12 +302,15 @@ class InferenceEngine:
             record["quant"] = qarrays
             store.qmeta = qmeta
             # mixed-gemm eligibility: row-wise int8 (weight-shaped) or
-            # packed row-wise int4 per-layer payloads; expert weights
-            # don't count — moe_ffn always consumes them dense
+            # packed row-wise int4 per-layer payloads; expert and
+            # shared-expert weights don't count — the forward always
+            # consumes them dense
             from ..ops.quant import is_mixed_gemm_layout
+            from .quantization import DENSE_ONLY_GROUPS
             store.mixed_gemm_eligible = all(
                 is_mixed_gemm_layout(qt)
-                for gname, grp in qblocks.items() if gname != "experts"
+                for gname, grp in qblocks.items()
+                if gname not in DENSE_ONLY_GROUPS
                 for qt in grp.values())
         store.spill(record)
         self._stream = store
@@ -536,14 +539,16 @@ class InferenceEngine:
         """The mixed-input kernel family consumes the row-wise int8
         (weight-shaped payload) and packed row-wise int4 layouts.
         Only the weights the ``_mm`` projection sites consume count:
-        expert weights (dense in moe_ffn) and the embedding table
-        (dequantized once per step) are always dequantized regardless."""
+        expert/shared-expert weights (dense in moe_ffn/_shared_expert)
+        and the embedding table (dequantized once per step) are always
+        dequantized regardless."""
         from ..ops.quant import QuantizedTensor, is_mixed_gemm_layout
+        from .quantization import DENSE_ONLY_GROUPS
         if self._quant is None:
             return False
         blocks = {k: v for k, v in
                   (self._quant.get("blocks") or {}).items()
-                  if k != "experts"}
+                  if k not in DENSE_ONLY_GROUPS}
         leaves = [x for x in jax.tree.leaves(
             blocks, is_leaf=lambda x: isinstance(x, QuantizedTensor))
             if isinstance(x, QuantizedTensor)]

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..compat import shard_map
 from ..models import layers as L
 from ..models.transformer import TransformerConfig, _norm
 from .ragged.state import RaggedBatch
@@ -121,7 +122,7 @@ def _paged_attention_pallas(kv_layer, q, batch: RaggedBatch,
         in_specs.append(P(TENSOR_AXIS, None))   # slopes [Hkv, rep] split
         operands.append(jnp.asarray(slopes, jnp.float32).reshape(
             _kv_parts(kv_layer)[0].shape[3], -1))   # with the kv heads
-    f = jax.shard_map(
+    f = shard_map(
         lambda kvl, qq, ss, pos, bt, *sl: paged_attention(
             kvl, qq, ss, pos, bt, block_size, max_blocks_per_seq, scale,
             slopes=sl[0] if sl else None),
@@ -248,6 +249,7 @@ def _stream_layer(stream, li, dt, mixed_gemm: bool = False):
     if "quant" in rec:
         from ..ops.quant import (QuantizedTensor, dequantize_any,
                                  is_mixed_gemm_layout)
+        from .quantization import DENSE_ONLY_GROUPS
         for gname, grp in rec["quant"].items():
             g = dict(lp.get(gname, {}))
             for name, arrs in grp.items():
@@ -255,7 +257,7 @@ def _stream_layer(stream, li, dt, mixed_gemm: bool = False):
                 qt = QuantizedTensor(arrs["data"], arrs["scale"],
                                      arrs.get("zero"), bits, shp, odt,
                                      layout=layout)
-                if mixed_gemm and gname != "experts" \
+                if mixed_gemm and gname not in DENSE_ONLY_GROUPS \
                         and is_mixed_gemm_layout(qt):
                     g[name] = qt
                 else:
@@ -267,7 +269,12 @@ def _stream_layer(stream, li, dt, mixed_gemm: bool = False):
 def _mm(x, w, dt, contract_dims: int = 1):
     """``x @ w`` where ``w`` is dense — or a row-wise QuantizedTensor,
     routed through the mixed-input VMEM-dequant kernel
-    (ops/mixed_gemm.py; reference: cuda_linear fp6_linear.cu)."""
+    (ops/mixed_gemm.py; reference: cuda_linear fp6_linear.cu).
+
+    Always returns ``dt``: a wider activation (e.g. the attention output
+    under an f32 KV cache with bf16 weights) must not promote the
+    residual stream past the serving dtype — the scan carry is ``dt``,
+    and the mixed-GEMM branch emits ``dt`` unconditionally."""
     from ..ops.quant import QuantizedTensor
     if isinstance(w, QuantizedTensor):
         from ..ops.mixed_gemm import mixed_matmul
@@ -275,7 +282,7 @@ def _mm(x, w, dt, contract_dims: int = 1):
                             out_dtype=dt)
     wshape = w.shape
     K = int(np.prod(wshape[:contract_dims]))
-    y = x.reshape(-1, K) @ w.reshape(K, -1).astype(dt)
+    y = (x.reshape(-1, K) @ w.reshape(K, -1).astype(dt)).astype(dt)
     return y.reshape(*x.shape[:-1], *wshape[contract_dims:])
 
 

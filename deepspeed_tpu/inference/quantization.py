@@ -37,6 +37,13 @@ from ..ops.quant import (MINIFLOAT_BY_BITS, QuantizedTensor,
 # weights eligible for quantization inside a block (2D+ matmul operands)
 _BLOCK_WEIGHTS = ("wq", "wk", "wv", "wo", "wi", "wg")
 
+# block groups whose weights are consumed DENSE by the serving forward —
+# "experts" feeds moe_ffn's ragged/scatter dispatch, "shared" feeds the
+# qwen2-moe sigmoid-gated shared expert (models/transformer._shared_expert,
+# plain ``@`` matmuls) — so they must never reach a mixed-input GEMM as
+# QuantizedTensors, and they don't count toward mixed-GEMM eligibility
+DENSE_ONLY_GROUPS = ("experts", "shared")
+
 
 def _quantize_stacked(w: jax.Array, bits: int,
                       contract_dims: int = 1) -> QuantizedTensor:
@@ -157,9 +164,10 @@ def merge_layer(lp: Dict[str, Any], quant_blocks: Dict[str, Any], i,
     for group_name, qgroup in quant_blocks.items():
         g = dict(out.get(group_name, {}))
         for name, qt in qgroup.items():
-            # expert weights are consumed DENSE by moe_ffn's ragged/
-            # scatter dispatch — never hand it a QuantizedTensor
-            if mixed and group_name != "experts" \
+            # expert/shared-expert weights are consumed DENSE (moe_ffn's
+            # ragged dispatch, _shared_expert's plain matmuls) — never
+            # hand them a QuantizedTensor
+            if mixed and group_name not in DENSE_ONLY_GROUPS \
                     and is_mixed_gemm_layout(qt):
                 g[name] = layer_qt(qt, i)
             else:

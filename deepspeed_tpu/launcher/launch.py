@@ -81,8 +81,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if as_subprocess:
         argv.remove("--as_subprocess")
     if not argv:
-        print("usage: python -m deepspeed_tpu.launcher.launch script.py ...",
-              file=sys.stderr)
+        print(  # tpulint: disable=print — CLI usage text
+            "usage: python -m deepspeed_tpu.launcher.launch script.py ...",
+            file=sys.stderr)
         return 2
     script, *script_args = argv
 

@@ -34,7 +34,19 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _mixed_kernel(x_ref, d_ref, s_ref, o_ref, acc_ref):
+def _round_bf16(w, interpret: bool):
+    """Force the dequantized tile to MATERIALIZE as bf16.
+
+    Interpret mode runs the kernel body as ordinary traced XLA ops, and
+    XLA fuses the bf16 dequant multiply straight into the f32 dot —
+    skipping the bf16 rounding the MXU feed applies on hardware.  An
+    optimization barrier pins the intermediate, so interpret-tested
+    numerics match the real kernel (and the bf16 XLA reference paths
+    the engine probes against).  No-op on real TPUs."""
+    return jax.lax.optimization_barrier(w) if interpret else w
+
+
+def _mixed_kernel(x_ref, d_ref, s_ref, o_ref, acc_ref, *, interpret):
     """One (bm, bn) output tile; grid dim 2 walks the K blocks."""
     k = pl.program_id(2)
 
@@ -45,7 +57,8 @@ def _mixed_kernel(x_ref, d_ref, s_ref, o_ref, acc_ref):
     # dequant IN VMEM: int8 tile -> bf16, scaled per contraction row.
     # bf16 keeps the MXU on its native input width; the f32 accumulator
     # carries the precision.
-    w = d_ref[...].astype(jnp.bfloat16) * s_ref[...].astype(jnp.bfloat16)
+    w = _round_bf16(d_ref[...].astype(jnp.bfloat16)
+                    * s_ref[...].astype(jnp.bfloat16), interpret)
     acc_ref[...] += jax.lax.dot(
         x_ref[...].astype(jnp.bfloat16), w,
         preferred_element_type=jnp.float32)
@@ -94,7 +107,7 @@ def mixed_matmul_2d(x: jax.Array, data: jax.Array, scale: jax.Array,
     scale2 = scale.reshape(K, 1)
 
     out = pl.pallas_call(
-        _mixed_kernel,
+        functools.partial(_mixed_kernel, interpret=interpret),
         grid=(Mp // block_m, N // bn, K // bk),
         in_specs=[
             pl.BlockSpec((block_m, bk), lambda i, j, k: (i, k)),
@@ -109,7 +122,8 @@ def mixed_matmul_2d(x: jax.Array, data: jax.Array, scale: jax.Array,
     return out[:M] if Mp != M else out
 
 
-def _mixed4_kernel(x1_ref, x2_ref, d_ref, s1_ref, s2_ref, o_ref, acc_ref):
+def _mixed4_kernel(x1_ref, x2_ref, d_ref, s1_ref, s2_ref, o_ref, acc_ref,
+                   *, interpret):
     """Packed-int4 tile: the byte block unpacks IN VMEM into the two
     strided contraction halves (lo nibble = flat row j, hi = j + K/2 —
     ops/quant.quantize_rowwise4), each fed to its own MXU dot against
@@ -122,8 +136,10 @@ def _mixed4_kernel(x1_ref, x2_ref, d_ref, s1_ref, s2_ref, o_ref, acc_ref):
 
     from .quant import unpack_nibbles
     lo, hi = unpack_nibbles(d_ref[...])
-    w1 = lo.astype(jnp.bfloat16) * s1_ref[...].astype(jnp.bfloat16)
-    w2 = hi.astype(jnp.bfloat16) * s2_ref[...].astype(jnp.bfloat16)
+    w1 = _round_bf16(lo.astype(jnp.bfloat16)
+                     * s1_ref[...].astype(jnp.bfloat16), interpret)
+    w2 = _round_bf16(hi.astype(jnp.bfloat16)
+                     * s2_ref[...].astype(jnp.bfloat16), interpret)
     acc_ref[...] += jax.lax.dot(
         x1_ref[...].astype(jnp.bfloat16), w1,
         preferred_element_type=jnp.float32)
@@ -158,7 +174,7 @@ def mixed4_matmul_2d(x: jax.Array, data: jax.Array, scale: jax.Array,
     scale2 = scale.reshape(K, 1)
 
     out = pl.pallas_call(
-        _mixed4_kernel,
+        functools.partial(_mixed4_kernel, interpret=interpret),
         grid=(Mp // block_m, N // bn, nk),
         in_specs=[
             pl.BlockSpec((block_m, bk), lambda i, j, k: (i, k)),

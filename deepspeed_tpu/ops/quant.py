@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..compat import axis_size
+
 
 @jax.tree_util.register_pytree_node_class
 class QuantizedTensor:
@@ -297,7 +299,7 @@ def quantized_psum_scatter(x: jax.Array, axis_name: str, bits: int = 8,
     locally (reference: all_to_all_quant_reduce
     runtime/comm/coalesced_collectives.py + quant_reduce.cu).  Wire bytes:
     int8/int4 instead of fp32 — 4-8x less reduce traffic."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     assert x.shape[0] % n == 0, (x.shape, n)
     chunks = x.reshape(n, x.shape[0] // n, *x.shape[1:])
     if num_groups is None:
@@ -346,7 +348,7 @@ def quantized_all_reduce(x: jax.Array, axis_name: str,
     per element on the wire instead of 4 fp32 (reference: the fallback
     ``all_to_all_quant_reduce`` path of coalesced_collectives.py for
     tensors every rank keeps whole)."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if x.ndim == 0 or x.shape[0] % n:
         return jax.lax.psum(x, axis_name)
     red = quantized_psum_scatter(x, axis_name, bits=bits)

@@ -82,8 +82,8 @@ def analyze_fn(fn: Callable, *args, static_argnums=()) -> Dict[str, float]:
                 getattr(mem, "temp_size_in_bytes", 0) +
                 getattr(mem, "argument_size_in_bytes", 0) +
                 getattr(mem, "output_size_in_bytes", 0))
-    except Exception:
-        pass
+    except Exception as e:  # backends without memory analysis
+        logger.debug("memory_analysis unavailable: %r", e)
     return out
 
 

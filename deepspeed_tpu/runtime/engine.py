@@ -34,6 +34,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..comm.mesh import DATA_AXIS, FSDP_AXIS, MeshTopology
+from ..compat import shard_map
 from ..comm.collectives import init_distributed
 from ..config.config import Config, ConfigError, load_config
 from ..parallel.zero import ZeroPolicy
@@ -370,7 +371,7 @@ class Engine:
         try:
             jax.devices()[0].memory("pinned_host")
             return True
-        except Exception:
+        except Exception:  # tpulint: disable=silent-except — capability probe
             return False
 
     def _opt_state_shardings(self, opt_state, master):
@@ -776,7 +777,7 @@ class Engine:
 
         # check_vma can't statically prove the all_gather output is
         # replicated along the gathered axes
-        return jax.shard_map(local, mesh=self.topology.mesh,
+        return shard_map(local, mesh=self.topology.mesh,
                              in_specs=mspec, out_specs=pspec,
                              check_vma=False)(p)
 
@@ -844,6 +845,20 @@ class Engine:
             # region
             self._degrade(f"{feature} is not composable with pipeline "
                           "or sequence parallelism yet")
+            return ()
+        from ..compat import _MODERN
+        if not _MODERN and (self.zero.stage >= 3
+                            or sizes.get("tensor", 1) > 1
+                            or sizes.get("expert", 1) > 1):
+            # jaxlib 0.4.x CHECK-crashes (uncatchable process abort) in
+            # backend_compile on partial-manual shard_map programs whose
+            # auto region carries real sharding (stage-3 param gathers,
+            # tensor-parallel layers, expert-parallel MoE grads); loud
+            # stop instead of a crash (compat.shard_map also refuses)
+            self._degrade(f"{feature} does not compose with zero stage 3, "
+                          "tensor or expert parallelism on legacy jaxlib "
+                          "(XLA CHECK-crashes compiling the partial-manual"
+                          " reduction); upgrade jax")
             return ()
         axes = []
         if sizes.get(DATA_AXIS, 1) > 1:
@@ -991,7 +1006,7 @@ class Engine:
                                  is_leaf=lambda x: isinstance(x, tuple))
             return m_hat, e_new
 
-        m_hat, new_err = jax.shard_map(
+        m_hat, new_err = shard_map(
             local, mesh=mesh,
             in_specs=(spec_in, spec_in, rep),
             out_specs=(rep, spec_in),
@@ -1069,7 +1084,7 @@ class Engine:
 
         def manual_grads(cparams, batch, rng, scale):
             mb_specs = jax.tree.map(lambda _: batch_spec, batch)
-            return jax.shard_map(
+            return shard_map(
                 local, mesh=mesh,
                 in_specs=(p_in, mb_specs, P(), P()),
                 out_specs=(P(), P(), g_out),
@@ -1132,7 +1147,7 @@ class Engine:
             new_o = put(new_o, o_host, jax.memory.Space.Device)
             return new_m, new_o
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=self.topology.mesh,
             in_specs=(self.master_specs, opt_specs, self.master_specs,
                       P(), P()),

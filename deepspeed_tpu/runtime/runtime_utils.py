@@ -91,11 +91,13 @@ def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
 
 def see_memory_usage(message: str = "", force: bool = False) -> dict:
     """Device memory stats (reference: see_memory_usage runtime/utils.py:771)."""
+    from ..utils.logging import logger
     stats = {}
     for d in jax.local_devices():
         try:
             s = d.memory_stats()
-        except Exception:
+        except Exception as e:
+            logger.debug("memory_stats unavailable on %s: %r", d, e)
             s = None
         if s:
             stats[str(d.id)] = {
@@ -104,7 +106,6 @@ def see_memory_usage(message: str = "", force: bool = False) -> dict:
                 "bytes_limit": s.get("bytes_limit", 0),
             }
     if force and stats:
-        from ..utils.logging import logger
         total = sum(v["bytes_in_use"] for v in stats.values())
         peak = sum(v["peak_bytes_in_use"] for v in stats.values())
         logger.info("%s | mem in_use=%.2fGB peak=%.2fGB", message,

@@ -36,7 +36,8 @@ def _process_index() -> int:
         import jax
 
         return jax.process_index()
-    except Exception:  # jax.distributed not initialized or jax unavailable
+    # logging bootstrap: this helper runs inside the logger itself
+    except Exception:  # tpulint: disable=silent-except
         return 0
 
 

@@ -7,12 +7,19 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu import compat as _compat
 import deepspeed_tpu as ds
+from deepspeed_tpu.compat import shard_map
 from deepspeed_tpu.ops.quant import (QuantizedTensor, dequantize, quantize,
                                      quantized_all_gather,
                                      quantized_psum_scatter,
                                      quantized_reduction)
 from tests.simple_model import make_batch, make_mlp
+
+# jaxlib 0.4.x CHECK-crashes (process abort, not a catchable error) in
+# backend_compile on the stage-3 qgZ partial-manual shard_map program;
+# modern jax compiles it fine
+_LEGACY_JAX = not _compat._MODERN
 
 
 class TestQuantize:
@@ -36,14 +43,19 @@ class TestQuantize:
         assert q4.data.size == q8.data.size // 2
 
     def test_stochastic_rounding_unbiased(self):
-        x = jnp.full((4096,), 0.3)
+        # one max element pins the scale at 0.01/code; the rest sit
+        # exactly mid-step (t = 30.5), where the rounding mode is
+        # actually observable — a constant 0.3 quantizes to code 127
+        # exactly and both modes agree
+        x = jnp.full((4096,), 0.305).at[0].set(1.27)
         qt = quantize(x, bits=8, num_groups=1, stochastic=True,
                       rng=jax.random.PRNGKey(2))
-        y = dequantize(qt)
-        # deterministic rounding would give a constant; stochastic must
-        # average out near the true value
-        assert abs(float(y.mean()) - 0.3) < 0.01
+        y = dequantize(qt)[1:]
+        # deterministic rounding would give a constant (std 0) biased by
+        # half a step; stochastic dithers between the two codes and
+        # averages out near the true value
         assert float(y.std()) > 0
+        assert abs(float(y.mean()) - 0.305) < 0.002
 
     def test_quantized_reduction(self):
         xs = [jax.random.normal(jax.random.PRNGKey(i), (256,))
@@ -62,7 +74,7 @@ class TestQuantizedCollectives:
         def local(v):
             return quantized_all_gather(v, "fsdp", bits=8, gather_dim=0)
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(shard_map(
             local, mesh=fsdp8.mesh, in_specs=P("fsdp"),
             out_specs=P(), check_vma=False))(sharded)
         np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=0.05)
@@ -78,7 +90,7 @@ class TestQuantizedCollectives:
             return quantized_psum_scatter(v[0], "fsdp", bits=8,
                                           num_groups=8)
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(shard_map(
             local, mesh=fsdp8.mesh, in_specs=P("fsdp"),
             out_specs=P("fsdp"), check_vma=False))(stacked)
         want = xs.sum(0)
@@ -110,8 +122,13 @@ class TestZeroPP:
     @pytest.mark.parametrize("stage,mesh", [
         (1, {"fsdp": 8}),
         (2, {"data": 2, "fsdp": 4}),
-        (3, {"data": 2, "fsdp": 4}),
-        (2, {"data": 2, "fsdp": 2, "tensor": 2}),   # TP stays auto-sharded
+        pytest.param(3, {"data": 2, "fsdp": 4}, marks=pytest.mark.skipif(
+            _LEGACY_JAX, reason="XLA CHECK-crash compiling stage-3 qgZ "
+            "on jaxlib 0.4.x")),
+        pytest.param(2, {"data": 2, "fsdp": 2, "tensor": 2},  # TP auto-sharded
+                     marks=pytest.mark.skipif(
+            _LEGACY_JAX, reason="XLA CHECK-crash compiling qgZ with a "
+            "tensor-parallel auto axis on jaxlib 0.4.x")),
     ])
     def test_qgz_trains_close_to_exact(self, stage, mesh):
         """qgZ: the gradient reduction runs through the int8 reduce-scatter
@@ -224,7 +241,7 @@ class TestOnebitAllReduce:
             out, new_err = onebit_all_reduce(g[0], "dp", err[0])
             return out[None], new_err[None]
 
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(shard_map(
             local, mesh=mesh, in_specs=(P("dp"), P("dp")),
             out_specs=(P("dp"), P("dp")), check_vma=False))
         err = jnp.zeros((8, 40), jnp.float32)

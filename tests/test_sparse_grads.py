@@ -8,6 +8,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 import deepspeed_tpu as ds
+from deepspeed_tpu.compat import shard_map
 from deepspeed_tpu.runtime.sparse_grads import (default_capacity,
                                                 is_sparse_leaf, sparse_psum)
 
@@ -22,7 +23,7 @@ class TestSparsePsum:
         def local(g):
             return sparse_psum(g[0], "dp", capacity)[None]
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(shard_map(
             local, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
             check_vma=False))(g)
         return np.asarray(out[0])

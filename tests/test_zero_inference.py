@@ -196,8 +196,13 @@ class TestWeightStream:
         m = build_model("llama-tiny", vocab_size=128, num_layers=3,
                         d_model=32, num_heads=4, num_kv_heads=2, d_ff=64,
                         max_seq_len=64)
+        # pin the GEMM path: the probe may legitimately pick mixed for
+        # one engine and dequant for the other (their cost profiles
+        # differ), and the two paths differ in bf16 rounding — the
+        # variable under test is the streaming machinery, nothing else
         kw = dict(token_budget=16, max_seqs=2, kv_block_size=8,
                   num_kv_blocks=32, attn_impl="xla", weight_quant="int8",
+                  mixed_gemm="off",
                   param_dtype=jnp.float32, kv_dtype=jnp.float32)
         prompts = {0: [5, 17, 99, 3], 1: [8, 9]}
         ref = self._gen(InferenceEngine(m, InferenceConfig(**kw)), prompts)
@@ -217,9 +222,13 @@ class TestWeightStream:
             return build_model("llama-tiny", vocab_size=128, num_layers=3,
                                d_model=32, num_heads=4, num_kv_heads=2,
                                d_ff=64, max_seq_len=64)
+        # bf16 serving dtype: the mixed kernel's MXU feed is bf16 by
+        # construction, so the dequant reference must run the same
+        # precision for exact greedy parity (at f32 the reference keeps
+        # unrounded weights the kernel never sees — on real TPUs too)
         kw = dict(token_budget=16, max_seqs=2, kv_block_size=8,
                   num_kv_blocks=32, attn_impl="xla", weight_quant="int8",
-                  param_dtype=jnp.float32, kv_dtype=jnp.float32)
+                  param_dtype=jnp.bfloat16, kv_dtype=jnp.float32)
         prompts = {0: [5, 17, 99, 3], 1: [8, 9]}
         ref = self._gen(InferenceEngine(mk(), InferenceConfig(
             weight_stream=str(tmp_path / "wd"), mixed_gemm="off", **kw)),
@@ -254,3 +263,52 @@ class TestStreamedMoEServing:
                                    weight_stream=str(tmp_path / name))
                 ).generate({0: [1, 2, 3]}, gr)[0]
             assert out == ref, name
+
+
+class TestSharedExpertQuantServing:
+    """qwen2-moe regression: the dense 'shared' expert group is consumed
+    by plain matmuls (models/transformer._shared_expert), so the
+    mixed-GEMM path must dequantize it like 'experts' — previously
+    mixed_gemm='on' crashed at trace time handing _shared_expert a
+    QuantizedTensor, and 'auto' silently disabled the kernel when the
+    probe swallowed that crash."""
+
+    def _model(self):
+        return build_model(
+            "qwen2-moe-tiny", vocab_size=128, num_layers=2, d_model=64,
+            num_heads=4, num_kv_heads=2, d_ff=96, moe_shared_ff=128,
+            max_seq_len=256, capacity_factor=4.0, eval_capacity_factor=4.0)
+
+    def _kw(self):
+        return dict(token_budget=32, max_seqs=4, kv_block_size=16,
+                    num_kv_blocks=64, param_dtype=jnp.float32,
+                    kv_dtype=jnp.float32, weight_quant="int8")
+
+    def test_shared_group_still_mixed_eligible(self):
+        eng = InferenceEngine(self._model(), InferenceConfig(**self._kw()))
+        assert "shared" in eng._quant["blocks"]      # it IS quantized...
+        assert eng._quant_is_rowwise()               # ...but doesn't veto
+
+    def test_mixed_on_traces_and_matches_dequant(self):
+        gr = SamplingParams(temperature=0.0, max_new_tokens=5)
+        prompt = {0: [1, 2, 3, 4]}
+        ref = InferenceEngine(
+            self._model(), InferenceConfig(mixed_gemm="off", **self._kw())
+        ).generate(prompt, gr)[0]
+        eng = InferenceEngine(
+            self._model(), InferenceConfig(mixed_gemm="on", **self._kw()))
+        out = eng.generate(prompt, gr)[0]
+        assert eng._mixed_gemm_active
+        assert out == ref
+
+    def test_streamed_mixed_on(self, tmp_path):
+        gr = SamplingParams(temperature=0.0, max_new_tokens=5)
+        prompt = {0: [1, 2, 3, 4]}
+        ref = InferenceEngine(
+            self._model(), InferenceConfig(mixed_gemm="off", **self._kw())
+        ).generate(prompt, gr)[0]
+        eng = InferenceEngine(self._model(), InferenceConfig(
+            mixed_gemm="on", weight_stream=str(tmp_path / "w"),
+            **self._kw()))
+        assert eng._stream.mixed_gemm_eligible
+        assert eng.generate(prompt, gr)[0] == ref

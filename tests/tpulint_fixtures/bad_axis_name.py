@@ -1,0 +1,20 @@
+"""Known-bad: collective axis names absent from the mesh
+(tpulint: axis-name — valid vocabulary comes from comm/mesh.py)."""
+import jax
+from jax import lax
+
+
+def grad_sync(g):
+    return lax.psum(g, "model")             # BAD: no "model" mesh axis
+
+
+def gather(x):
+    return lax.all_gather(x, axis_name="tp", axis=0, tiled=True)  # BAD
+
+
+def rank():
+    return lax.axis_index("stage")          # BAD: "stage" not a mesh axis
+
+
+def mixed(v):
+    return lax.pmean(v, ("data", "shard"))  # BAD: "shard" invalid

@@ -1,0 +1,24 @@
+"""Known-bad: buffer reuse after donation (tpulint: donated-reuse)."""
+import jax
+import jax.numpy as jnp
+
+
+def step(params, kv, batch):
+    return kv + batch, params
+
+
+def serve(params, batch):
+    step_fn = jax.jit(step, donate_argnums=(1,))
+    kv = jnp.zeros((4, 4))
+    logits, _ = step_fn(params, kv, batch)
+    return logits + kv                  # BAD: kv was donated above
+
+
+class Engine:
+    def __init__(self):
+        self.kv = jnp.zeros((4, 4))
+
+    def run(self, params, batch):
+        fn = jax.jit(step, donate_argnums=(1,))
+        out, _ = fn(params, self.kv, batch)
+        return out * self.kv.sum()      # BAD: self.kv donated, not rebound

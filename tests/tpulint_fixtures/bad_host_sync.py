@@ -1,0 +1,32 @@
+"""Known-bad: host syncs inside jit-traced code (tpulint: host-sync)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+
+@jax.jit
+def decorated(x):
+    return x.sum().item()               # BAD: .item() inside jit
+
+
+@partial(jax.jit, static_argnames=("n",))
+def partial_decorated(x, n):
+    s = float(jnp.sum(x))               # BAD: float() on traced value
+    return x * s + n
+
+
+def wrapped(x):
+    return np.asarray(x) * 2            # BAD: traced value -> host numpy
+
+
+def helper(x):
+    return int(jnp.argmax(x))           # BAD: called from a jit root
+
+
+def root(x):
+    return helper(x)
+
+
+wrapped_jit = jax.jit(wrapped)
+root_jit = jax.jit(root)

@@ -1,0 +1,10 @@
+"""Known-bad: stray stdout/debugger use in library code (tpulint: print)."""
+import pdb                              # BAD: debugger import
+
+
+def train_step(x):
+    print("step", x)                    # BAD: print in library code
+    if x < 0:
+        pdb.set_trace()                 # BAD: debugger call
+    breakpoint()                        # BAD: debugger call
+    return x

@@ -1,0 +1,23 @@
+"""Known-bad: swallowed exceptions (tpulint: silent-except)."""
+
+
+def probe(fn, x):
+    try:
+        return fn(x), True
+    except Exception:                   # BAD: silent fallback
+        return None, False
+
+
+def load(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except:                             # BAD: bare except  # noqa: E722
+        return ""
+
+
+def best_effort(cleanup):
+    try:
+        cleanup()
+    except BaseException:               # BAD: swallows even SystemExit
+        pass

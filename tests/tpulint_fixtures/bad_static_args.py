@@ -1,0 +1,20 @@
+"""Known-bad: broken jit static args (tpulint: static-args)."""
+import jax
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("block_sz",))
+def kernel(x, block_size):              # BAD: "block_sz" is not a param
+    return x * block_size
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def configured(x, opts={"mode": "fast"}):   # BAD: unhashable static default
+    return x
+
+
+def scale(x, factor=2):
+    return x * factor
+
+
+scaled = jax.jit(scale, static_argnums=(5,))    # BAD: out of range

@@ -1,0 +1,24 @@
+"""Known-good twin of bad_axis_name (no axis-name findings)."""
+import jax
+from jax import lax
+from jax.sharding import Mesh
+
+LOCAL_AXIS = "rows"                         # file-local axis constant
+
+
+def grad_sync(g):
+    return lax.psum(g, "data")              # declared in comm/mesh.py
+
+
+def gather(x):
+    return lax.all_gather(x, axis_name="tensor", axis=0, tiled=True)
+
+
+def toy(devices):
+    mesh = Mesh(devices, ("rows", "cols"))  # file-local mesh vocabulary
+    del mesh
+    return lax.axis_index("cols")
+
+
+def local(v):
+    return lax.pmean(v, ("fsdp", LOCAL_AXIS))   # variables aren't checked

@@ -1,0 +1,24 @@
+"""Known-good twin of bad_donated_reuse (no donated-reuse findings)."""
+import jax
+import jax.numpy as jnp
+
+
+def step(params, kv, batch):
+    return kv + batch, kv * 2
+
+
+def serve(params, batch):
+    step_fn = jax.jit(step, donate_argnums=(1,))
+    kv = jnp.zeros((4, 4))
+    logits, kv = step_fn(params, kv, batch)     # rebound: fresh buffer
+    return logits + kv
+
+
+class Engine:
+    def __init__(self):
+        self.kv = jnp.zeros((4, 4))
+
+    def run(self, params, batch):
+        fn = jax.jit(step, donate_argnums=(1,))
+        out, self.kv = fn(params, self.kv, batch)   # rebound in the call
+        return out * self.kv.sum()

@@ -1,0 +1,33 @@
+"""Known-good twin of bad_host_sync (no host-sync findings)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+
+@jax.jit
+def decorated(x):
+    return jnp.sum(x)                   # stays on device
+
+
+@partial(jax.jit, static_argnames=("n",))
+def partial_decorated(x, n):
+    k = int(np.prod(x.shape))           # shape arithmetic is static
+    return x * k + n
+
+
+def host_side(x):
+    # NOT jit-traced: syncing here is the caller's business
+    return float(np.asarray(x).sum())
+
+
+def fetch(i):
+    return np.asarray(i) + 1            # host callback body: host is fine
+
+
+def streamed(x):
+    y = jax.pure_callback(fetch, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+    return y * 2
+
+
+streamed_jit = jax.jit(streamed)

@@ -1,0 +1,14 @@
+"""Known-good twin of bad_print (no print findings)."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def train_step(x):
+    logger.info("step %s", x)
+    return x
+
+
+def report(lines):
+    # explicit CLI output, pragma'd as intentional
+    print("\n".join(lines))  # tpulint: disable=print

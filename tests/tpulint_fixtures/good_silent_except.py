@@ -1,0 +1,34 @@
+"""Known-good twin of bad_silent_except (no silent-except findings)."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def probe(fn, x):
+    try:
+        return fn(x), True
+    except Exception as e:
+        logger.warning("probe failed (%s); falling back", e)
+        return None, False
+
+
+def load(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except OSError:                     # narrow handler: fine silent
+        return ""
+
+
+def wrapped(fn):
+    try:
+        return fn()
+    except Exception as e:
+        raise RuntimeError("fn failed") from e
+
+
+def intentional(fn):
+    try:
+        return fn()
+    except Exception:  # tpulint: disable=silent-except
+        return None

@@ -1,0 +1,20 @@
+"""Known-good twin of bad_static_args (no static-args findings)."""
+import jax
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("block_size",))
+def kernel(x, block_size):
+    return x * block_size
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def configured(x, mode="fast"):         # hashable static default
+    return x
+
+
+def scale(x, factor=2):
+    return x * factor
+
+
+scaled = jax.jit(scale, static_argnums=(1,))

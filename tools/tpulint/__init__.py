@@ -1,0 +1,15 @@
+"""tpulint — a JAX/TPU-aware static-analysis pass for this framework.
+
+Pure-AST (no target imports, no JAX needed): runs in milliseconds on
+CPU-only CI.  See docs/TPULINT.md for the rule catalog and suppression
+syntax.
+
+    python -m tools.tpulint deepspeed_tpu tests
+"""
+
+from .core import (Finding, RULES, collect_files, find_mesh_axes,
+                   lint_file, lint_paths, rule)
+from . import rules as _rules  # noqa: F401  (register the builtin rules)
+
+__all__ = ["Finding", "RULES", "collect_files", "find_mesh_axes",
+           "lint_file", "lint_paths", "rule"]

@@ -1,0 +1,56 @@
+"""CLI: ``python -m tools.tpulint [paths...]``.
+
+Exits non-zero when any finding survives suppression — wire it straight
+into CI (tests/test_tpulint.py runs it over the whole tree as tier-1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import RULES, find_mesh_axes, lint_paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpulint",
+        description="JAX/TPU-aware static analysis (pure AST, no "
+                    "imports of the target modules)")
+    ap.add_argument("paths", nargs="*", default=["deepspeed_tpu", "tests"],
+                    help="files or directories to lint "
+                         "(default: deepspeed_tpu tests)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as a JSON array")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of rules to run")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name, r in sorted(RULES.items()):
+            scope = " [library-only]" if r.library_only else ""
+            print(f"{name}{scope}: {r.doc}")
+        return 0
+
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    paths = args.paths or ["deepspeed_tpu", "tests"]
+    findings = lint_paths(paths, rules=rules)
+
+    if args.as_json:
+        print(json.dumps([f.json() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.human())
+        n = len(findings)
+        axes = sorted(find_mesh_axes(paths))
+        print(f"tpulint: {n} finding{'s' if n != 1 else ''} "
+              f"({len(RULES)} rules, mesh axes {axes})", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,229 @@
+"""tpulint core: file model, suppression pragmas, rule registry, runner.
+
+The analyzer is pure ``ast`` — it never imports the modules it checks,
+so it runs in milliseconds on CPU-only CI with no JAX installed.
+
+Suppression syntax (documented in docs/TPULINT.md):
+
+* ``# tpulint: disable=rule-a,rule-b`` on a flagged line suppresses
+  those rules for that line (``disable=all`` suppresses everything).
+  For multi-line statements the pragma goes on the line the finding
+  anchors to (reported in the output).
+* ``# tpulint: disable-file=rule-a`` anywhere in a file suppresses the
+  rule for the whole file.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Set
+
+# Fallback mesh axis names, used only when comm/mesh.py cannot be found
+# (kept in sync with deepspeed_tpu.comm.mesh.AXIS_ORDER by test_tpulint).
+DEFAULT_AXES = ("pipe", "data", "fsdp", "expert", "seq", "tensor")
+
+_PRAGMA = re.compile(r"#\s*tpulint:\s*(disable(?:-file)?)\s*=\s*([\w\-,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic: ``path:line:col: [rule] message``."""
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def human(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] " \
+               f"{self.message}"
+
+    def json(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class FileContext:
+    """Everything a rule may look at for one source file."""
+    path: str
+    source: str
+    tree: ast.Module
+    is_test: bool                 # under tests/ or named test_*/conftest
+    mesh_axes: Set[str]           # valid collective axis names
+
+    _line_disable: Dict[int, Set[str]] = dataclasses.field(default=None)
+    _file_disable: Set[str] = dataclasses.field(default=None)
+
+    def __post_init__(self):
+        self._line_disable, self._file_disable = _parse_pragmas(self.source)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        for s in (self._file_disable, self._line_disable.get(line, ())):
+            if "all" in s or rule in s:
+                return True
+        return False
+
+
+def _parse_pragmas(source: str):
+    """Pragmas from COMMENT tokens only — a docstring that documents the
+    suppression syntax (like this module's) must not disable rules."""
+    import io
+    import tokenize
+
+    line_disable: Dict[int, Set[str]] = {}
+    file_disable: Set[str] = set()
+    try:
+        tokens = list(tokenize.generate_tokens(
+            io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return line_disable, file_disable
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _PRAGMA.search(tok.string)
+        if not m:
+            continue
+        names = {n.strip() for n in m.group(2).split(",") if n.strip()}
+        if m.group(1) == "disable-file":
+            file_disable |= names
+        else:
+            line_disable.setdefault(tok.start[0], set()).update(names)
+    return line_disable, file_disable
+
+
+# --------------------------------------------------------------------------
+# rule registry
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    doc: str
+    check: Callable[["FileContext"], Iterator[Finding]]
+    library_only: bool = False    # skip test files (prints etc. are fine)
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(name: str, doc: str, library_only: bool = False):
+    """Register a rule.  ``check(ctx)`` yields Findings."""
+    def deco(fn):
+        RULES[name] = Rule(name, doc, fn, library_only)
+        return fn
+    return deco
+
+
+# --------------------------------------------------------------------------
+# runner
+# --------------------------------------------------------------------------
+
+# fixture corpora of deliberately-bad code live under this directory name;
+# they are linted only when passed as explicit file arguments
+FIXTURE_DIR = "tpulint_fixtures"
+
+
+def _is_test_path(p: Path) -> bool:
+    if FIXTURE_DIR in p.parts:      # fixtures model library code
+        return False
+    return ("tests" in p.parts or p.name.startswith("test_")
+            or p.name == "conftest.py")
+
+
+def collect_files(paths: Iterable[str]) -> List[Path]:
+    out: List[Path] = []
+    for raw in paths:
+        p = Path(raw)
+        if p.is_file():
+            out.append(p)
+        elif p.is_dir():
+            out.extend(sorted(
+                f for f in p.rglob("*.py")
+                if FIXTURE_DIR not in f.parts))
+        else:
+            # a typo'd CI path must not yield a green gate that lints
+            # nothing
+            raise FileNotFoundError(f"tpulint: no such file or "
+                                    f"directory: {raw!r}")
+    return out
+
+
+def find_mesh_axes(paths: Iterable[str]) -> Set[str]:
+    """Extract the declared axis-name vocabulary from ``comm/mesh.py``
+    (searched under each lint root, then the CWD) without importing it:
+    the ``AXIS_ORDER`` tuple plus every ``*_AXIS = "name"`` constant."""
+    candidates = [Path(p) for p in paths] + [Path(".")]
+    for root in candidates:
+        root = root if root.is_dir() else root.parent
+        for mesh in sorted(root.rglob("comm/mesh.py")):
+            axes = _axes_from_source(mesh.read_text())
+            if axes:
+                return axes
+    return set(DEFAULT_AXES)
+
+
+def _axes_from_source(source: str) -> Set[str]:
+    axes: Set[str] = set()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return axes
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            targets = [node.target.id]
+        else:
+            continue
+        value = node.value
+        for t in targets:
+            if t == "AXIS_ORDER" and isinstance(value, (ast.Tuple, ast.List)):
+                axes |= {e.value for e in value.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str)}
+            elif t.endswith("_AXIS") and isinstance(value, ast.Constant) \
+                    and isinstance(value.value, str):
+                axes.add(value.value)
+    return axes
+
+
+def lint_file(path: Path, mesh_axes: Set[str],
+              rules: Optional[Iterable[Rule]] = None) -> List[Finding]:
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:
+        return [Finding("syntax", str(path), e.lineno or 0, 0,
+                        f"cannot parse: {e.msg}")]
+    ctx = FileContext(path=str(path), source=source, tree=tree,
+                      is_test=_is_test_path(path), mesh_axes=mesh_axes)
+    findings: List[Finding] = []
+    for r in (rules if rules is not None else RULES.values()):
+        if r.library_only and ctx.is_test:
+            continue
+        findings.extend(f for f in r.check(ctx)
+                        if not ctx.suppressed(r.name, f.line))
+    return findings
+
+
+def lint_paths(paths: Iterable[str],
+               mesh_axes: Optional[Set[str]] = None,
+               rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    from . import rules as _rules  # noqa: F401  (populate the registry)
+    axes = mesh_axes if mesh_axes is not None else find_mesh_axes(paths)
+    selected = None
+    if rules is not None:
+        unknown = set(rules) - set(RULES)
+        if unknown:
+            raise ValueError(f"unknown rules: {sorted(unknown)}")
+        selected = [RULES[n] for n in rules]
+    out: List[Finding] = []
+    for f in collect_files(paths):
+        out.extend(lint_file(f, axes, selected))
+    return sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule))

@@ -1,0 +1,573 @@
+"""tpulint rule set: the JAX/TPU hazards this framework actually hits.
+
+Every rule is a pure-AST check registered with :func:`core.rule`.
+Rules are deliberately conservative — a finding should be actionable,
+and anything intentional gets a ``# tpulint: disable=<rule>`` pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, FileContext, rule, _axes_from_source
+
+
+# --------------------------------------------------------------------------
+# shared AST helpers
+# --------------------------------------------------------------------------
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a pure Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+_JIT_NAMES = {"jit", "jax.jit", "pjit", "jax.pjit"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+
+def _jit_call_info(call: ast.Call):
+    """(wrapped_fn_expr, jit_kwargs) if ``call`` is jax.jit(...) or
+    partial(jax.jit, ...), else None.  wrapped_fn_expr is the first
+    positional arg (None for the partial/decorator-factory form)."""
+    d = dotted(call.func)
+    if d in _JIT_NAMES:
+        fn = call.args[0] if call.args else None
+        return fn, call.keywords
+    if d in _PARTIAL_NAMES and call.args \
+            and dotted(call.args[0]) in _JIT_NAMES:
+        return None, call.keywords
+    return None
+
+
+def _is_jit_decorator(dec: ast.AST) -> Optional[ast.Call]:
+    """The jit Call node when ``dec`` makes the function jit-traced."""
+    if dotted(dec) in _JIT_NAMES:
+        return ast.Call(func=dec, args=[], keywords=[])
+    if isinstance(dec, ast.Call):
+        d = dotted(dec.func)
+        if d in _JIT_NAMES:
+            return dec
+        if d in _PARTIAL_NAMES and dec.args \
+                and dotted(dec.args[0]) in _JIT_NAMES:
+            return dec
+    return None
+
+
+def _const_str_elems(node: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """String constants in a literal (plain or tuple/list of them)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [(node.value, node)]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            out.extend(_const_str_elems(e))
+        return out
+    return []
+
+
+def _int_elems(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            out.extend(_int_elems(e))
+        return out
+    return []
+
+
+def _function_defs(tree: ast.AST) -> Dict[str, List[ast.FunctionDef]]:
+    defs: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    return defs
+
+
+def _enclosing_map(tree: ast.AST) -> Dict[int, Optional[ast.AST]]:
+    """id(node) -> innermost enclosing FunctionDef (None at module
+    scope) — lets name lookups respect lexical scoping, so a local
+    closure named ``step`` never aliases a method named ``step``."""
+    enc: Dict[int, Optional[ast.AST]] = {id(tree): None}
+
+    def walk(node, current):
+        for child in ast.iter_child_nodes(node):
+            enc[id(child)] = current
+            walk(child, child if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                else current)
+
+    walk(tree, None)
+    return enc
+
+
+def _resolve_defs(defs: Dict[str, List[ast.FunctionDef]],
+                  enc: Dict[int, Optional[ast.AST]],
+                  name: str, at_node: ast.AST) -> List[ast.FunctionDef]:
+    """Defs named ``name`` visible from ``at_node``, innermost scope
+    first; an inner match shadows all outer ones."""
+    cands = defs.get(name, [])
+    if len(cands) <= 1:
+        return cands
+    scope = enc.get(id(at_node))
+    while True:
+        here = [d for d in cands if enc.get(id(d)) is scope]
+        if here:
+            return here
+        if scope is None:
+            return []
+        scope = enc.get(id(scope))
+
+
+# --------------------------------------------------------------------------
+# rule: host-sync — device->host synchronization inside traced code
+# --------------------------------------------------------------------------
+
+_CALLBACK_SUFFIXES = ("io_callback", "pure_callback", "callback")
+
+# attributes whose access is static at trace time (shape arithmetic is
+# fine inside jit — int(np.prod(x.shape)) never touches the device)
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize", "bits"}
+_STATIC_CALLS = {"len", "prod", "np.prod", "math.prod", "ord", "min", "max"}
+
+
+def _host_callback_fn_names(tree: ast.AST) -> Set[str]:
+    """Names of local functions handed to io_callback/pure_callback —
+    their bodies run on host, so host syncs there are fine."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            d = dotted(node.func) or ""
+            if d.split(".")[-1].endswith(_CALLBACK_SUFFIXES):
+                for a in node.args:
+                    if isinstance(a, ast.Name):
+                        out.add(a.id)
+    return out
+
+
+def _traced_functions(tree: ast.Module) -> List[ast.FunctionDef]:
+    """Functions that run under jit in this module: jit-decorated defs,
+    local defs passed to jax.jit(f, ...), plus (module-local, by-name)
+    everything they call — iterated to a fixpoint."""
+    defs = _function_defs(tree)
+    enc = _enclosing_map(tree)
+    host_fns = _host_callback_fn_names(tree)
+    traced: Set[ast.FunctionDef] = set()
+
+    for name, fns in defs.items():
+        for fn in fns:
+            if any(_is_jit_decorator(d) for d in fn.decorator_list):
+                traced.add(fn)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            info = _jit_call_info(node)
+            if info and isinstance(info[0], ast.Name):
+                traced.update(_resolve_defs(defs, enc, info[0].id, node))
+
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(traced):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name):
+                    for callee in _resolve_defs(defs, enc,
+                                                node.func.id, node):
+                        if callee.name not in host_fns \
+                                and callee not in traced:
+                            traced.add(callee)
+                            changed = True
+    return [fn for fn in traced if fn.name not in host_fns]
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """Conservatively true when an expression is trace-time static
+    (pure shape/dtype arithmetic)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(sub, ast.Call) \
+                and (dotted(sub.func) or "") in _STATIC_CALLS:
+            return True
+    return False
+
+
+@rule("host-sync",
+      "device->host sync inside jit-traced code (.item(), float()/int() "
+      "on array values, np.asarray/np.array on traced values)")
+def check_host_sync(ctx: FileContext) -> Iterator[Finding]:
+    for fn in _traced_functions(ctx.tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func) or ""
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                yield Finding("host-sync", ctx.path, node.lineno,
+                              node.col_offset,
+                              ".item() forces a device->host sync inside "
+                              "a jit-traced function")
+            elif d in ("float", "int", "bool") and len(node.args) == 1 \
+                    and not isinstance(node.args[0], ast.Constant) \
+                    and not _is_static_expr(node.args[0]):
+                yield Finding("host-sync", ctx.path, node.lineno,
+                              node.col_offset,
+                              f"{d}() on a traced value breaks the trace "
+                              "(ConcretizationTypeError on TPU; host sync "
+                              "at best)")
+            elif d in ("np.asarray", "np.array", "numpy.asarray",
+                       "numpy.array", "onp.asarray", "onp.array") \
+                    and node.args \
+                    and not _is_static_expr(node.args[0]):
+                yield Finding("host-sync", ctx.path, node.lineno,
+                              node.col_offset,
+                              f"{d}() materializes a traced value on host "
+                              "inside jit (use jnp, or move out of the "
+                              "traced function)")
+            elif d in ("jax.device_get", "device_get"):
+                yield Finding("host-sync", ctx.path, node.lineno,
+                              node.col_offset,
+                              "device_get inside a jit-traced function")
+
+
+# --------------------------------------------------------------------------
+# rule: static-args — recompilation / hashability hazards on jit params
+# --------------------------------------------------------------------------
+
+_UNHASHABLE = (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp,
+               ast.SetComp)
+
+
+def _jit_sites(tree: ast.Module):
+    """(call, wrapped FunctionDef or None) for every jit application."""
+    defs = _function_defs(tree)
+    enc = _enclosing_map(tree)
+    sites = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                call = _is_jit_decorator(dec)
+                if call is not None:
+                    sites.append((call, node))
+        elif isinstance(node, ast.Call):
+            info = _jit_call_info(node)
+            if info is not None:
+                fn_expr = info[0]
+                fn = None
+                if isinstance(fn_expr, ast.Name):
+                    cands = _resolve_defs(defs, enc, fn_expr.id, node)
+                    fn = cands[0] if len(cands) == 1 else None
+                sites.append((node, fn))
+    return sites
+
+
+def _params_of(fn: ast.FunctionDef):
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    defaults: Dict[str, ast.AST] = {}
+    pos_with_default = names[len(names) - len(a.defaults):] \
+        if a.defaults else []
+    for name, d in zip(pos_with_default, a.defaults):
+        defaults[name] = d
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        names.append(p.arg)
+        if d is not None:
+            defaults[p.arg] = d
+    return names, defaults
+
+
+@rule("static-args",
+      "jit static_argnums/static_argnames that don't exist, or whose "
+      "defaults are unhashable (recompile/TypeError hazards)")
+def check_static_args(ctx: FileContext) -> Iterator[Finding]:
+    for call, fn in _jit_sites(ctx.tree):
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+        line = getattr(call, "lineno", fn.lineno if fn else 0)
+        col = getattr(call, "col_offset", 0)
+        static_names = [s for s, _ in
+                        _const_str_elems(kw.get("static_argnames",
+                                                ast.Constant(value=None)))]
+        static_nums = _int_elems(kw.get("static_argnums",
+                                        ast.Constant(value=None)))
+        if fn is None:
+            continue
+        params, defaults = _params_of(fn)
+        for name in static_names:
+            if name not in params:
+                yield Finding("static-args", ctx.path, line, col,
+                              f"static_argnames {name!r} is not a "
+                              f"parameter of {fn.name}()")
+            elif isinstance(defaults.get(name), _UNHASHABLE):
+                yield Finding("static-args", ctx.path, line, col,
+                              f"static parameter {name!r} of {fn.name}() "
+                              "defaults to an unhashable "
+                              "dict/list/set — jit static args must hash "
+                              "stably or every call recompiles")
+        has_varargs = fn.args.vararg is not None
+        n_pos = len(fn.args.posonlyargs + fn.args.args)
+        for num in static_nums:
+            if num >= n_pos and not has_varargs:
+                yield Finding("static-args", ctx.path, line, col,
+                              f"static_argnums {num} is out of range for "
+                              f"{fn.name}() with {n_pos} positional "
+                              "parameters")
+            elif 0 <= num < n_pos:
+                pname = (fn.args.posonlyargs + fn.args.args)[num].arg
+                if isinstance(defaults.get(pname), _UNHASHABLE):
+                    yield Finding(
+                        "static-args", ctx.path, line, col,
+                        f"static parameter {pname!r} of {fn.name}() "
+                        "defaults to an unhashable dict/list/set")
+
+
+# --------------------------------------------------------------------------
+# rule: axis-name — collective axis names must exist in the mesh
+# --------------------------------------------------------------------------
+
+# final attribute -> index of the axis-name positional argument
+_COLLECTIVES = {"psum": 1, "pmean": 1, "pmax": 1, "pmin": 1,
+                "psum_scatter": 1, "all_gather": 1, "all_to_all": 1,
+                "ppermute": 1, "pshuffle": 1, "pbroadcast": 1,
+                "axis_index": 0, "axis_size": 0}
+_COLLECTIVE_PREFIXES = {"", "lax", "jax.lax"}
+
+
+def _local_axis_vocab(ctx: FileContext) -> Set[str]:
+    """Axis names declared in THIS file: *_AXIS constants, AXIS_ORDER,
+    and Mesh(..., axis_names)/make_mesh constructions (tests build toy
+    meshes with their own names)."""
+    vocab = _axes_from_source(ctx.source)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = (dotted(node.func) or "").split(".")[-1]
+        if d in ("Mesh", "make_mesh", "AbstractMesh"):
+            cands = list(node.args[1:2]) + [
+                k.value for k in node.keywords
+                if k.arg == "axis_names"]
+            for c in cands:
+                vocab |= {s for s, _ in _const_str_elems(c)}
+        elif d == "shard_map":
+            for k in node.keywords:
+                if k.arg == "axis_names":
+                    vocab |= {s for s, _ in _const_str_elems(k.value)}
+    return vocab
+
+
+@rule("axis-name",
+      "lax collective axis names cross-checked against the mesh axes "
+      "declared in comm/mesh.py")
+def check_axis_name(ctx: FileContext) -> Iterator[Finding]:
+    valid = ctx.mesh_axes | _local_axis_vocab(ctx)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d is None:
+            continue
+        prefix, _, last = d.rpartition(".")
+        if last not in _COLLECTIVES or prefix not in _COLLECTIVE_PREFIXES:
+            continue
+        idx = _COLLECTIVES[last]
+        axis_args = [kw.value for kw in node.keywords
+                     if kw.arg == "axis_name"]
+        if not axis_args and len(node.args) > idx:
+            axis_args = [node.args[idx]]
+        for arg in axis_args:
+            for name, lit in _const_str_elems(arg):
+                if name not in valid:
+                    yield Finding(
+                        "axis-name", ctx.path, lit.lineno, lit.col_offset,
+                        f"{last}() over axis {name!r}, which is not a "
+                        f"mesh axis (known: {sorted(valid)})")
+
+
+# --------------------------------------------------------------------------
+# rule: silent-except — swallowed exceptions in fallback paths
+# --------------------------------------------------------------------------
+
+_BROAD = {"Exception", "BaseException"}
+_LOG_ATTRS = {"warning", "error", "exception", "critical", "info",
+              "debug", "log", "warn"}
+
+
+def _exc_names(node: Optional[ast.AST]) -> List[str]:
+    if node is None:
+        return []
+    if isinstance(node, ast.Tuple):
+        return [n for e in node.elts for n in _exc_names(e)]
+    d = dotted(node)
+    return [d] if d else []
+
+
+def _handler_surfaces(handler: ast.ExceptHandler) -> bool:
+    """True when the handler re-raises or logs/prints the failure."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            d = dotted(node.func) or ""
+            last = d.split(".")[-1]
+            # attribute calls: logger.warning(...), monitor.log(...)
+            if isinstance(node.func, ast.Attribute) and last in _LOG_ATTRS:
+                return True
+            # bare-name calls: log_dist(...), warn(...) — but NOT
+            # math.log()-style names ("log" alone is only a logging
+            # call as a method)
+            if last in (_LOG_ATTRS - {"log"}) or last == "log_dist" \
+                    or last.startswith("log_"):
+                return True
+            if d in ("print", "warnings.warn", "traceback.print_exc",
+                     "pytest.skip", "pytest.fail", "pytest.xfail"):
+                return True     # pytest.* raise by design
+    return False
+
+
+@rule("silent-except",
+      "bare except / except Exception that falls back without logging "
+      "the swallowed error (the silent-disable bug pattern)")
+def check_silent_except(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        names = _exc_names(node.type)
+        bare = node.type is None
+        broad = any(n in _BROAD for n in names)
+        if not (bare or broad) or _handler_surfaces(node):
+            continue
+        what = "bare except:" if bare else f"except {'/'.join(names)}"
+        yield Finding(
+            "silent-except", ctx.path, node.lineno, node.col_offset,
+            f"{what} swallows the error without logging it — trace "
+            "failures degrade into silent fallbacks; log the exception "
+            "(or pragma if genuinely intentional)")
+
+
+# --------------------------------------------------------------------------
+# rule: print — stray stdout/debugger calls in library code
+# --------------------------------------------------------------------------
+
+@rule("print",
+      "stray print()/pdb/breakpoint in library code — route through "
+      "utils.logging", library_only=True)
+def check_print(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d == "print":
+                yield Finding("print", ctx.path, node.lineno,
+                              node.col_offset,
+                              "print() in library code — use "
+                              "utils.logging (or pragma for CLI output)")
+            elif d in ("pdb.set_trace", "ipdb.set_trace", "breakpoint"):
+                yield Finding("print", ctx.path, node.lineno,
+                              node.col_offset, f"debugger call {d}()")
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            mods = [a.name for a in node.names]
+            if isinstance(node, ast.ImportFrom):
+                mods = [node.module or ""]
+            for m in mods:
+                if m.split(".")[0] in ("pdb", "ipdb"):
+                    yield Finding("print", ctx.path, node.lineno,
+                                  node.col_offset,
+                                  f"debugger import {m!r}")
+
+
+# --------------------------------------------------------------------------
+# rule: donated-reuse — buffers used after donate_argnums handed them over
+# --------------------------------------------------------------------------
+
+def _maximal_refs(scope: ast.AST):
+    """(dotted, line, is_store) for every maximal Name/Attribute chain in
+    ``scope``, skipping nested function bodies."""
+    refs: List[Tuple[str, int, bool]] = []
+    skip_children: Set[int] = set()
+
+    def visit(node, in_nested):
+        if id(node) in skip_children:
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not scope:
+            return
+        if isinstance(node, (ast.Attribute, ast.Name)):
+            d = dotted(node)
+            if d is not None:
+                ctx_node = node
+                is_store = isinstance(ctx_node.ctx,
+                                      (ast.Store, ast.Del))
+                refs.append((d, node.lineno, is_store))
+                # don't descend into the chain's own parts
+                inner = node
+                while isinstance(inner, ast.Attribute):
+                    skip_children.add(id(inner.value))
+                    inner = inner.value
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_nested)
+
+    visit(scope, False)
+    return refs
+
+
+@rule("donated-reuse",
+      "buffer passed at a donate_argnums position and then used again — "
+      "donated buffers are invalidated by the call")
+def check_donated_reuse(ctx: FileContext) -> Iterator[Finding]:
+    scopes = [ctx.tree] + [n for n in ast.walk(ctx.tree)
+                           if isinstance(n, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))]
+    for scope in scopes:
+        donating: Dict[str, List[int]] = {}
+        body_nodes = list(ast.walk(scope))
+        for node in body_nodes:
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                info = _jit_call_info(node.value)
+                if info is None:
+                    continue
+                kw = {k.arg: k.value for k in node.value.keywords}
+                nums = _int_elems(kw.get("donate_argnums",
+                                         ast.Constant(value=None)))
+                if nums:
+                    donating[node.targets[0].id] = nums
+        if not donating:
+            continue
+        refs = _maximal_refs(scope)
+        for node in body_nodes:
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in donating):
+                continue
+            call_line = node.lineno
+            for i in donating[node.func.id]:
+                if i >= len(node.args):
+                    continue
+                expr = dotted(node.args[i])
+                if expr is None:
+                    continue
+                # rebinding must hit the expr exactly; a USE of any
+                # longer chain (kv.sum, kv[...]) still reads the buffer
+                stores = [ln for d, ln, st in refs
+                          if st and d == expr and ln >= call_line]
+                loads = [ln for d, ln, st in refs
+                         if not st and ln > call_line
+                         and (d == expr or d.startswith(expr + "."))]
+                for ln in sorted(loads):
+                    if any(s <= ln for s in stores):
+                        break
+                    yield Finding(
+                        "donated-reuse", ctx.path, ln, 0,
+                        f"{expr!r} was donated to {node.func.id}() "
+                        f"(donate_argnums={i}, line {call_line}) and is "
+                        "used again here — the buffer is invalid after "
+                        "donation")
+                    break
