@@ -197,6 +197,7 @@ def main():
                     f"{(str(e).splitlines() or [''])[0][:120]}"}
 
     serve = leg(serving_bench, on_tpu)
+    pipe = leg(pipeline_serving_bench, on_tpu)
     llama_train = leg(llama_train_bench, on_tpu, peak)
     llama_serve = leg(llama8b_serving_bench, on_tpu)
     moe = leg(moe_train_bench, on_tpu, peak)
@@ -213,7 +214,7 @@ def main():
         out["serving_decode_tok_s"] = round(serve[1], 1)
     else:
         out.update(serve)
-    print(json.dumps({**out, **llama_train, **llama_serve, **moe}))
+    print(json.dumps({**out, **pipe, **llama_train, **llama_serve, **moe}))
 
 
 def moe_train_bench(on_tpu: bool, peak: float):
@@ -639,6 +640,81 @@ def sla_goodput_sweep(eng, on_tpu: bool, prompt_len: int):
             curve[f"r{rate}_{tier}"] = round(goodput, 3)
     return {**{f"goodput_qps_{k}": round(v, 3) for k, v in best.items()},
             "goodput_curve": curve}
+
+
+def pipeline_serving_bench(on_tpu: bool):
+    """Pipelined vs strict-sync serving loop at identical shapes: decode
+    tokens/s for pipeline_depth 1 vs 2 plus the engine's per-step
+    host-overhead breakdown (schedule / stage / device / readback ms).
+    The pipeline's win is the host work it moves off the critical path:
+    schedule+stage of step N+1 and the token readback of step N overlap
+    step N/N+1's device compute, so the per-token host overhead
+    (schedule+stage+readback) drops vs the synchronous baseline while
+    outputs stay token-for-token identical."""
+    import numpy as np
+
+    from deepspeed_tpu.inference import (InferenceConfig, InferenceEngine,
+                                         SamplingParams)
+    from deepspeed_tpu.models import build_model
+
+    n_seqs, prompt_len = (16, 64) if on_tpu else (8, 8)
+    gen_tokens = 64 if on_tpu else 24
+    model = build_model(
+        "gpt2",
+        **(dict(max_seq_len=1024) if on_tpu else
+           dict(num_layers=2, d_model=128, num_heads=4, vocab_size=1024,
+                max_seq_len=64)))
+    r = np.random.RandomState(0)
+    vocab = model.config.vocab_size
+    prompts = {uid: list(r.randint(0, vocab, prompt_len))
+               for uid in range(n_seqs)}
+    sp = SamplingParams(temperature=0.0, max_new_tokens=gen_tokens)
+
+    out = {}
+    breakdown = {}
+    for depth in (1, 2):
+        eng = InferenceEngine(model, InferenceConfig(
+            token_budget=1024 if on_tpu else 64, max_seqs=n_seqs,
+            kv_block_size=64 if on_tpu else 16,
+            num_kv_blocks=1024 if on_tpu else 64,
+            pipeline_depth=depth))
+        # warm the compile caches (probe + both context buckets) outside
+        # the timed region
+        eng.generate({u: list(p) for u, p in prompts.items()}, sp)
+        eng.reset_timings()
+        t0 = time.perf_counter()
+        toks = eng.generate({u: list(p) for u, p in prompts.items()}, sp)
+        dt = time.perf_counter() - t0
+        produced = sum(len(v) for v in toks.values())
+        tl = eng.timings
+        steps = max(tl["steps"], 1)
+        out[f"pipe{depth}_decode_tok_s"] = round(produced / dt, 1)
+        breakdown[f"pipe{depth}"] = {
+            "schedule_ms": round(tl["schedule_ms"] / steps, 3),
+            "stage_ms": round(tl["stage_ms"] / steps, 3),
+            "device_ms": round(tl["device_ms"] / steps, 3),
+            "wait_ms": round(tl["wait_ms"] / steps, 3),
+            "readback_ms": round(tl["readback_ms"] / steps, 3),
+            "wall_ms_per_step": round(dt * 1e3 / steps, 3),
+            "steps": tl["steps"],
+        }
+    # host overhead left ON THE CRITICAL PATH per step: wall minus the
+    # device-busy time.  Device busy is taken from the strict-sync run
+    # (same model/shapes, measured serially: its jit call + result wait
+    # IS the device step, unperturbed by overlap) so both depths are
+    # charged the same device cost and the difference is purely the
+    # schedule/stage/readback work the pipeline hides behind compute.
+    dev_busy = (breakdown["pipe1"]["device_ms"]
+                + breakdown["pipe1"]["wait_ms"])
+    for d in (1, 2):
+        b = breakdown[f"pipe{d}"]
+        b["host_crit_ms_per_step"] = round(
+            max(0.0, b["wall_ms_per_step"] - dev_busy), 3)
+    h1 = breakdown["pipe1"]["host_crit_ms_per_step"]
+    h2 = breakdown["pipe2"]["host_crit_ms_per_step"]
+    out["pipeline_host_overhead_ratio"] = round(h2 / h1, 3) if h1 else 0.0
+    out["pipeline_step_breakdown_ms"] = breakdown
+    return out
 
 
 def serving_bench(on_tpu: bool):

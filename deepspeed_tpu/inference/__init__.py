@@ -1,9 +1,10 @@
 from .engine import InferenceConfig, InferenceEngine
 from .sampler import SamplingParams, sample
-from .ragged.state import KVCacheConfig, StateManager, RaggedBatch
+from .ragged.state import (BatchStager, FEEDBACK_TOKEN, KVCacheConfig,
+                           StateManager, RaggedBatch)
 from .ragged.allocator import BlockedAllocator
 from .weight_stream import NVMeWeightStore
 
 __all__ = ["InferenceConfig", "InferenceEngine", "SamplingParams", "sample",
-           "KVCacheConfig", "StateManager", "RaggedBatch",
-           "BlockedAllocator", "NVMeWeightStore"]
+           "KVCacheConfig", "StateManager", "RaggedBatch", "BatchStager",
+           "FEEDBACK_TOKEN", "BlockedAllocator", "NVMeWeightStore"]

@@ -22,8 +22,9 @@ API:
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
-from typing import Dict, List, Optional, Sequence
+import time
+from collections import deque
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,8 +34,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..comm.mesh import FSDP_AXIS, MeshTopology, TENSOR_AXIS
 from ..models.transformer import Model, TransformerConfig
 from ..utils.logging import logger
-from .model import ragged_forward
-from .ragged.state import KVCacheConfig, RaggedBatch, StateManager
+from .model import pipelined_ragged_step, ragged_forward
+from .ragged.state import (FEEDBACK_TOKEN, BatchStager, KVCacheConfig,
+                           RaggedBatch, StateManager)
 from .sampler import SamplingParams, sample
 
 
@@ -86,10 +88,41 @@ class InferenceConfig:
     # their stop token mid-burst over-generate up to K-1 tokens, which
     # generate() discards (the usual multi-step-scheduling trade).
     decode_burst: int = 1
+    # serving-pipeline depth for generate(): 2 keeps one step in flight —
+    # sampling happens INSIDE the jitted step, the sampled token array
+    # stays on device and feeds the next step's batch directly, and the
+    # host schedules/stages step N+1 (and reads step N's tokens back)
+    # while step N computes.  1 is the strict-sync debug mode; both
+    # depths run the same step computation, so outputs are
+    # token-for-token identical.  Sequences that hit their stop token
+    # over-generate one speculative token, which the driver discards
+    # (as decode bursts do).
+    pipeline_depth: int = 2
+    # KV-cache donation across steps: "on" aliases the cache in place
+    # (the right call wherever HBM is the constraint), "off" lets XLA
+    # allocate a fresh result cache per step.  "auto" donates everywhere
+    # EXCEPT a pipelined engine on the CPU backend: XLA:CPU blocks a
+    # dispatch whose donated operand is still being produced by the
+    # in-flight step (measured: chained donated calls serialize at full
+    # step latency), which would silently turn the depth-2 pipeline back
+    # into the synchronous loop.  Host RAM pays one transient cache copy
+    # instead.
+    kv_donate: str = "auto"
 
 
 # attn-impl probe results, memoized per (backend, shape signature)
 _PROBE_CACHE: Dict[tuple, str] = {}
+
+
+class _InFlight(NamedTuple):
+    """One dispatched-but-unread serving step: the on-device [max_seqs]
+    sample array, the (uid, slot) emission list frozen at dispatch time
+    (slots may be reassigned by the time the step is collected), and the
+    engine-wide dispatch sequence number (feedback markers name the step
+    whose sample array they defer to)."""
+    toks: jax.Array
+    emit: Tuple[Tuple[int, int], ...]
+    sid: int
 
 
 class InferenceEngine:
@@ -163,9 +196,36 @@ class InferenceEngine:
         self._pending: Dict[int, List[int]] = {}   # uid -> unprocessed toks
         self._ctx_exhausted: set = set()
         self._rng = jax.random.PRNGKey(0)
-        self._step_fns: Dict[int, object] = {}   # per context bucket
+        self._pstep_fns: Dict[tuple, object] = {}  # (bucket, sampler_key)
         self._burst_fns: Dict[tuple, object] = {}
         self._steps_done = 0
+        # pipelined-serving state: alternating host staging buffers, the
+        # last dispatched step's on-device sample array (the feedback
+        # source for the next step), and a zero fallback for step 0
+        self._stager = BatchStager(self.icfg.token_budget,
+                                   self.icfg.max_seqs,
+                                   self.icfg.num_kv_blocks,
+                                   depth=max(2, self.icfg.pipeline_depth))
+        self._zero_toks = self._stage(
+            jnp.zeros(self.icfg.max_seqs, jnp.int32))
+        self._last_toks = None
+        self._dispatch_seq = 0
+        self._fb_step: Dict[int, int] = {}   # uid -> sid its marker defers to
+        self._zero_key = jax.random.PRNGKey(0)
+        self.reset_timings()
+
+    def reset_timings(self) -> None:
+        """Zero the cumulative per-phase breakdown the serving loop
+        records (milliseconds; ``steps`` dispatches): host scheduling,
+        batch staging, the jitted call (pure enqueue when dispatch is
+        async; the whole device step when something — e.g. CPU-backend
+        donation — forces it synchronous), the wait for the collected
+        step's sample array, and the pure device->host fetch.  A
+        pipelined engine's per-step critical-path host overhead is
+        roughly wall/steps - (device_ms + wait_ms)/steps."""
+        self.timings = {"schedule_ms": 0.0, "stage_ms": 0.0,
+                        "device_ms": 0.0, "wait_ms": 0.0,
+                        "readback_ms": 0.0, "steps": 0}
 
     def refresh_params(self, params) -> None:
         """Swap the served weights (hybrid-engine policy refresh).
@@ -187,7 +247,7 @@ class InferenceEngine:
                 self.params, bits=WEIGHT_QUANT_BITS[self.icfg.weight_quant],
                 quantize_embeddings=self.icfg.quantize_embeddings)
             # step/burst closures hold the old quant tree
-            self._step_fns.clear()
+            self._pstep_fns.clear()
             self._burst_fns.clear()
         self._shard_weights()
 
@@ -374,8 +434,60 @@ class InferenceEngine:
             self._kv_on_host = False
 
     # ------------------------------------------------------------------
+    def _resolve_fw(self, mbs: Optional[int]):
+        """Resolve the forward-pass knobs shared by every compiled
+        serving program (probing attn_impl/mixed_gemm on first use)."""
+        mbs = mbs or self.max_blocks_per_seq
+        impl = self.icfg.attn_impl
+        if impl == "auto":
+            impl = self._probe_attn_impl()
+        mixed = self._resolve_mixed_gemm(impl)
+        self._mixed_gemm_active = mixed
+        return dict(attn_impl=impl, mixed_gemm=mixed,
+                    kv_host=getattr(self, "_kv_on_host", False),
+                    shard_mesh=self._tp_mesh, stream=self._stream), mbs
+
+    def _donate_kv(self) -> tuple:
+        """donate_argnums for the per-step serving programs (the cache
+        rides argnum 2).  See ``InferenceConfig.kv_donate``: donation on
+        XLA:CPU blocks each dispatch until the in-flight producer of the
+        donated cache finishes, so a pipelined CPU engine trades one
+        transient cache copy for async dispatch."""
+        mode = self.icfg.kv_donate
+        if mode == "off":
+            return ()
+        if mode == "auto" and self.icfg.pipeline_depth >= 2 \
+                and self.icfg.decode_burst <= 1 \
+                and jax.default_backend() == "cpu":
+            # burst engines route generate() to the strict-sync driver,
+            # so their steps never pipeline — keep donating for them
+            return ()
+        return (2,)
+
+    def _serving_jit(self, fn):
+        """jit a serving program of signature (..., kv-at-argnum-2, ...)
+        -> (small replicated output, new_kv), with the cache donated
+        (see ``_donate_kv``) and its sharding (host placement / head
+        split) pinned."""
+        donate = self._donate_kv()
+        if getattr(self, "_kv_on_host", False):
+            # pin the cache output to host memory so the persistent
+            # state never round-trips through HBM between steps
+            out_sh = (None, jax.tree.map(lambda x: x.sharding,
+                                         self.state.kv))
+            return jax.jit(fn, donate_argnums=donate, out_shardings=out_sh)
+        if self._kv_nsh is not None:
+            # logits/tokens replicated (one small host fetch), cache
+            # keeps its head-split sharding across the donation
+            return jax.jit(fn, donate_argnums=donate,
+                           out_shardings=(self._repl, self._kv_nsh))
+        return jax.jit(fn, donate_argnums=donate)
+
     def _build_step(self, mbs: Optional[int] = None):
-        """Compile one SplitFuse step bounded to ``mbs`` context blocks.
+        """Compile one SplitFuse step bounded to ``mbs`` context blocks —
+        the logits-returning sibling of :meth:`_build_pstep` (the serving
+        loop runs pstep; this entry serves logits-level consumers:
+        quant/TP parity tests and offline scoring).
 
         Steps are compiled per power-of-two context bucket (like the
         decode-burst prefix buckets): the XLA attention paths do work
@@ -384,16 +496,7 @@ class InferenceEngine:
         skips dead blocks dynamically; the dense paths cannot)."""
         cfg = self.cfg
         bs = self.icfg.kv_block_size
-        mbs = mbs or self.max_blocks_per_seq
-        impl = self.icfg.attn_impl
-        if impl == "auto":
-            impl = self._probe_attn_impl()
-        mixed = self._resolve_mixed_gemm(impl)
-        self._mixed_gemm_active = mixed
-
-        kv_host = getattr(self, "_kv_on_host", False)
-        shard_mesh = self._tp_mesh
-        stream = self._stream
+        fw, mbs = self._resolve_fw(mbs)
 
         # NOTE: the quant tree is a jit ARGUMENT, never a closure —
         # closed-over trees bake into the HLO as constants (7.5 GB of
@@ -401,23 +504,31 @@ class InferenceEngine:
         # compile); as an argument it is device buffers, like params
         def step(params, quant, kv, batch: RaggedBatch):
             return ragged_forward(cfg, params, kv, batch, bs, mbs,
-                                  attn_impl=impl, quant=quant,
-                                  kv_host=kv_host, shard_mesh=shard_mesh,
-                                  stream=stream, mixed_gemm=mixed)
+                                  quant=quant, **fw)
 
-        if kv_host:
-            # pin the cache output to host memory so the persistent
-            # state never round-trips through HBM between steps
-            out_sh = (None, jax.tree.map(lambda x: x.sharding,
-                                         self.state.kv))
-            return jax.jit(step, donate_argnums=(2,),
-                           out_shardings=out_sh)
-        if self._kv_nsh is not None:
-            # logits replicated (one small host fetch), cache keeps its
-            # head-split sharding across the donation
-            return jax.jit(step, donate_argnums=(2,),
-                           out_shardings=(self._repl, self._kv_nsh))
-        return jax.jit(step, donate_argnums=(2,))
+        return self._serving_jit(step)
+
+    def _build_pstep(self, mbs: Optional[int], sampling: SamplingParams):
+        """Compile one pipelined serving step for a context bucket:
+        deferred-token feedback + ragged forward + ON-DEVICE sampling.
+        The sampled [max_seqs] token array is both a program output (read
+        back one step later) and the next step's feedback operand, so
+        the host round trip leaves the critical path.  Cached per
+        (bucket, sampler_key) — stop_token/max_new_tokens are host loop
+        concerns and never force a recompile."""
+        cfg = self.cfg
+        bs = self.icfg.kv_block_size
+        fw, mbs = self._resolve_fw(mbs)
+
+        def sample_fn(logits, r):
+            return sample(logits, sampling, r)
+
+        def pstep(params, quant, kv, batch: RaggedBatch, prev_toks, rng):
+            return pipelined_ragged_step(cfg, params, quant, kv, batch,
+                                         prev_toks, rng, sample_fn,
+                                         bs, mbs, **fw)
+
+        return self._serving_jit(pstep)
 
     def _probe_key(self, what: str):
         cfg = self.cfg
@@ -602,6 +713,7 @@ class InferenceEngine:
     def flush(self, uid: int) -> None:
         """(reference: engine_v2.flush :242)."""
         self._pending.pop(uid, None)
+        self._fb_step.pop(uid, None)
         self.state.release(uid)
 
     def query(self, uid: int) -> Dict:
@@ -615,7 +727,7 @@ class InferenceEngine:
         }
 
     # ------------------------------------------------------------------
-    def _schedule(self) -> List[tuple]:
+    def _schedule(self) -> List[tuple]:  # tpulint: serving-loop
         """Dynamic SplitFuse: pack the fixed token budget — decode tokens
         first (latency), then prompt chunks (throughput) — while
         *reserving* KV blocks and slots as requests are admitted so the
@@ -654,11 +766,25 @@ class InferenceEngine:
             if needs_slot:
                 free_slots -= 1
 
-        pending = [(uid, t) for uid, t in self._pending.items() if t]
-        # decode requests (continuing sequences, single token) first
-        decodes = [p for p in pending
-                   if len(p[1]) == 1 and p[0] in self.state.seqs]
-        prefills = [p for p in pending if p not in decodes]
+        # decode requests (continuing sequences, single token) first,
+        # then prompt chunks — one O(n) pass keyed on the entry itself
+        # (the old value-membership split re-scanned the decode list for
+        # every pending request: O(n^2) tuple compares under load)
+        decodes: List[tuple] = []
+        prefills: List[tuple] = []
+        for uid, t in self._pending.items():
+            if not t:
+                continue
+            if t[0] == FEEDBACK_TOKEN \
+                    and self._fb_step.get(uid) != self._dispatch_seq:
+                # deferred sample owned by an OLDER still-uncollected
+                # step (possible at pipeline_depth >= 3 when the budget
+                # starves a decode for a step): the jitted feedback path
+                # only sees the last dispatch's sample array, so hold the
+                # request until its owner's collect patches it concrete
+                continue
+            (decodes if len(t) == 1 and uid in self.state.seqs
+             else prefills).append((uid, t))
         for uid, toks in decodes + prefills:
             if budget <= 0:
                 break
@@ -666,12 +792,44 @@ class InferenceEngine:
         return sched
 
     def step(self, rng: Optional[jax.Array] = None,
-             sampling: SamplingParams = SamplingParams()) -> Dict[int, int]:
+             sampling: SamplingParams = SamplingParams()
+             ) -> Dict[int, int]:  # tpulint: serving-loop
         """Run one engine step; returns {uid: next_token} for sequences
-        whose last pending token was consumed (i.e. ready to sample)."""
+        whose last pending token was consumed (i.e. ready to sample).
+        Strict-sync form of the pipeline: dispatch, then read straight
+        back (generate() at ``pipeline_depth>=2`` interleaves these)."""
+        st = self._dispatch(sampling, rng)
+        if st is None:
+            return {}
+        return self._collect(st)
+
+    @staticmethod
+    def _rng_drawer(rng: Optional[jax.Array]):
+        """None, or a zero-arg callable yielding a fresh subkey per
+        dispatched step — drawn lazily (only when a step actually
+        launches) so the strict-sync and pipelined drivers consume the
+        caller's key stream identically: one split per launched step."""
+        if rng is None:
+            return None
+        box = [rng]
+
+        def draw():
+            box[0], sub = jax.random.split(box[0])
+            return sub
+        return draw
+
+    def _dispatch(self, sampling: SamplingParams,
+                  rng=None) -> Optional[_InFlight]:  # tpulint: serving-loop
+        """Schedule, stage, and launch one serving step WITHOUT reading
+        the sampled tokens back; returns the in-flight record (tokens
+        still on device) or None when nothing is schedulable.  ``rng``:
+        an explicit PRNG key, a zero-arg callable invoked only once a
+        step is known to launch, or None (engine-internal key stream
+        when the sampler needs one)."""
+        t0 = time.perf_counter()
         sched = self._schedule()
         if not sched:
-            return {}
+            return None
         # context bucket: the compiled block bound covers every scheduled
         # sequence's post-step context, rounded to a power of two so a
         # growing context mints O(log) programs, not one per block
@@ -685,14 +843,29 @@ class InferenceEngine:
         while mbs < need:
             mbs *= 2
         mbs = min(mbs, self.max_blocks_per_seq)
-        step_fn = self._step_fns.get(mbs)
+        key = (mbs, sampling.sampler_key)
+        step_fn = self._pstep_fns.pop(key, None)
         if step_fn is None:
-            step_fn = self._step_fns[mbs] = self._build_step(mbs)
+            if len(self._pstep_fns) >= 16:    # bound retained executables
+                self._pstep_fns.pop(next(iter(self._pstep_fns)))
+            step_fn = self._build_pstep(mbs, sampling)
+        self._pstep_fns[key] = step_fn    # reinsert: LRU, not FIFO
+        t1 = time.perf_counter()
         batch = self._stage(
-            self.state.build_batch(sched, self.icfg.token_budget))
+            self.state.build_batch(sched, self.icfg.token_budget,
+                                   stager=self._stager))
+        t2 = time.perf_counter()
+        if callable(rng):
+            rng = rng()
+        if rng is None and sampling.needs_rng:
+            self._rng, rng = jax.random.split(self._rng)
+        if rng is None:
+            rng = self._zero_key          # greedy: the sampler ignores it
+        prev = self._last_toks if self._last_toks is not None \
+            else self._zero_toks
         try:
-            logits, self.state.kv = step_fn(
-                self.params, self._quant, self.state.kv, batch)
+            toks, self.state.kv = step_fn(
+                self.params, self._quant, self.state.kv, batch, prev, rng)
         except jax.errors.JaxRuntimeError:
             # degrade to an HBM cache ONLY on the first-ever step (the
             # backend compiled but cannot execute in-program host
@@ -707,23 +880,63 @@ class InferenceEngine:
             # the failed call donated the cache; at step 0 it is all
             # zeros — recreate it
             self.state.kv = self.state.cfg.kv_zeros()
-            self._step_fns.clear()
-            step_fn = self._step_fns[mbs] = self._build_step(mbs)
-            logits, self.state.kv = step_fn(
-                self.params, self._quant, self.state.kv, batch)
+            self._pstep_fns.clear()
+            step_fn = self._pstep_fns[key] = self._build_pstep(mbs, sampling)
+            toks, self.state.kv = step_fn(
+                self.params, self._quant, self.state.kv, batch, prev, rng)
+        t3 = time.perf_counter()
         self._steps_done += 1
-        if rng is None and sampling.temperature > 0.0:
-            self._rng, rng = jax.random.split(self._rng)
-        toks = sample(logits, sampling, rng)
+        self._last_toks = toks
+        tm = self.timings
+        tm["schedule_ms"] += (t1 - t0) * 1e3
+        tm["stage_ms"] += (t2 - t1) * 1e3
+        tm["device_ms"] += (t3 - t2) * 1e3
+        tm["steps"] += 1
+        emit = tuple((uid, self.state.slot(uid)) for uid, _ in sched
+                     if not self._pending.get(uid))
+        self._dispatch_seq += 1
+        return _InFlight(toks=toks, emit=emit, sid=self._dispatch_seq)
+
+    def _mark_feedback(self, uid: int, st: _InFlight) -> None:
+        """Queue uid's next decode token as a deferred on-device read of
+        step ``st``'s sample array (the driver speculates continuation
+        without waiting for readback)."""
+        self._pending[uid] = [FEEDBACK_TOKEN]
+        self._fb_step[uid] = st.sid
+
+    def _fetch_tokens(self, arr) -> np.ndarray:  # tpulint: serving-loop
+        """THE sanctioned serving-loop readback: every device->host token
+        fetch (step collect, decode bursts) funnels through here so the
+        ``serving-sync`` lint rule can keep ad-hoc syncs off the decode
+        critical path."""
+        return np.asarray(arr)  # tpulint: disable=serving-sync
+
+    def _collect(self, st: _InFlight
+                 ) -> Dict[int, int]:  # tpulint: serving-loop
+        """Read one in-flight step's tokens back and emit them; patches
+        any still-deferred feedback marker THIS step owns to the concrete
+        value (a later batch built after this read must never reference a
+        stale device sample array).  Markers owned by a newer in-flight
+        step — the same sequence sampled again before this read — are
+        left for that step's collect."""
+        t0 = time.perf_counter()
+        jax.block_until_ready(st.toks)
+        t1 = time.perf_counter()
+        toks_np = self._fetch_tokens(st.toks)
+        self.timings["wait_ms"] += (t1 - t0) * 1e3
+        self.timings["readback_ms"] += (time.perf_counter() - t1) * 1e3
         out: Dict[int, int] = {}
-        toks_np = np.asarray(toks)
-        for uid, scheduled in sched:
-            if self._pending.get(uid):
-                continue                       # prompt not fully ingested
-            slot = self.state.slot(uid)
+        for uid, slot in st.emit:
             tok = int(toks_np[slot])
-            self.state.seqs[uid].tokens.append(tok)
+            seq = self.state.seqs.get(uid)
+            if seq is not None and self.state._slots.get(uid) == slot:
+                seq.tokens.append(tok)
             out[uid] = tok
+            if self._fb_step.get(uid) == st.sid:
+                self._fb_step.pop(uid)
+                p = self._pending.get(uid)
+                if p and p[0] == FEEDBACK_TOKEN:
+                    p[0] = tok
         return out
 
     # ------------------------------------------------------------------
@@ -764,7 +977,8 @@ class InferenceEngine:
 
     def decode_burst(self, steps: Optional[int] = None,
                      sampling: SamplingParams = SamplingParams(),
-                     rng: Optional[jax.Array] = None) -> Dict[int, List[int]]:
+                     rng: Optional[jax.Array] = None
+                     ) -> Dict[int, List[int]]:  # tpulint: serving-loop
         """Run ``steps`` decode iterations in ONE device dispatch: the
         sampled token feeds the next forward on-device (lax.scan), so the
         host round trip — which dominates decode latency on
@@ -776,10 +990,11 @@ class InferenceEngine:
         pending = {u: t for u, t in self._pending.items() if t}
         if not pending:
             return {}
-        if any(len(t) != 1 or u not in self.state.seqs
+        if any(len(t) != 1 or t[0] < 0 or u not in self.state.seqs
                for u, t in pending.items()):
             raise ValueError("decode_burst requires every pending request "
-                             "to be a single-token continuation; use "
+                             "to be a single-token continuation (with a "
+                             "concrete, non-deferred token id); use "
                              "step() for prefill")
         if getattr(self, "_kv_on_host", False) or self._stream is not None:
             # bursts need the cache addressable on device and the block
@@ -842,7 +1057,7 @@ class InferenceEngine:
             self._stage(jnp.asarray(tables)), self._stage(jnp.asarray(base)),
             self._stage(jnp.asarray(tok0)), self._stage(rng))
         self._steps_done += steps
-        toks_np = np.asarray(toks)                     # ONE fetch
+        toks_np = self._fetch_tokens(toks)             # ONE fetch
         out: Dict[int, List[int]] = {}
         for uid in pending:
             slot = st.slot(uid)
@@ -867,20 +1082,31 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     def generate(self, prompts: Dict[int, Sequence[int]],
                  sampling: SamplingParams = SamplingParams(),
-                 rng: Optional[jax.Array] = None) -> Dict[int, List[int]]:
+                 rng: Optional[jax.Array] = None
+                 ) -> Dict[int, List[int]]:  # tpulint: serving-loop
         """Convenience loop: run all prompts to max_new_tokens/stop.
         With ``InferenceConfig.decode_burst > 1``, decode-only rounds run
-        as device-side bursts."""
+        as device-side bursts; otherwise ``pipeline_depth >= 2`` (the
+        default) keeps one step in flight — host scheduling/staging and
+        token readback overlap device compute, and the sampled-token
+        array feeds the next step on device."""
         for uid, p in prompts.items():
             self.put(uid, p)
         done: Dict[int, List[int]] = {uid: [] for uid in prompts}
         active = set(prompts)
+        if self.icfg.decode_burst <= 1 and self.icfg.pipeline_depth >= 2:
+            return self._generate_pipelined(done, active, sampling, rng)
+        return self._generate_sync(done, active, sampling, rng)
+
+    def _generate_sync(self, done: Dict[int, List[int]], active: set,
+                       sampling: SamplingParams,
+                       rng: Optional[jax.Array]
+                       ) -> Dict[int, List[int]]:  # tpulint: serving-loop
+        """Strict step-at-a-time driver (``pipeline_depth=1`` debug mode,
+        and the burst dispatcher when ``decode_burst > 1``)."""
         i = 0
+        draw = self._rng_drawer(rng)
         while active:
-            if rng is not None:
-                rng, sub = jax.random.split(rng)
-            else:
-                sub = None
             pending = {u: t for u, t in self._pending.items() if t}
             decode_only = pending and all(
                 len(t) == 1 and u in self.state.seqs
@@ -896,10 +1122,11 @@ class InferenceEngine:
                 burst = (self.icfg.decode_burst
                          if room >= self.icfg.decode_burst else 1)
             if burst > 1:
-                outs = self.decode_burst(burst, sampling=sampling, rng=sub)
+                outs = self.decode_burst(burst, sampling=sampling,
+                                         rng=draw() if draw else None)
             else:
                 outs = {u: [t] for u, t in
-                        self.step(rng=sub, sampling=sampling).items()}
+                        self.step(rng=draw, sampling=sampling).items()}
             # sequences that hit the context limit end their generation
             for uid in list(self._ctx_exhausted):
                 if uid in active:
@@ -925,4 +1152,83 @@ class InferenceEngine:
             i += 1
             if i > 100_000:
                 raise RuntimeError("generate() did not terminate")
+        return done
+
+    def _generate_pipelined(self, done: Dict[int, List[int]], active: set,
+                            sampling: SamplingParams,
+                            rng: Optional[jax.Array]
+                            ) -> Dict[int, List[int]]:  # tpulint: serving-loop
+        """Depth-``pipeline_depth`` dispatch-ahead driver.
+
+        The loop keeps up to ``depth`` steps dispatched-but-unread: after
+        launching step N it immediately schedules, stages, and launches
+        step N+1 — continuing decodes ride the FEEDBACK_TOKEN marker, so
+        their token ids are read from step N's on-device sample array
+        inside the jitted step — and only then reads step N's tokens
+        back (by which point the device has long started N+1).  Host
+        work therefore overlaps device compute, and blocking readback
+        happens one step behind dispatch.
+
+        Stop tokens are the one thing the host cannot predict: a
+        sequence that stops at step N already has a speculative token in
+        flight at N+1, which is discarded at its collect (the same
+        over-generation trade decode bursts make).  max_new_tokens is
+        count-based, so the driver simply stops speculating a step
+        early.  Outputs are token-for-token identical to the sync driver
+        — both run the same compiled step program."""
+        depth = self.icfg.pipeline_depth
+        inflight: deque = deque()
+        finishing: set = set()    # ctx-exhausted, last token still in flight
+        counts = {uid: 0 for uid in done}   # emitted + in-flight samples
+        draw = self._rng_drawer(rng)
+        stall = 0
+        while active or inflight:
+            # fill the pipeline while there is schedulable work
+            while len(inflight) < depth and any(self._pending.values()):
+                st = self._dispatch(sampling, draw)
+                # sequences that hit the context limit stop being
+                # scheduled; finish them once their last sampled token
+                # (possibly still in flight) has been emitted
+                for uid in list(self._ctx_exhausted):
+                    self._ctx_exhausted.discard(uid)
+                    if uid in active:
+                        finishing.add(uid)
+                if st is None:
+                    break
+                # speculate continuations for this step's sampled seqs
+                for uid, _slot in st.emit:
+                    if uid not in active:
+                        continue               # put() outside generate()
+                    counts[uid] += 1
+                    if counts[uid] >= sampling.max_new_tokens:
+                        continue               # finishes by count at emit
+                    self._mark_feedback(uid, st)
+                inflight.append(st)
+            if inflight:
+                stall = 0
+                out = self._collect(inflight.popleft())
+                for uid, tok in out.items():
+                    if uid not in active:
+                        continue               # stopped earlier: discard
+                    done[uid].append(tok)
+                    stop = (sampling.stop_token is not None
+                            and tok == sampling.stop_token)
+                    if stop or len(done[uid]) >= sampling.max_new_tokens:
+                        active.discard(uid)
+                        finishing.discard(uid)
+                        self.flush(uid)
+            # ctx-exhausted seqs end once no in-flight step still holds
+            # their final token
+            for uid in list(finishing):
+                if not any(uid == u for s in inflight for u, _ in s.emit):
+                    finishing.discard(uid)
+                    active.discard(uid)
+                    self.flush(uid)
+            if not inflight and active:
+                # nothing running and nothing schedulable: either every
+                # remaining seq just finished above, or the pool is
+                # wedged (mirror the sync driver's bound)
+                stall += 1
+                if stall > 100_000:
+                    raise RuntimeError("generate() did not terminate")
         return done

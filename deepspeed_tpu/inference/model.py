@@ -446,6 +446,33 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
     return logits.astype(jnp.float32), new_kv
 
 
+def pipelined_ragged_step(cfg: TransformerConfig, params, quant, kv,
+                          batch: RaggedBatch, prev_toks, rng, sample_fn,
+                          block_size: int, max_blocks_per_seq: int,
+                          **fw_kwargs) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One serving pipeline stage, entirely on device: substitute
+    deferred feedback tokens from the previous step's on-device samples,
+    run the ragged forward, sample every slot's next token.
+
+    ``prev_toks``: [max_seqs] i32, the previous step's sample output
+    (still on device — the engine reads a step's tokens back only after
+    dispatching the next one).  ``batch.feedback_src[t] == s`` means
+    token ``t``'s id is ``prev_toks[s]`` rather than
+    ``batch.token_ids[t]``; -1 keeps the host-staged id.  Returns
+    (sampled tokens [max_seqs] i32, new_kv); rows of the token output
+    whose ``batch.logits_idx`` is -1 are garbage (callers mask by the
+    schedule, exactly like the logits of :func:`ragged_forward`)."""
+    fb = batch.feedback_src
+    if fb is not None:
+        tok = jnp.where(fb >= 0, prev_toks[jnp.maximum(fb, 0)],
+                        batch.token_ids)
+        batch = batch._replace(token_ids=tok)
+    logits, new_kv = ragged_forward(cfg, params, kv, batch, block_size,
+                                    max_blocks_per_seq, quant=quant,
+                                    **fw_kwargs)
+    return sample_fn(logits, rng), new_kv
+
+
 # --------------------------------------------------------------------------
 # Device-side decode bursts (multi-token decode in one dispatch)
 # --------------------------------------------------------------------------

@@ -27,6 +27,13 @@ import numpy as np
 
 from .allocator import BlockedAllocator
 
+# Sentinel token value in a pending queue meaning "the value is the
+# previous pipelined step's on-device sample for this sequence's slot" —
+# the host schedules position/blocks for it without ever reading the
+# token back (engine.py substitutes it inside the jitted step from the
+# prior step's [max_seqs] sample array).  Real token ids are >= 0.
+FEEDBACK_TOKEN = -1
+
 
 @dataclasses.dataclass
 class KVCacheConfig:
@@ -93,6 +100,53 @@ class RaggedBatch(NamedTuple):
                                  # last token this step (-1 if none)
     n_tokens: int                # real token count (static python int)
     n_seqs: int
+    feedback_src: Optional[jnp.ndarray] = None
+                                 # [T] i32: slot whose previous-step
+                                 # on-device sample supplies this token's
+                                 # id (-1 = token_ids holds the value)
+
+
+class BatchStager:
+    """Two alternating host-side staging buffer sets for RaggedBatch
+    metadata (the reference's pinned "fast host buffer",
+    ragged_wrapper.py).  The depth-2 serving pipeline builds step N+1's
+    metadata while step N executes on device; alternating buffers
+    guarantee the host never rewrites a set whose ``device_put`` transfer
+    for the previous step may still be draining.  Two sets suffice for
+    exactly one step in flight (``pipeline_depth=2``); deeper pipelines
+    get ``depth`` sets."""
+
+    def __init__(self, token_budget: int, max_seqs: int, max_blocks: int,
+                 depth: int = 2):
+        self.shape_key = (token_budget, max_seqs, max_blocks)
+        self._bufs = [self._alloc(token_budget, max_seqs, max_blocks)
+                      for _ in range(max(2, depth))]
+        self._i = 0
+
+    @staticmethod
+    def _alloc(T: int, S: int, nb: int) -> Dict[str, np.ndarray]:
+        return {
+            "token_ids": np.zeros(T, np.int32),
+            "positions": np.zeros(T, np.int32),
+            "seq_slot": np.zeros(T, np.int32),
+            "block_tables": np.full((S, nb), -1, np.int32),
+            "context_lens": np.zeros(S, np.int32),
+            "logits_idx": np.full(S, -1, np.int32),
+            "feedback_src": np.full(T, -1, np.int32),
+        }
+
+    def next_buffers(self) -> Dict[str, np.ndarray]:
+        """The next staging set, reset to its fill values."""
+        b = self._bufs[self._i]
+        self._i = (self._i + 1) % len(self._bufs)
+        b["token_ids"].fill(0)
+        b["positions"].fill(0)
+        b["seq_slot"].fill(0)
+        b["block_tables"].fill(-1)
+        b["context_lens"].fill(0)
+        b["logits_idx"].fill(-1)
+        b["feedback_src"].fill(-1)
+        return b
 
 
 class StateManager:
@@ -173,21 +227,39 @@ class StateManager:
         self.seqs[uid].seen_tokens += n_tokens
 
     # ---- batch building --------------------------------------------------
-    def build_batch(self, requests: List[tuple], token_budget: int
-                    ) -> RaggedBatch:
+    def build_batch(self, requests: List[tuple], token_budget: int,
+                    stager: Optional[BatchStager] = None) -> RaggedBatch:
         """requests: [(uid, list_of_new_token_ids)]; allocates KV blocks and
-        produces the padded device metadata."""
+        produces the padded device metadata.  A token id of
+        :data:`FEEDBACK_TOKEN` (single-token decode continuations only)
+        marks a deferred on-device token: the host stages id 0 and
+        records the sequence's slot in ``feedback_src`` so the jitted
+        step substitutes the previous step's sample.  With ``stager``,
+        metadata is written into its alternating pre-allocated buffers
+        instead of fresh arrays."""
         max_blocks = self.cfg.num_blocks
         T = token_budget
-        token_ids = np.zeros(T, np.int32)
-        positions = np.zeros(T, np.int32)
-        seq_slot = np.full(T, 0, np.int32)
-        # -1 pad: negative gather wraps to the KV array's last row, which
-        # is the zeroed trash block — padded columns can never alias a
-        # live block (they are also masked by position)
-        block_tables = np.full((self.max_seqs, max_blocks), -1, np.int32)
-        context_lens = np.zeros(self.max_seqs, np.int32)
-        logits_idx = np.full(self.max_seqs, -1, np.int32)
+        if stager is not None \
+                and stager.shape_key == (T, self.max_seqs, max_blocks):
+            bufs = stager.next_buffers()
+            token_ids = bufs["token_ids"]
+            positions = bufs["positions"]
+            seq_slot = bufs["seq_slot"]
+            block_tables = bufs["block_tables"]
+            context_lens = bufs["context_lens"]
+            logits_idx = bufs["logits_idx"]
+            feedback_src = bufs["feedback_src"]
+        else:
+            token_ids = np.zeros(T, np.int32)
+            positions = np.zeros(T, np.int32)
+            seq_slot = np.full(T, 0, np.int32)
+            # -1 pad: negative gather wraps to the KV array's last row,
+            # which is the zeroed trash block — padded columns can never
+            # alias a live block (they are also masked by position)
+            block_tables = np.full((self.max_seqs, max_blocks), -1, np.int32)
+            context_lens = np.zeros(self.max_seqs, np.int32)
+            logits_idx = np.full(self.max_seqs, -1, np.int32)
+            feedback_src = np.full(T, -1, np.int32)
 
         # keep existing sequences' tables valid even if not in this batch
         for uid, seq in self.seqs.items():
@@ -214,7 +286,13 @@ class StateManager:
                 seq.blocks.extend(self.allocator.allocate(need))
             s = self._slots[uid]
             block_tables[s, :len(seq.blocks)] = seq.blocks
-            token_ids[cursor:cursor + n] = new_tokens
+            if n == 1 and new_tokens[0] == FEEDBACK_TOKEN:
+                # deferred decode token: value comes from the previous
+                # step's on-device sample at this sequence's slot
+                token_ids[cursor] = 0
+                feedback_src[cursor] = s
+            else:
+                token_ids[cursor:cursor + n] = new_tokens
             positions[cursor:cursor + n] = np.arange(
                 seq.seen_tokens, seq.seen_tokens + n)
             seq_slot[cursor:cursor + n] = s
@@ -232,4 +310,5 @@ class StateManager:
             block_tables=jnp.asarray(block_tables),
             context_lens=jnp.asarray(context_lens),
             logits_idx=jnp.asarray(logits_idx),
-            n_tokens=cursor, n_seqs=n_seqs)
+            n_tokens=cursor, n_seqs=n_seqs,
+            feedback_src=jnp.asarray(feedback_src))

@@ -21,6 +21,18 @@ class SamplingParams:
     max_new_tokens: int = 64
     stop_token: Optional[int] = None
 
+    @property
+    def sampler_key(self) -> tuple:
+        """The fields that change the compiled sampling computation —
+        ``stop_token``/``max_new_tokens`` are host-side loop concerns, so
+        jitted steps that bake the sampler in (pipelined serving, decode
+        bursts) cache executables on this key, not the full params."""
+        return (self.temperature, self.top_k, self.top_p)
+
+    @property
+    def needs_rng(self) -> bool:
+        return self.temperature > 0.0
+
 
 def sample(logits: jnp.ndarray, params: SamplingParams,
            rng: Optional[jax.Array] = None) -> jnp.ndarray:

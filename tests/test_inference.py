@@ -345,6 +345,97 @@ class TestDecodeBurst:
         assert len(out[0]) > 0 and len(out[1]) > 0
 
 
+class TestPipelinedServing:
+    """Depth-2 dispatch-ahead serving loop (on-device sampling + deferred
+    token feedback + double-buffered staging) must be token-for-token
+    identical to the strict-sync loop — both run the same step
+    computation; only dispatch/readback cadence differs."""
+
+    PROMPTS = {0: [5, 17, 99, 3, 42], 1: [7, 7, 1]}
+
+    @staticmethod
+    def _gen(eng, prompts, sp, rng=None):
+        return eng.generate({u: list(p) for u, p in prompts.items()},
+                            sp, rng=rng)
+
+    def test_depth2_matches_sync(self):
+        """Greedy, stop-token, and seeded-sampling parity on one engine
+        pair (generate() flushes everything, so the engines are reused
+        across phases — and greedy/stop share one compiled step)."""
+        m = tiny_model()
+        e1 = make_fp32_engine(m, pipeline_depth=1)
+        e2 = make_fp32_engine(m, pipeline_depth=2)
+        sp = SamplingParams(max_new_tokens=10)
+        sync = self._gen(e1, self.PROMPTS, sp)
+        piped = self._gen(e2, self.PROMPTS, sp)
+        assert piped == sync
+        # stop token mid-stream: the pipelined driver has one speculative
+        # step in flight when it fires; its token must be discarded
+        sps = SamplingParams(max_new_tokens=50, stop_token=sync[0][3])
+        one = {0: self.PROMPTS[0]}
+        got = self._gen(e2, one, sps)
+        assert got == self._gen(e1, one, sps)
+        assert got[0][-1] == sync[0][3]
+        # fixed-rng sampling: both drivers consume the key stream
+        # identically (one split per launched step)
+        spr = SamplingParams(temperature=1.0, top_k=8, max_new_tokens=8)
+        assert self._gen(e2, self.PROMPTS, spr,
+                         rng=jax.random.PRNGKey(7)) \
+            == self._gen(e1, self.PROMPTS, spr, rng=jax.random.PRNGKey(7))
+        # no leaked feedback markers, sequences, slots, or blocks after
+        # the pipelined runs (speculation fully rolled up)
+        assert e2._fb_step == {}
+        assert not e2.state.seqs and not e2.state._slots
+        assert e2.state.allocator.free_blocks \
+            == e2.state.allocator.total_blocks
+        # per-phase breakdown recorded
+        t = e2.timings
+        assert t["steps"] > 0
+        assert all(t[k] >= 0.0 for k in ("schedule_ms", "stage_ms",
+                                         "device_ms", "wait_ms",
+                                         "readback_ms"))
+
+    def test_depth2_mixed_prefill_decode_traffic(self):
+        """Prompts straddling the token budget: chunked prefill, decode,
+        and prefill+decode mixed steps all pipeline identically."""
+        m = tiny_model()
+        r = np.random.RandomState(3)
+        prompts = {0: list(r.randint(1, 128, 50)), 1: [3, 1, 4],
+                   2: list(r.randint(1, 128, 20))}
+        sp = SamplingParams(max_new_tokens=6)
+        sync = self._gen(make_fp32_engine(m, pipeline_depth=1,
+                                          token_budget=16), prompts, sp)
+        piped = self._gen(make_fp32_engine(m, pipeline_depth=2,
+                                           token_budget=16), prompts, sp)
+        assert piped == sync
+
+    def test_depth3_budget_starvation(self):
+        """pipeline_depth=3 with a budget smaller than the live decode
+        count: a sequence's deferred feedback can outlive TWO dispatches,
+        so the scheduler must hold it until its owning step's collect
+        patches it concrete (feeding it the wrong step's sample array
+        would be silently wrong, not an error)."""
+        m = tiny_model()
+        prompts = {0: [5, 9], 1: [7, 7], 2: [3, 1], 3: [8, 2]}
+        sp = SamplingParams(max_new_tokens=5)
+        sync = self._gen(make_fp32_engine(m, pipeline_depth=1,
+                                          token_budget=2), prompts, sp)
+        piped = self._gen(make_fp32_engine(m, pipeline_depth=3,
+                                           token_budget=2), prompts, sp)
+        assert piped == sync
+
+    def test_depth2_context_limit(self):
+        """A sequence ending at the context limit still emits its final
+        in-flight token before the driver finishes it."""
+        m = tiny_model()
+        eng = make_fp32_engine(m, num_kv_blocks=2, kv_block_size=16,
+                               max_seqs=1, max_seq_len=32,
+                               pipeline_depth=2)
+        out = eng.generate({0: [1, 2, 3, 4]},
+                           SamplingParams(max_new_tokens=100))
+        assert len(out[0]) == 29        # same bound as the sync loop
+
+
 class TestChunkedPagedAttention:
     def test_chunked_matches_one_shot(self, monkeypatch):
         """Past the gather-bytes cap the XLA path streams one KV block at

@@ -1,0 +1,122 @@
+"""Property-style fuzz of the SplitFuse scheduler's admission
+invariants: across randomized put/schedule/flush interleavings,
+``_schedule()`` must never over-commit the token budget, the KV block
+pool, or the slot pool — and the batch it admits must always build
+without tripping ``build_batch``'s own guards (reference analog:
+``can_schedule`` engine_v2.py:184 + SchedulingResult).
+
+Pure host-side: the engine is constructed but no step is ever
+dispatched, so hundreds of scheduler rounds run in milliseconds."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference import (InferenceConfig, InferenceEngine,
+                                     SamplingParams)
+from deepspeed_tpu.inference.ragged.state import FEEDBACK_TOKEN
+from deepspeed_tpu.models import build_model
+
+
+@pytest.fixture(scope="module")
+def model():
+    return build_model("llama-tiny", vocab_size=128, num_layers=2,
+                       d_model=64, num_heads=4, num_kv_heads=2, d_ff=128,
+                       max_seq_len=256)
+
+
+def _check_invariants(eng, sched):
+    st = eng.state
+    budget = eng.icfg.token_budget
+    bs = eng.icfg.kv_block_size
+    # 1) token budget
+    n_toks = sum(len(t) for _, t in sched)
+    assert n_toks <= budget, f"budget over-commit: {n_toks} > {budget}"
+    # 2) KV block pool: blocks newly needed by the admitted batch fit
+    #    the free pool at admission time
+    need = 0
+    for uid, toks in sched:
+        seq = st.seqs.get(uid)
+        seen = seq.seen_tokens if seq else 0
+        have = len(seq.blocks) if seq else 0
+        need += max(0, -(-(seen + len(toks)) // bs) - have)
+    assert need <= st.allocator.free_blocks, \
+        f"block over-commit: need {need}, free {st.allocator.free_blocks}"
+    # 3) slot pool: new sequences admitted fit the free slots
+    new_seqs = {uid for uid, _ in sched if uid not in st._slots}
+    assert len(new_seqs) <= len(st._free_slots), \
+        f"slot over-commit: {len(new_seqs)} new > {len(st._free_slots)}"
+    # 4) per-seq context bound
+    for uid, toks in sched:
+        seq = st.seqs.get(uid)
+        seen = seq.seen_tokens if seq else 0
+        assert seen + len(toks) <= st.max_context_tokens
+
+
+def _check_pool_accounting(eng):
+    st = eng.state
+    held = [b for seq in st.seqs.values() for b in seq.blocks]
+    # no block owned twice, and free + held covers the pool exactly
+    assert len(held) == len(set(held)), "block aliased across sequences"
+    assert st.allocator.free_blocks + len(held) \
+        == st.allocator.total_blocks
+    # slots unique and consistent
+    slots = list(st._slots.values())
+    assert len(slots) == len(set(slots))
+    assert len(slots) + len(st._free_slots) == st.max_seqs
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_schedule_never_overcommits(model, seed):
+    r = np.random.RandomState(seed)
+    # deliberately tight pools: 6 blocks of 8 tokens, 3 slots, budget 16
+    eng = InferenceEngine(model, InferenceConfig(
+        token_budget=16, max_seqs=3, kv_block_size=8, num_kv_blocks=6,
+        max_seq_len=48))
+    next_uid = 0
+    for _ in range(250):
+        op = r.randint(4)
+        live = list(eng.state.seqs)
+        if op == 0:                          # new prompt (any length)
+            eng.put(next_uid, list(r.randint(1, 128, r.randint(1, 40))))
+            next_uid += 1
+        elif op == 1 and live:               # decode continuation
+            uid = live[r.randint(len(live))]
+            if not eng._pending.get(uid):
+                eng.put(uid, [int(r.randint(1, 128))])
+        elif op == 2 and live:               # flush a random live seq
+            eng.flush(live[r.randint(len(live))])
+        else:                                # run the scheduler
+            sched = eng._schedule()
+            _check_invariants(eng, sched)
+            if sched:
+                # the admitted batch must build cleanly (allocates the
+                # reserved blocks for real)
+                eng.state.build_batch(sched, eng.icfg.token_budget,
+                                      stager=eng._stager)
+        _check_pool_accounting(eng)
+
+
+def test_schedule_feedback_markers_admit_like_decodes(model):
+    """Deferred-feedback pendings (the pipelined driver's speculative
+    continuations) schedule exactly like concrete decode tokens — but
+    ONLY while owned by the most recent dispatch; a marker deferring to
+    an older still-uncollected step is held back (its value would be
+    read from the wrong sample array)."""
+    eng = InferenceEngine(model, InferenceConfig(
+        token_budget=16, max_seqs=3, kv_block_size=8, num_kv_blocks=6,
+        max_seq_len=48))
+    eng.put(0, [1, 2, 3])
+    sched = eng._schedule()
+    eng.state.build_batch(sched, eng.icfg.token_budget)
+    eng._pending[0] = [FEEDBACK_TOKEN]
+    eng._fb_step[0] = eng._dispatch_seq      # _mark_feedback's contract
+    sched = eng._schedule()
+    assert sched == [(0, [FEEDBACK_TOKEN])]
+    b = eng.state.build_batch(sched, eng.icfg.token_budget)
+    assert int(b.feedback_src[0]) == eng.state.slot(0)
+    assert int(b.token_ids[0]) == 0          # host stages a benign id
+    _check_pool_accounting(eng)
+    # marker owned by an OLDER dispatch: unschedulable until patched
+    eng._pending[0] = [FEEDBACK_TOKEN]
+    eng._fb_step[0] = eng._dispatch_seq - 1
+    assert eng._schedule() == []

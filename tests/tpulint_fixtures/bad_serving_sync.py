@@ -1,0 +1,20 @@
+"""Known-bad: blocking device->host readbacks inside serving-loop
+methods (tpulint: serving-sync)."""
+import numpy as np
+
+
+class Engine:
+    def step(self):  # tpulint: serving-loop
+        toks = self._run()
+        fetched = np.asarray(toks)          # BAD: per-step readback
+        score = float(toks[0])              # BAD: float() on array value
+        one = toks.item()                   # BAD: .item() blocks
+        return fetched, score, one
+
+    def emit(
+            self, st):  # tpulint: serving-loop
+        # marker on a multi-line def header still marks the method
+        return np.array(st.toks)            # BAD: ad-hoc materialization
+
+    def _run(self):
+        return [0]

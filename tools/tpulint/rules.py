@@ -239,6 +239,84 @@ def check_host_sync(ctx: FileContext) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
+# rule: serving-sync — blocking readbacks inside marked serving-loop code
+# --------------------------------------------------------------------------
+
+# marker comment that declares a function part of the serving hot loop
+# (documented in docs/TPULINT.md and docs/SERVING.md): every device->host
+# materialization inside it lands on the per-token critical path, so all
+# token fetches must funnel through the single pragma'd emit point
+_SERVING_MARK = "serving-loop"
+_SERVING_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray",
+                       "numpy.array", "onp.asarray", "onp.array"}
+
+
+def _serving_marked_lines(source: str) -> Set[int]:
+    """Line numbers of ``# tpulint: serving-loop`` COMMENT tokens (a
+    docstring mentioning the marker must not mark anything)."""
+    import io
+    import re
+    import tokenize
+
+    pat = re.compile(r"#\s*tpulint:\s*" + _SERVING_MARK + r"\b")
+    out: Set[int] = set()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return out
+    for tok in tokens:
+        if tok.type == tokenize.COMMENT and pat.search(tok.string):
+            out.add(tok.start[0])
+    return out
+
+
+@rule("serving-sync",
+      "blocking device->host readback (np.asarray/float/.item/device_get) "
+      "inside a '# tpulint: serving-loop' marked method — route token "
+      "fetches through the one pragma'd emit point")
+def check_serving_sync(ctx: FileContext) -> Iterator[Finding]:
+    marked = _serving_marked_lines(ctx.source)
+    if not marked:
+        return
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # the marker sits on the def header (possibly multi-line): any
+        # marked line between `def` and the first body statement
+        header = range(fn.lineno, fn.body[0].lineno + 1)
+        if not any(ln in marked for ln in header):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func) or ""
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                yield Finding("serving-sync", ctx.path, node.lineno,
+                              node.col_offset,
+                              ".item() blocks the serving loop on a "
+                              "device->host sync")
+            elif d in _SERVING_SYNC_CALLS and node.args \
+                    and not _is_static_expr(node.args[0]):
+                yield Finding("serving-sync", ctx.path, node.lineno,
+                              node.col_offset,
+                              f"{d}() materializes a device value on the "
+                              "serving loop's critical path — defer to "
+                              "the sanctioned emit point")
+            elif d == "float" and len(node.args) == 1 \
+                    and not isinstance(node.args[0], ast.Constant) \
+                    and not _is_static_expr(node.args[0]):
+                yield Finding("serving-sync", ctx.path, node.lineno,
+                              node.col_offset,
+                              "float() on an array value blocks the "
+                              "serving loop until the device catches up")
+            elif d in ("jax.device_get", "device_get"):
+                yield Finding("serving-sync", ctx.path, node.lineno,
+                              node.col_offset,
+                              "device_get inside a serving-loop method")
+
+
+# --------------------------------------------------------------------------
 # rule: static-args — recompilation / hashability hazards on jit params
 # --------------------------------------------------------------------------
 
