@@ -307,7 +307,11 @@ def moe_ffn(gate_p, expert_p, x, *, top_k: int, capacity_factor: float,
     E = expert_p["wi"].shape[0]
     cap = capacity_for(S, E, top_k, capacity_factor, min_capacity)
     if noise_policy == "Jitter" and rng is not None:
-        xg = x * jax.random.uniform(rng, x.shape, minval=0.98, maxval=1.02)
+        # jitter gets its own stream: reusing ``rng`` here would
+        # correlate the input jitter with the gating noise drawn below
+        jitter_rng, rng = jax.random.split(rng)
+        xg = x * jax.random.uniform(jitter_rng, x.shape,
+                                    minval=0.98, maxval=1.02)
     else:
         xg = x
     logits = jnp.einsum("gtd,de->gte", xg, gate_p["kernel"].astype(x.dtype))

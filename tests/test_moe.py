@@ -60,20 +60,20 @@ class TestGating:
 
 class TestExperts:
     def test_moe_ffn_shapes(self):
-        key = jax.random.PRNGKey(0)
-        gp, _ = M.gate_init(key, 32, 4)
-        ep, _ = M.experts_init(key, 4, 32, 64)
-        x = jax.random.normal(key, (2, 8, 32))
+        kg, ke, kx = jax.random.split(jax.random.PRNGKey(0), 3)
+        gp, _ = M.gate_init(kg, 32, 4)
+        ep, _ = M.experts_init(ke, 4, 32, 64)
+        x = jax.random.normal(kx, (2, 8, 32))
         y, metrics = M.moe_ffn(gp, ep, x, top_k=2, capacity_factor=2.0)
         assert y.shape == x.shape
         assert "moe_aux_loss" in metrics
 
     def test_single_expert_equals_dense(self):
         """E=1, k=1, ample capacity: MoE == plain FFN with that expert."""
-        key = jax.random.PRNGKey(0)
-        gp, _ = M.gate_init(key, 16, 1)
-        ep, _ = M.experts_init(key, 1, 16, 32)
-        x = jax.random.normal(key, (1, 4, 16))
+        kg, ke, kx = jax.random.split(jax.random.PRNGKey(0), 3)
+        gp, _ = M.gate_init(kg, 16, 1)
+        ep, _ = M.experts_init(ke, 1, 16, 32)
+        x = jax.random.normal(kx, (1, 4, 16))
         y, _ = M.moe_ffn(gp, ep, x, top_k=1, capacity_factor=8.0,
                          activation=jax.nn.gelu)
         ref = jax.nn.gelu(x[0] @ ep["wi"][0]) @ ep["wo"][0]
@@ -186,12 +186,11 @@ class TestRaggedDispatch:
     def test_matches_einsum_when_nothing_drops(self):
         from deepspeed_tpu.parallel import moe as M
 
-        key = jax.random.PRNGKey(0)
+        kg, ke, kx = jax.random.split(jax.random.PRNGKey(0), 3)
         E, dm, dff, B, S = 4, 32, 64, 2, 16
-        gp, _ = M.gate_init(key, dm, E)
-        ep, _ = M.experts_init(jax.random.fold_in(key, 1), E, dm, dff)
-        x = jax.random.normal(jax.random.fold_in(key, 2), (B, S, dm),
-                              jnp.float32)
+        gp, _ = M.gate_init(kg, dm, E)
+        ep, _ = M.experts_init(ke, E, dm, dff)
+        x = jax.random.normal(kx, (B, S, dm), jnp.float32)
         kw = dict(top_k=2, min_capacity=4, activation=jax.nn.gelu,
                   gated=False)
         # capacity_factor huge -> the einsum path drops nothing, so the
@@ -214,13 +213,13 @@ class TestRaggedDispatch:
         traffic (the capacity paths would drop)."""
         from deepspeed_tpu.parallel import moe as M
 
-        key = jax.random.PRNGKey(3)
+        kg, ke, kx = jax.random.split(jax.random.PRNGKey(3), 3)
         E, dm, dff = 4, 16, 32
-        gp, _ = M.gate_init(key, dm, E)
+        gp, _ = M.gate_init(kg, dm, E)
         # bias the gate hard toward expert 0
         gp = {"kernel": gp["kernel"].at[:, 0].add(10.0)}
-        ep, _ = M.experts_init(jax.random.fold_in(key, 1), E, dm, dff)
-        x = jax.random.normal(jax.random.fold_in(key, 2), (1, 32, dm))
+        ep, _ = M.experts_init(ke, E, dm, dff)
+        x = jax.random.normal(kx, (1, 32, dm))
         y, m = M.moe_ffn(gp, ep, x, top_k=1, capacity_factor=1.0,
                          min_capacity=2, activation=jax.nn.gelu,
                          gated=False, dispatch_mode="ragged")

@@ -1,0 +1,76 @@
+"""Known-bad: donated-buffer lifetimes across call boundaries
+(tpulint: donation-lifetime)."""
+import jax
+import jax.numpy as jnp
+
+
+def step(params, kv, batch):
+    return kv + batch, kv * 2
+
+
+def step2(params, kv):
+    return kv + 1, kv * 2
+
+
+class Engine:
+    """Donating binding stored on ``self`` in one method, misused in
+    another — invisible to per-file, per-scope analysis."""
+
+    def __init__(self):
+        self.kv = jnp.zeros((4, 4))
+        self._step = jax.jit(step, donate_argnums=(1,))
+
+    def run(self, params, batch):
+        out, _ = self._step(params, self.kv, batch)
+        return out + self.kv               # BAD: self.kv was donated
+
+
+class Pipelined:
+    """Donating binding produced by a builder method."""
+
+    def _build(self):
+        def pstep(params, kv):
+            return kv * 2, kv + 1
+        return jax.jit(pstep, donate_argnums=(1,))
+
+    def serve(self, params):
+        fn = self._build()
+        kv = jnp.zeros((2, 2))
+        a, _ = fn(params, kv)
+        return a + kv                      # BAD: kv donated via builder fn
+
+
+class Cache:
+    def __init__(self):
+        self.saved = None
+
+    def stash(self, kv):
+        self.saved = kv
+
+
+def run_with_stash(params, batch):
+    step_fn = jax.jit(step, donate_argnums=(1,))
+    cache = Cache()
+    kv = jnp.zeros((4, 4))
+    cache.stash(kv)
+    out, _ = step_fn(params, kv, batch)    # BAD: cache.saved aliases kv
+    return out, cache
+
+
+def consume(params, kv):
+    fn = jax.jit(step2, donate_argnums=(1,))
+    out, _ = fn(params, kv)
+    return out
+
+
+def call_then_reuse(params):
+    kv = jnp.zeros((4, 4))
+    out = consume(params, kv)
+    return out + kv                        # BAD: consume() donated kv
+
+
+def alias_positions(params):
+    fn = jax.jit(step2, donate_argnums=(1,))
+    kv = jnp.zeros((4, 4))
+    out, _ = fn(kv, kv)                    # BAD: donated AND read in one call
+    return out

@@ -1,0 +1,22 @@
+"""Known-bad: silent bf16/f32 promotion in traced code (tpulint:
+dtype-flow) — the _mm residual-stream bug class."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def promote_local(x):
+    w = jnp.zeros((4, 4), dtype=jnp.float32)
+    h = x.astype(jnp.bfloat16)
+    return h @ w                           # BAD: bf16 @ f32 -> silent f32
+
+
+def helper(h, w):
+    return h * w                           # BAD: mixes caller's bf16 and f32
+
+
+@jax.jit
+def promote_through_call(x):
+    h = x.astype(jnp.bfloat16)
+    w = jnp.ones((4,), dtype=jnp.float32)
+    return helper(h, w)

@@ -1,0 +1,48 @@
+"""Known-bad: recompilation hazards (tpulint: retrace-hazard)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def fn(x):
+    return x * 2
+
+
+def jit_in_loop(x):
+    outs = []
+    for _ in range(3):
+        f = jax.jit(fn)                    # BAD: fresh wrapper every turn
+        outs.append(f(x))
+    return outs
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def padded(x, n):
+    return jnp.pad(x, (0, n - x.shape[0]))
+
+
+def varying_static(x):
+    outs = []
+    for n in range(1, 5):
+        outs.append(padded(x, n=n))        # BAD: static arg varies per turn
+    return outs
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def configured(x, cfg=None):
+    return x
+
+
+def unhashable_static(x):
+    return configured(x, cfg={"a": 1})     # BAD: dict can never hash
+
+
+step = jax.jit(fn)
+
+
+def varying_shapes(n):
+    outs = []
+    for i in range(1, n):
+        outs.append(step(jnp.zeros((i, 4))))   # BAD: new shape per turn
+    return outs

@@ -1,0 +1,32 @@
+"""Known-bad: PRNG key misuse (tpulint: rng-discipline)."""
+import jax
+import jax.numpy as jnp
+
+
+def double_consume(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.normal(key, (4,))       # BAD: key already consumed
+    return a + b
+
+
+def use_after_split(key):
+    k1, k2 = jax.random.split(key)
+    noise = jax.random.normal(key, (2,))   # BAD: parent key is dead
+    return k1, k2, noise
+
+
+def loop_invariant(key):
+    out = []
+    for _ in range(4):
+        out.append(jax.random.uniform(key, (2,)))   # BAD: same draw each turn
+    return jnp.stack(out)
+
+
+def draw(k):
+    return jax.random.normal(k, (2,))
+
+
+def helper_double(key):
+    x = draw(key)
+    y = draw(key)                          # BAD: draw() consumed key already
+    return x + y

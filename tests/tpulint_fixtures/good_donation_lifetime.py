@@ -1,0 +1,67 @@
+"""Known-good twin of bad_donation_lifetime (no findings)."""
+import jax
+import jax.numpy as jnp
+
+
+def step(params, kv, batch):
+    return kv + batch, kv * 2
+
+
+def step2(params, kv):
+    return kv + 1, kv * 2
+
+
+class Engine:
+    def __init__(self):
+        self.kv = jnp.zeros((4, 4))
+        self._step = jax.jit(step, donate_argnums=(1,))
+
+    def run(self, params, batch):
+        out, self.kv = self._step(params, self.kv, batch)
+        return out + self.kv               # rebound: fresh buffer
+
+
+class Pipelined:
+    def _build(self):
+        def pstep(params, kv):
+            return kv * 2, kv + 1
+        return jax.jit(pstep, donate_argnums=(1,))
+
+    def serve(self, params):
+        fn = self._build()
+        kv = jnp.zeros((2, 2))
+        a, kv = fn(params, kv)             # rebound in the call
+        return a + kv
+
+
+class Cache:
+    def peek(self, kv):
+        return float(jnp.sum(kv))          # reads, stores nothing
+
+
+def run_with_peek(params, batch):
+    step_fn = jax.jit(step, donate_argnums=(1,))
+    cache = Cache()
+    kv = jnp.zeros((4, 4))
+    cache.peek(kv)
+    out, kv = step_fn(params, kv, batch)
+    return out, kv
+
+
+def consume(params, kv):
+    fn = jax.jit(step2, donate_argnums=(1,))
+    out, _ = fn(params, kv)
+    return out
+
+
+def call_no_reuse(params):
+    kv = jnp.zeros((4, 4))
+    out = consume(params, kv)
+    return out * 2
+
+
+def distinct_positions(params):
+    fn = jax.jit(step2, donate_argnums=(1,))
+    kv = jnp.zeros((4, 4))
+    out, _ = fn(params, kv)
+    return out

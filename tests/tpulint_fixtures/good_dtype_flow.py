@@ -1,0 +1,24 @@
+"""Known-good twin of bad_dtype_flow (no dtype-flow findings)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def explicit_cast(x):
+    w = jnp.zeros((4, 4), dtype=jnp.float32)
+    h = x.astype(jnp.bfloat16)
+    wide = h.astype(jnp.float32) @ w       # widened deliberately
+    narrow = h @ w.astype(jnp.bfloat16)    # narrowed deliberately
+    acc = (h @ w.astype(jnp.bfloat16)).astype(jnp.float32)
+    return wide, narrow, acc
+
+
+def helper(h, w):
+    return h * w.astype(h.dtype)           # runtime-matched, not static
+
+
+@jax.jit
+def matched_through_call(x):
+    h = x.astype(jnp.bfloat16)
+    w = jnp.ones((4,), dtype=jnp.float32)
+    return helper(h, w)

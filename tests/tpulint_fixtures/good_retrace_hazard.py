@@ -1,0 +1,55 @@
+"""Known-good twin of bad_retrace_hazard (no findings)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def fn(x):
+    return x * 2
+
+
+def jit_hoisted(x):
+    f = jax.jit(fn)                        # one wrapper, reused
+    outs = []
+    for _ in range(3):
+        outs.append(f(x))
+    return outs
+
+
+def jit_cache_fill(xs):
+    cache = {}
+    for n in (1, 2, 4):
+        cache[n] = jax.jit(fn)             # keyed executable cache
+    return [cache[n](x) for n, x in zip((1, 2, 4), xs)]
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def padded(x, n):
+    return jnp.pad(x, (0, n - x.shape[0]))
+
+
+def constant_static(xs):
+    outs = []
+    for x in xs:
+        outs.append(padded(x, n=8))        # static arg never changes
+    return outs
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def configured(x, cfg=None):
+    return x
+
+
+def hashable_static(x):
+    return configured(x, cfg=(1, 2))
+
+
+step = jax.jit(fn)
+
+
+def stable_shapes(n):
+    outs = []
+    for _ in range(1, n):
+        outs.append(step(jnp.zeros((4, 4))))
+    return outs

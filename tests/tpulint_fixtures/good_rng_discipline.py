@@ -1,0 +1,41 @@
+"""Known-good twin of bad_rng_discipline (no rng-discipline findings)."""
+import jax
+import jax.numpy as jnp
+
+
+def split_chain(key):
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, (4,))
+    key, sub = jax.random.split(key)
+    b = jax.random.normal(sub, (4,))
+    return a + b
+
+
+def fold_per_iteration(key):
+    out = []
+    for i in range(4):
+        k = jax.random.fold_in(key, i)
+        out.append(jax.random.uniform(k, (2,)))
+    return jnp.stack(out)
+
+
+def loop_over_split_keys(key):
+    out = []
+    for k in jax.random.split(key, 4):
+        out.append(jax.random.normal(k, (2,)))
+    return jnp.stack(out)
+
+
+def exclusive_branches(key, flag):
+    if flag:
+        return jax.random.normal(key, (2,))
+    return jax.random.uniform(key, (2,))
+
+
+def draw(k):
+    return jax.random.normal(k, (2,))
+
+
+def helper_fresh_keys(key):
+    sub1, sub2 = jax.random.split(key)
+    return draw(sub1) + draw(sub2)

@@ -2,22 +2,90 @@
 
 Exits non-zero when any finding survives suppression — wire it straight
 into CI (tests/test_tpulint.py runs it over the whole tree as tier-1).
+
+Gate-scaling modes for a growing tree:
+
+* ``--write-baseline FILE`` snapshots the current findings as accepted;
+* ``--baseline FILE`` subtracts that snapshot (matched on
+  rule+path+message, line-number tolerant) and fails only on NEW
+  findings;
+* ``--changed`` reports only findings in git-dirty files.  The
+  whole-program pass still analyzes every file — cross-file context is
+  never truncated — only the report is filtered.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import re
+import subprocess
 import sys
+from collections import Counter
+from pathlib import Path
+from typing import List, Optional, Set
 
-from .core import RULES, find_mesh_axes, lint_paths
+from .core import RULES, Finding, find_mesh_axes, lint_paths
+
+
+_DIGITS = re.compile(r"\d+")
+
+
+def _fingerprint(rule: str, path: str, message: str):
+    """Stable identity for baseline matching: line numbers drift as the
+    file is edited, so the finding's own line is excluded AND numbers
+    embedded in messages (\"...consumed by split (line 42)...\") are
+    normalized away."""
+    return (rule, path, _DIGITS.sub("#", message))
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: List[dict]) -> List[Finding]:
+    """Findings not covered by the baseline snapshot (multiset match)."""
+    budget = Counter(_fingerprint(d["rule"], d["path"], d["message"])
+                     for d in baseline)
+    fresh: List[Finding] = []
+    for f in findings:
+        fp = _fingerprint(f.rule, f.path, f.message)
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+        else:
+            fresh.append(f)
+    return fresh
+
+
+def git_dirty_files(repo_cwd: str = ".") -> Optional[Set[str]]:
+    """Absolute paths of modified/added/untracked .py files, or None
+    when git is unavailable (callers fall back to a full run)."""
+    try:
+        # --untracked-files=all: a brand-new package must list its .py
+        # files, not collapse to one "?? dir/" entry
+        r = subprocess.run(
+            ["git", "status", "--porcelain", "--untracked-files=all"],
+            cwd=repo_cwd, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if r.returncode != 0:
+        return None
+    out: Set[str] = set()
+    for line in r.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].strip()
+        if " -> " in path:                     # rename: take the new side
+            path = path.split(" -> ", 1)[1]
+        path = path.strip('"')
+        if path.endswith(".py"):
+            out.add(str((Path(repo_cwd) / path).resolve()))
+    return out
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="tpulint",
         description="JAX/TPU-aware static analysis (pure AST, no "
-                    "imports of the target modules)")
+                    "imports of the target modules; two passes: "
+                    "per-file rules + whole-program dataflow)")
     ap.add_argument("paths", nargs="*", default=["deepspeed_tpu", "tests"],
                     help="files or directories to lint "
                          "(default: deepspeed_tpu tests)")
@@ -27,18 +95,65 @@ def main(argv=None) -> int:
                     help="comma-separated subset of rules to run")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="accepted-findings snapshot (from "
+                         "--write-baseline); only NEW findings fail")
+    ap.add_argument("--write-baseline", default=None, metavar="FILE",
+                    help="snapshot current findings as the accepted "
+                         "baseline and exit 0")
+    ap.add_argument("--changed", action="store_true",
+                    help="report only findings in git-dirty files (the "
+                         "program pass still sees the whole tree)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for name, r in sorted(RULES.items()):
-            scope = " [library-only]" if r.library_only else ""
-            print(f"{name}{scope}: {r.doc}")
+            tags = []
+            if r.library_only:
+                tags.append("library-only")
+            if r.scope == "program":
+                tags.append("whole-program")
+            suffix = f" [{', '.join(tags)}]" if tags else ""
+            print(f"{name}{suffix}: {r.doc}")
         return 0
 
     rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
              if args.rules else None)
     paths = args.paths or ["deepspeed_tpu", "tests"]
-    findings = lint_paths(paths, rules=rules)
+
+    if args.write_baseline and args.changed:
+        # a dirty-files-only snapshot would make every CLEAN file's
+        # accepted finding fail the next full run
+        ap.error("--write-baseline snapshots the full tree; "
+                 "it cannot be combined with --changed")
+
+    report_only = None
+    if args.changed:
+        dirty = git_dirty_files()
+        if dirty is None:
+            print("tpulint: --changed needs git; linting everything",
+                  file=sys.stderr)
+        else:
+            report_only = dirty
+            if not dirty:
+                print("tpulint: no dirty .py files", file=sys.stderr)
+                return 0
+
+    findings = lint_paths(paths, rules=rules, report_only=report_only)
+
+    if args.write_baseline:
+        Path(args.write_baseline).write_text(json.dumps(
+            [f.json() for f in findings], indent=2) + "\n")
+        print(f"tpulint: baseline with {len(findings)} finding(s) "
+              f"written to {args.write_baseline}", file=sys.stderr)
+        return 0
+
+    if args.baseline:
+        baseline = json.loads(Path(args.baseline).read_text())
+        before = len(findings)
+        findings = apply_baseline(findings, baseline)
+        print(f"tpulint: baseline absorbed {before - len(findings)} "
+              f"of {before} finding(s)", file=sys.stderr)
 
     if args.as_json:
         print(json.dumps([f.json() for f in findings], indent=2))

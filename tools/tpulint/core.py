@@ -56,9 +56,14 @@ class FileContext:
 
     _line_disable: Dict[int, Set[str]] = dataclasses.field(default=None)
     _file_disable: Set[str] = dataclasses.field(default=None)
+    # (line, text) of every COMMENT token — tokenized exactly once and
+    # shared by every rule that reads marker comments
+    comments: List[tuple] = dataclasses.field(default=None)
 
     def __post_init__(self):
-        self._line_disable, self._file_disable = _parse_pragmas(self.source)
+        self.comments = _comment_tokens(self.source)
+        self._line_disable, self._file_disable = \
+            _parse_pragmas(self.comments)
 
     def suppressed(self, rule: str, line: int) -> bool:
         for s in (self._file_disable, self._line_disable.get(line, ())):
@@ -67,30 +72,36 @@ class FileContext:
         return False
 
 
-def _parse_pragmas(source: str):
-    """Pragmas from COMMENT tokens only — a docstring that documents the
-    suppression syntax (like this module's) must not disable rules."""
+def _comment_tokens(source: str):
+    """(line, text) for every COMMENT token — comments only, so a
+    docstring that merely documents a marker never activates it."""
     import io
     import tokenize
 
+    out = []
+    if "#" not in source:
+        return out
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out.append((tok.start[0], tok.string))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return out
+
+
+def _parse_pragmas(comments):
     line_disable: Dict[int, Set[str]] = {}
     file_disable: Set[str] = set()
-    try:
-        tokens = list(tokenize.generate_tokens(
-            io.StringIO(source).readline))
-    except (tokenize.TokenError, IndentationError, SyntaxError):
-        return line_disable, file_disable
-    for tok in tokens:
-        if tok.type != tokenize.COMMENT:
-            continue
-        m = _PRAGMA.search(tok.string)
+    for line, text in comments:
+        m = _PRAGMA.search(text)
         if not m:
             continue
         names = {n.strip() for n in m.group(2).split(",") if n.strip()}
         if m.group(1) == "disable-file":
             file_disable |= names
         else:
-            line_disable.setdefault(tok.start[0], set()).update(names)
+            line_disable.setdefault(line, set()).update(names)
     return line_disable, file_disable
 
 
@@ -102,17 +113,22 @@ def _parse_pragmas(source: str):
 class Rule:
     name: str
     doc: str
-    check: Callable[["FileContext"], Iterator[Finding]]
+    check: Callable[..., Iterator[Finding]]
     library_only: bool = False    # skip test files (prints etc. are fine)
+    scope: str = "file"           # "file": check(FileContext);
+    #                               "program": check(graph.Program)
 
 
 RULES: Dict[str, Rule] = {}
 
 
-def rule(name: str, doc: str, library_only: bool = False):
-    """Register a rule.  ``check(ctx)`` yields Findings."""
+def rule(name: str, doc: str, library_only: bool = False,
+         scope: str = "file"):
+    """Register a rule.  ``check(ctx)`` yields Findings — a
+    :class:`FileContext` for per-file rules, the whole-program
+    :class:`graph.Program` for ``scope="program"`` (pass 2) rules."""
     def deco(fn):
-        RULES[name] = Rule(name, doc, fn, library_only)
+        RULES[name] = Rule(name, doc, fn, library_only, scope)
         return fn
     return deco
 
@@ -193,19 +209,25 @@ def _axes_from_source(source: str) -> Set[str]:
     return axes
 
 
+def parse_context(path: Path, mesh_axes: Set[str]) -> "FileContext":
+    source = path.read_text()
+    tree = ast.parse(source, filename=str(path))   # SyntaxError propagates
+    return FileContext(path=str(path), source=source, tree=tree,
+                       is_test=_is_test_path(path), mesh_axes=mesh_axes)
+
+
 def lint_file(path: Path, mesh_axes: Set[str],
               rules: Optional[Iterable[Rule]] = None) -> List[Finding]:
-    source = path.read_text()
+    """Per-file (pass 1) rules only; :func:`lint_paths` adds the
+    whole-program pass."""
     try:
-        tree = ast.parse(source, filename=str(path))
+        ctx = parse_context(path, mesh_axes)
     except SyntaxError as e:
         return [Finding("syntax", str(path), e.lineno or 0, 0,
                         f"cannot parse: {e.msg}")]
-    ctx = FileContext(path=str(path), source=source, tree=tree,
-                      is_test=_is_test_path(path), mesh_axes=mesh_axes)
     findings: List[Finding] = []
     for r in (rules if rules is not None else RULES.values()):
-        if r.library_only and ctx.is_test:
+        if r.scope != "file" or (r.library_only and ctx.is_test):
             continue
         findings.extend(f for f in r.check(ctx)
                         if not ctx.suppressed(r.name, f.line))
@@ -214,16 +236,54 @@ def lint_file(path: Path, mesh_axes: Set[str],
 
 def lint_paths(paths: Iterable[str],
                mesh_axes: Optional[Set[str]] = None,
-               rules: Optional[Iterable[str]] = None) -> List[Finding]:
+               rules: Optional[Iterable[str]] = None,
+               report_only: Optional[Set[str]] = None) -> List[Finding]:
+    """Two-pass run: per-file rules on every file, then the
+    whole-program dataflow rules over the combined module graph.
+    ``report_only``: when given (absolute paths), findings outside the
+    set are dropped AFTER analysis — the program pass still sees every
+    file, so cross-file context is never lost (``--changed`` mode)."""
     from . import rules as _rules  # noqa: F401  (populate the registry)
+    from . import dataflow as _dataflow  # noqa: F401
     axes = mesh_axes if mesh_axes is not None else find_mesh_axes(paths)
-    selected = None
+    selected = list(RULES.values())
     if rules is not None:
         unknown = set(rules) - set(RULES)
         if unknown:
             raise ValueError(f"unknown rules: {sorted(unknown)}")
         selected = [RULES[n] for n in rules]
+    file_rules = [r for r in selected if r.scope == "file"]
+    program_rules = [r for r in selected if r.scope == "program"]
+
     out: List[Finding] = []
+    ctxs: List[FileContext] = []
     for f in collect_files(paths):
-        out.extend(lint_file(f, axes, selected))
+        try:
+            ctx = parse_context(f, axes)
+        except SyntaxError as e:
+            out.append(Finding("syntax", str(f), e.lineno or 0, 0,
+                               f"cannot parse: {e.msg}"))
+            continue
+        ctxs.append(ctx)
+        for r in file_rules:
+            if r.library_only and ctx.is_test:
+                continue
+            out.extend(fd for fd in r.check(ctx)
+                       if not ctx.suppressed(r.name, fd.line))
+
+    if program_rules and ctxs:
+        from .graph import build_program
+        program = build_program(ctxs)
+        for r in program_rules:
+            for fd in r.check(program):
+                ctx = program.ctx_for(fd.path)
+                if ctx is not None and (
+                        ctx.suppressed(r.name, fd.line)
+                        or (r.library_only and ctx.is_test)):
+                    continue
+                out.append(fd)
+
+    if report_only is not None:
+        keep = {str(Path(p).resolve()) for p in report_only}
+        out = [f for f in out if str(Path(f.path).resolve()) in keep]
     return sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule))

@@ -1,0 +1,1198 @@
+"""tpulint pass 2: whole-program dataflow rules.
+
+Four rule families over the :class:`graph.Program` context, each
+targeting a bug class this repo has actually shipped and fixed by hand:
+
+* ``rng-discipline`` — a PRNG key consumed twice without an intervening
+  ``split``/``fold_in`` rebind, and a loop-invariant key sampled inside
+  a loop (every iteration draws the same randomness).  Interprocedural:
+  passing a key to a helper that feeds it to ``jax.random`` counts as a
+  consumption at the call site.
+* ``dtype-flow`` — a bf16/f32 dtype lattice propagated through traced
+  call chains; a bf16 value silently mixed with an f32 value inside
+  jit-reachable code is the ``_mm`` residual-stream bug (PR 1).
+* ``donation-lifetime`` — ``check_donated_reuse`` extended across call
+  boundaries: donating bindings stored on ``self`` or returned from
+  builder methods, helpers that stash an alias of a buffer the caller
+  later donates, helpers that donate their own parameter, and the same
+  buffer passed at a donated and a non-donated position of one call.
+* ``retrace-hazard`` — jit applied inside a Python loop (a fresh
+  wrapper re-traces every iteration), per-iteration-varying static
+  arguments, unhashable static arguments at call sites, and
+  per-iteration-varying shape constructors fed to a jitted callable.
+
+All rules are pure AST; everything cross-file flows through the pass-1
+tables (imports, class methods, jit reachability, donation bindings).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, rule
+from .graph import (FunctionInfo, JitBinding, ModuleInfo, Program,
+                    binding_for_value, builder_binding,
+                    jit_binding_from_call)
+from .rules import _is_jit_decorator, _jit_call_info, _maximal_refs, dotted
+
+
+# --------------------------------------------------------------------------
+# shared machinery
+# --------------------------------------------------------------------------
+
+
+
+def _assign_targets(node: ast.AST) -> List[ast.AST]:
+    """Target expressions bound by a statement (Assign/AugAssign/For/
+    With/walrus/AnnAssign)."""
+    out: List[ast.AST] = []
+    if isinstance(node, ast.Assign):
+        out = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        out = [node.target]
+    elif isinstance(node, (ast.For, ast.AsyncFor)):
+        out = [node.target]
+    elif isinstance(node, ast.NamedExpr):
+        out = [node.target]
+    elif isinstance(node, (ast.With, ast.AsyncWith)):
+        out = [i.optional_vars for i in node.items if i.optional_vars]
+    flat: List[ast.AST] = []
+    for t in out:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            flat.extend(t.elts)
+        else:
+            flat.append(t)
+    return flat
+
+
+def _target_names(node: ast.AST) -> Set[str]:
+    """Dotted names a statement (re)binds."""
+    names: Set[str] = set()
+    for t in _assign_targets(node):
+        if isinstance(t, ast.Starred):
+            t = t.value
+        d = dotted(t)
+        if d:
+            names.add(d)
+    return names
+
+
+def _branch_tags(parents: Dict[int, ast.AST], node: ast.AST,
+                 stop: ast.AST) -> List[Tuple[int, str, ast.AST]]:
+    """(branch-owner id, arm, owner) for every If/Try arm enclosing
+    ``node`` up to ``stop`` — used to recognize mutually-exclusive code."""
+    tags: List[Tuple[int, str, ast.AST]] = []
+    cur = node
+    while cur is not stop:
+        parent = parents.get(id(cur))
+        if parent is None:
+            break
+        if isinstance(parent, ast.If):
+            arm = "body" if cur in parent.body else \
+                ("orelse" if cur in parent.orelse else "")
+            if arm:
+                tags.append((id(parent), arm, parent))
+        elif isinstance(parent, ast.Try):
+            for arm in ("body", "handlers", "orelse", "finalbody"):
+                if cur in getattr(parent, arm):
+                    tags.append((id(parent), arm, parent))
+                    break
+        cur = parent
+    return tags
+
+
+def _mutually_exclusive(parents, a: ast.AST, b: ast.AST,
+                        stop: ast.AST) -> bool:
+    owners_a = {i: arm for i, arm, _ in _branch_tags(parents, a, stop)}
+    owners_b = {i: arm for i, arm, _ in _branch_tags(parents, b, stop)}
+    for i, arm in owners_a.items():
+        if i in owners_b and owners_b[i] != arm:
+            return True
+    return False
+
+
+def _terminates(stmts: Sequence[ast.stmt]) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+def _early_exit_between(parents, first: ast.AST, second: ast.AST,
+                        stop: ast.AST) -> bool:
+    """True when ``first`` sits in an If/Try arm (not shared with
+    ``second``) that terminates — control never flows on to ``second``."""
+    tags_b = {(i, arm) for i, arm, _ in _branch_tags(parents, second, stop)}
+    for i, arm, owner in _branch_tags(parents, first, stop):
+        if (i, arm) in tags_b:
+            continue
+        body = getattr(owner, arm if arm != "handlers" else "body", None)
+        if arm == "handlers":
+            continue
+        if body is not None and _terminates(body):
+            return True
+    return False
+
+
+def _stmt_of(parents: Dict[int, ast.AST], node: ast.AST,
+             stop: ast.AST) -> ast.AST:
+    """The statement a node belongs to (child of a body list)."""
+    cur = node
+    while cur is not stop:
+        parent = parents.get(id(cur))
+        if parent is None or isinstance(parent, (ast.Module,
+                                                 ast.FunctionDef,
+                                                 ast.AsyncFunctionDef,
+                                                 ast.If, ast.For, ast.While,
+                                                 ast.Try, ast.With)):
+            return cur
+        cur = parent
+    return cur
+
+
+# --------------------------------------------------------------------------
+# rng-discipline
+# --------------------------------------------------------------------------
+
+# jax.random constructors that take a seed, not a key
+_KEY_MAKERS = {"PRNGKey", "key", "wrap_key_data"}
+
+
+def _rng_fn(mod: ModuleInfo, call: ast.Call) -> Optional[str]:
+    """The ``jax.random`` function name when ``call`` targets one (alias
+    aware: ``from jax import random as jr`` works; stdlib/np ``random``
+    does not match)."""
+    d = dotted(call.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    head = mod.imports.get(parts[0], parts[0])
+    full = ".".join([head] + parts[1:])
+    mod_part, _, fn = full.rpartition(".")
+    if mod_part == "jax.random":
+        return fn
+    return None
+
+
+def _key_expr(call: ast.Call) -> Optional[str]:
+    """The dotted key operand of a jax.random call (first positional)."""
+    if not call.args:
+        return None
+    return dotted(call.args[0])
+
+
+def _compute_key_params(program: Program) -> Dict[str, Set[str]]:
+    """param names of each function that are fed to ``jax.random``
+    (directly, or via a further callee — fixpoint over the call graph).
+    Passing a live key to such a param consumes the key."""
+    consumed: Dict[str, Set[str]] = {}
+    for qual, fi in program.functions.items():
+        hit: Set[str] = set()
+        if "random" in fi.module.ctx.source:
+            params, _ = fi.params()
+            pset = set(params)
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Call) \
+                        and _rng_fn(fi.module, node) not in (None,
+                                                             *_KEY_MAKERS):
+                    k = _key_expr(node)
+                    if k in pset:
+                        hit.add(k)
+        consumed[qual] = hit
+    for _ in range(4):                      # bounded fixpoint
+        changed = False
+        for qual, fi in program.functions.items():
+            params, _ = fi.params()
+            pset = set(params)
+            for node, callee in program.call_sites.get(qual, ()):
+                if callee.qual == qual:
+                    continue
+                bound = callee.arg_to_param(node)
+                for pname, arg in bound.items():
+                    if pname in consumed.get(callee.qual, ()) \
+                            and isinstance(arg, ast.Name) \
+                            and arg.id in pset \
+                            and arg.id not in consumed[qual]:
+                        consumed[qual].add(arg.id)
+                        changed = True
+        if not changed:
+            break
+    return consumed
+
+
+def _loop_ancestors(parents, node: ast.AST, stop: ast.AST):
+    """Enclosing loops whose BODY re-evaluates ``node`` each iteration.
+    A ``for`` header's iterable (and a comprehension's outermost
+    ``iter``) runs exactly once — ``for k in split(key, 4)`` is fine."""
+    cur = node
+    via_comp_iter = False
+    while cur is not stop:
+        parent = parents.get(id(cur))
+        if parent is None:
+            break
+        if isinstance(parent, (ast.For, ast.AsyncFor)):
+            if cur is not parent.iter:
+                yield parent
+        elif isinstance(parent, ast.While):
+            yield parent
+        elif isinstance(parent, ast.comprehension):
+            via_comp_iter = cur is parent.iter
+        elif isinstance(parent, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                 ast.GeneratorExp)):
+            first = parent.generators[0] if parent.generators else None
+            if not (via_comp_iter and cur is first):
+                yield parent
+            via_comp_iter = False
+        cur = parent
+
+
+def _loop_variant_names(loop: ast.AST) -> Set[str]:
+    """Names that change per iteration of ``loop``: the loop target plus
+    everything assigned inside the body."""
+    names: Set[str] = set()
+    if isinstance(loop, (ast.For, ast.AsyncFor)):
+        for t in ast.walk(loop.target):
+            d = dotted(t)
+            if d:
+                names.add(d)
+        body = loop.body
+    elif isinstance(loop, (ast.ListComp, ast.SetComp, ast.DictComp,
+                           ast.GeneratorExp)):
+        for gen in loop.generators:
+            for t in ast.walk(gen.target):
+                d = dotted(t)
+                if d:
+                    names.add(d)
+        return names
+    else:
+        body = loop.body
+    for stmt in body:
+        for node in ast.walk(stmt):
+            names |= _target_names(node)
+    return names
+
+
+@rule("rng-discipline",
+      "PRNG key consumed twice without split/fold_in, or a "
+      "loop-invariant key sampled inside a loop (interprocedural: "
+      "helpers that feed a key to jax.random consume it)",
+      scope="program")
+def check_rng_discipline(program: Program) -> Iterator[Finding]:
+    key_params = _compute_key_params(program)
+    # names of helpers that consume a key param — a module mentioning
+    # none of them and never saying "random" cannot produce an event
+    kp_names = {program.functions[q].name
+                for q, s in key_params.items() if s}
+    for mod in program.modules.values():
+        src = mod.ctx.source
+        if "random" not in src \
+                and not any(n in src for n in kp_names):
+            continue
+        for scope, owner, nodes in program.scope_index(mod):
+            yield from _rng_scope(program, mod, scope, owner, nodes,
+                                  key_params)
+
+
+_BINDING_STMTS = (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.For,
+                  ast.AsyncFor, ast.NamedExpr, ast.With, ast.AsyncWith)
+
+
+def _rng_scope(program: Program, mod: ModuleInfo, scope: ast.AST,
+               owner: Optional[FunctionInfo], scope_nodes,
+               key_params) -> Iterator[Finding]:
+    # events: (line, col, kind, var, node, fn_label)
+    events: List[Tuple[int, int, str, str, ast.AST, str]] = []
+    for node in scope_nodes:
+        if isinstance(node, ast.Call):
+            fn = _rng_fn(mod, node)
+            if fn is not None and fn not in _KEY_MAKERS:
+                var = _key_expr(node)
+                if var:
+                    events.append((node.lineno, node.col_offset,
+                                   "consume", var, node, f"jax.random.{fn}"))
+            elif fn is None:
+                callee = program.resolve_call(mod, owner, node)
+                if callee is not None:
+                    kp = key_params.get(callee.qual, ())
+                    for pname, arg in callee.arg_to_param(node).items():
+                        if pname in kp:
+                            var = dotted(arg)
+                            if var:
+                                events.append(
+                                    (node.lineno, node.col_offset,
+                                     "consume", var, node,
+                                     f"{callee.name}()"))
+        if isinstance(node, _BINDING_STMTS):
+            for var in _target_names(node):
+                events.append((getattr(node, "lineno", 0), -1, "rebind",
+                               var, node, ""))
+    if not any(e[2] == "consume" for e in events):
+        return
+    parents = program.parents(mod)
+    events.sort(key=lambda e: (e[0], e[1]))
+
+    # --- double consumption ------------------------------------------------
+    live: Dict[str, Tuple[ast.AST, str, int]] = {}   # var -> last consume
+    for line, col, kind, var, node, label in events:
+        if kind == "rebind":
+            live.pop(var, None)
+            continue
+        prev = live.get(var)
+        if prev is not None:
+            pnode, plabel, pline = prev
+            # only pair events sharing the same loop nesting — within
+            # one iteration linear order is sound; cross-loop reuse is
+            # the loop-invariant check's job
+            loops_cur = tuple(id(l) for l in
+                              _loop_ancestors(parents, node, scope))
+            loops_prev = tuple(id(l) for l in
+                               _loop_ancestors(parents, pnode, scope))
+            if pnode is not node and loops_cur == loops_prev \
+                    and not _mutually_exclusive(parents, pnode, node, scope) \
+                    and not _early_exit_between(parents, pnode, node, scope):
+                yield Finding(
+                    "rng-discipline", mod.path, line, col,
+                    f"PRNG key {var!r} was already consumed by {plabel} "
+                    f"(line {pline}) — reusing it here replays the same "
+                    "randomness; split/fold_in first")
+        # a consume whose enclosing statement rebinds the var
+        # (``key, sub = jax.random.split(key)``) is consume-then-rebind
+        stmt = _stmt_of(parents, node, scope)
+        if var in _target_names(stmt):
+            live.pop(var, None)
+        else:
+            live[var] = (node, label, line)
+
+    # --- loop-invariant key sampled in a loop ------------------------------
+    for line, col, kind, var, node, label in events:
+        if kind != "consume":
+            continue
+        loops = list(_loop_ancestors(parents, node, scope))
+        if not loops:
+            continue
+        inner = loops[0]
+        variant = _loop_variant_names(inner)
+        if var in variant or var.split(".")[0] in variant:
+            continue
+        # fold_in(key, i) with a loop-variant mixin is the FIX, not a bug
+        if isinstance(node, ast.Call):
+            fn = _rng_fn(mod, node)
+            if fn == "fold_in":
+                mixins = node.args[1:] + [k.value for k in node.keywords]
+                if any(isinstance(sub, ast.Name) and sub.id in variant
+                       for m in mixins for sub in ast.walk(m)):
+                    continue
+        yield Finding(
+            "rng-discipline", mod.path, line, col,
+            f"loop-invariant PRNG key {var!r} consumed by {label} inside "
+            "a loop — every iteration draws identical randomness; "
+            "split the key per iteration or fold_in the loop index")
+
+
+# --------------------------------------------------------------------------
+# dtype-flow
+# --------------------------------------------------------------------------
+
+_NARROW = {"bf16", "f16"}
+_WIDE = {"f32", "f64"}
+_DTYPE_CONSTS = {"bfloat16": "bf16", "float16": "f16", "half": "f16",
+                 "float32": "f32", "single": "f32",
+                 "float64": "f64", "double": "f64"}
+_SHAPE_PRESERVING = {"reshape", "transpose", "ravel", "flatten", "squeeze",
+                     "copy", "swapaxes", "clip", "take", "repeat", "tile",
+                     "block_until_ready"}
+_CREATORS = {"zeros", "ones", "full", "empty", "asarray", "array",
+             "arange", "linspace"}
+
+
+def _weak_scalar(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float, complex, bool))
+    if isinstance(node, ast.UnaryOp):
+        return _weak_scalar(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _weak_scalar(node.left) and _weak_scalar(node.right)
+    return False
+
+
+def _dtype_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return _DTYPE_CONSTS.get(node.value)
+    d = dotted(node)
+    if d:
+        return _DTYPE_CONSTS.get(d.split(".")[-1])
+    return None
+
+
+def _rank(dt: str) -> int:
+    return {"bf16": 1, "f16": 1, "f32": 2, "f64": 3}[dt]
+
+
+class _DtypeScope:
+    """One abstract interpretation of a function body under a param
+    dtype binding; emits silent-promotion findings as it walks."""
+
+    def __init__(self, program: Program, fi: FunctionInfo,
+                 bound: Dict[str, str], via: str, sink: List[Finding],
+                 seen: Set[Tuple[str, int, int]], depth: int):
+        self.program = program
+        self.fi = fi
+        self.mod = fi.module
+        self.env: Dict[str, Optional[str]] = dict(bound)
+        self.via = via
+        self.sink = sink
+        self.seen = seen
+        self.depth = depth
+        self.parents = program.parents(fi.module)
+        self.calls_out: List[Tuple[FunctionInfo, Dict[str, str]]] = []
+
+    # -- expression lattice ------------------------------------------------
+
+    def expr(self, node: Optional[ast.AST]) -> Optional[str]:
+        if node is None:
+            return None
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id)
+        if isinstance(node, ast.Attribute):
+            d = dotted(node)
+            if d is not None and d in self.env:
+                return self.env[d]
+            if node.attr == "T":
+                return self.expr(node.value)
+            return None
+        if isinstance(node, ast.Subscript):
+            return self.expr(node.value)
+        if isinstance(node, ast.Call):
+            return self.call_dtype(node)
+        if isinstance(node, ast.BinOp):
+            lt = self.expr(node.left)
+            rt = self.expr(node.right)
+            # python scalars are weak-typed in jax: they never promote
+            if lt is None and _weak_scalar(node.left):
+                return rt
+            if rt is None and _weak_scalar(node.right):
+                return lt
+            return self.mix(node, lt, rt)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, ast.IfExp):
+            a, b = self.expr(node.body), self.expr(node.orelse)
+            return a if a == b else None
+        if isinstance(node, ast.NamedExpr):
+            dt = self.expr(node.value)
+            self.env[node.target.id] = dt
+            return dt
+        return None
+
+    def call_dtype(self, node: ast.Call) -> Optional[str]:
+        func = node.func
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        # evaluate arguments first: nested resolved calls schedule their
+        # interprocedural pass even when this call itself is opaque
+        # (re-visits are deduped by the ``seen`` finding set)
+        for a in node.args:
+            if not isinstance(a, ast.Starred):
+                self.expr(a)
+        for k in node.keywords:
+            self.expr(k.value)
+        if isinstance(func, ast.Attribute):
+            if func.attr == "astype" and node.args:
+                return _dtype_const(node.args[0]) \
+                    or _dtype_const(kw.get("dtype"))
+            if func.attr in _SHAPE_PRESERVING:
+                return self.expr(func.value)
+            if func.attr == "astype":
+                return None
+        d = dotted(func) or ""
+        parts = d.split(".")
+        last = parts[-1]
+        if last in _CREATORS and len(parts) > 1:
+            dt = _dtype_const(kw.get("dtype"))
+            if dt is None and last in ("asarray", "array", "full") \
+                    and len(node.args) >= 2:
+                dt = _dtype_const(node.args[-1])
+            return dt
+        if last in ("zeros_like", "ones_like", "full_like",
+                    "empty_like") and node.args:
+            return _dtype_const(kw.get("dtype")) or self.expr(node.args[0])
+        if last in _DTYPE_CONSTS and len(parts) > 1 and node.args:
+            return _DTYPE_CONSTS[last]       # jnp.bfloat16(x) cast call
+        if last == "where" and len(node.args) == 3:
+            a, b = self.expr(node.args[1]), self.expr(node.args[2])
+            return self.mix(node, a, b)
+        if last in ("matmul", "dot", "multiply", "add", "einsum",
+                    "concatenate", "stack", "maximum", "minimum"):
+            dts = []
+            args = node.args
+            if last == "einsum":
+                args = [a for a in args
+                        if not (isinstance(a, ast.Constant)
+                                and isinstance(a.value, str))]
+            if last in ("concatenate", "stack") and len(args) == 1 \
+                    and isinstance(args[0], (ast.Tuple, ast.List)):
+                args = args[0].elts
+            for a in args:
+                dts.append(self.expr(a))
+            known = [x for x in dts if x]
+            out: Optional[str] = None
+            for x in known:
+                out = self.mix(node, out, x)
+            return out
+        # interprocedural: schedule the callee under this binding
+        callee = self.program.resolve_call(self.mod, self.fi, node)
+        if callee is not None and self.depth < 3:
+            bound: Dict[str, str] = {}
+            for pname, arg in callee.arg_to_param(node).items():
+                dt = self.expr(arg)
+                if dt is not None:
+                    bound[pname] = dt
+            if bound:
+                self.calls_out.append((callee, bound))
+        return None
+
+    def mix(self, node: ast.AST, lt: Optional[str],
+            rt: Optional[str]) -> Optional[str]:
+        if lt is None or rt is None:
+            return None        # unknown taints the result: no guessing
+        if lt == rt:
+            return lt
+        if (lt in _NARROW and rt in _WIDE) or (lt in _WIDE
+                                               and rt in _NARROW):
+            parent = self.parents.get(id(node))
+            cast_away = isinstance(parent, ast.Attribute) \
+                and parent.attr == "astype"
+            key = (self.mod.path, node.lineno, node.col_offset)
+            if not cast_away and key not in self.seen:
+                self.seen.add(key)
+                narrow = lt if lt in _NARROW else rt
+                wide = rt if rt in _WIDE else lt
+                self.sink.append(Finding(
+                    "dtype-flow", self.mod.path, node.lineno,
+                    node.col_offset,
+                    f"{narrow} value mixed with {wide} value inside "
+                    f"traced code silently promotes to {wide}"
+                    f"{self.via} — cast one side explicitly (the _mm "
+                    "residual-stream bug class)"))
+        return lt if _rank(lt) >= _rank(rt) else rt
+
+    # -- statement interpreter ---------------------------------------------
+
+    def run(self) -> None:
+        self.block(self.fi.node.body)
+
+    def block(self, stmts: Sequence[ast.stmt]) -> None:
+        for st in stmts:
+            self.stmt(st)
+
+    def merge(self, *envs: Dict[str, Optional[str]]) -> None:
+        keys = set()
+        for e in envs:
+            keys |= set(e)
+        out: Dict[str, Optional[str]] = {}
+        for k in keys:
+            vals = {e.get(k) for e in envs}
+            out[k] = vals.pop() if len(vals) == 1 else None
+        self.env = out
+
+    def stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, ast.Assign):
+            dt = self.expr(st.value)
+            for t in st.targets:
+                self.bind(t, dt, st.value)
+        elif isinstance(st, ast.AnnAssign):
+            self.bind(st.target, self.expr(st.value), st.value)
+        elif isinstance(st, ast.AugAssign):
+            d = dotted(st.target)
+            cur = self.env.get(d) if d else None
+            dt = self.mix(st, cur, self.expr(st.value)) \
+                if cur and self.expr(st.value) else None
+            if d:
+                self.env[d] = dt
+        elif isinstance(st, ast.Expr):
+            self.expr(st.value)
+        elif isinstance(st, ast.Return):
+            self.expr(st.value)
+        elif isinstance(st, ast.If):
+            self.expr(st.test)
+            saved = dict(self.env)
+            self.block(st.body)
+            then_env = self.env
+            self.env = dict(saved)
+            self.block(st.orelse)
+            self.merge(then_env, self.env)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self.expr(st.iter)
+            for t in ast.walk(st.target):
+                d = dotted(t)
+                if d:
+                    self.env[d] = None
+            saved = dict(self.env)
+            self.block(st.body)
+            self.block(st.orelse)
+            self.merge(saved, self.env)
+        elif isinstance(st, ast.While):
+            self.expr(st.test)
+            saved = dict(self.env)
+            self.block(st.body)
+            self.block(st.orelse)
+            self.merge(saved, self.env)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self.expr(item.context_expr)
+            self.block(st.body)
+        elif isinstance(st, ast.Try):
+            saved = dict(self.env)
+            self.block(st.body)
+            body_env = self.env
+            envs = [body_env]
+            for h in st.handlers:
+                self.env = dict(saved)
+                self.block(h.body)
+                envs.append(self.env)
+            self.env = dict(body_env)
+            self.block(st.orelse)
+            envs.append(self.env)
+            self.merge(*envs)
+            self.block(st.finalbody)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            pass                              # separate scope
+        elif isinstance(st, ast.Delete):
+            for t in st.targets:
+                d = dotted(t)
+                if d:
+                    self.env.pop(d, None)
+
+    def bind(self, target: ast.AST, dt: Optional[str],
+             value: ast.AST) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            elts = value.elts if isinstance(value, (ast.Tuple, ast.List)) \
+                and len(value.elts) == len(target.elts) else None
+            for i, t in enumerate(target.elts):
+                self.bind(t, self.expr(elts[i]) if elts else None,
+                          elts[i] if elts else value)
+            return
+        d = dotted(target)
+        if d:
+            self.env[d] = dt
+
+
+@rule("dtype-flow",
+      "bf16/f32 lattice through traced call chains: a narrow value "
+      "silently mixed with a wide one inside jit-reachable code (the "
+      "_mm residual-stream promotion class)",
+      scope="program")
+def check_dtype_flow(program: Program) -> Iterator[Finding]:
+    sink: List[Finding] = []
+    seen: Set[Tuple[str, int, int]] = set()
+    analyzed: Set[Tuple[str, Tuple[Tuple[str, str], ...]]] = set()
+    queue: List[Tuple[FunctionInfo, Dict[str, str], str, int]] = []
+    for qual in sorted(program.jit_reachable):
+        fi = program.function(qual)
+        if fi is not None:
+            queue.append((fi, {}, "", 0))
+    while queue:
+        fi, bound, via, depth = queue.pop(0)
+        key = (fi.qual, tuple(sorted(bound.items())))
+        if key in analyzed:
+            continue
+        analyzed.add(key)
+        scope = _DtypeScope(program, fi, bound, via, sink, seen, depth)
+        scope.run()
+        for callee, cb in scope.calls_out:
+            if callee.qual in program.jit_reachable or fi.qual \
+                    in program.jit_reachable:
+                desc = ", ".join(f"{p}={d}" for p, d in sorted(cb.items()))
+                queue.append((callee, cb,
+                              f" (called from {fi.name}() with {desc})",
+                              depth + 1))
+    yield from sink
+
+
+# --------------------------------------------------------------------------
+# donation-lifetime
+# --------------------------------------------------------------------------
+
+_STASH_CONTAINER_CALLS = {"append", "add", "insert", "setdefault",
+                          "appendleft", "push"}
+
+
+def _stash_params(fi: FunctionInfo) -> Set[str]:
+    """Params of ``fi`` that escape the call: stored on an attribute /
+    subscript / global, or put into a container — an alias that
+    outlives the frame."""
+    params, _ = fi.params()
+    pset = set(params)
+    out: Set[str] = set()
+    globals_decl: Set[str] = set()
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.Global):
+            globals_decl |= set(node.names)
+        elif isinstance(node, ast.Assign):
+            vals = [node.value]
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                vals = list(node.value.elts)
+            stored = {v.id for v in vals
+                      if isinstance(v, ast.Name) and v.id in pset}
+            if not stored:
+                continue
+            for t in node.targets:
+                flat = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                    else [t]
+                for tt in flat:
+                    if isinstance(tt, (ast.Attribute, ast.Subscript)):
+                        out |= stored
+                    elif isinstance(tt, ast.Name) \
+                            and tt.id in globals_decl:
+                        out |= stored
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _STASH_CONTAINER_CALLS:
+            for a in node.args:
+                if isinstance(a, ast.Name) and a.id in pset:
+                    out.add(a.id)
+    return out
+
+
+def _compute_donating_params(program: Program) -> Dict[str, Dict[str, int]]:
+    """For each function: params it passes onward at a donated position
+    of a donating binding (the caller's buffer dies inside the callee).
+    Maps param name -> line of the donating call, fixpoint over calls."""
+    out: Dict[str, Dict[str, int]] = {q: {} for q in program.functions}
+    for mod in program.modules.values():
+        if "donate" not in mod.ctx.source:
+            continue
+        for scope, owner, nodes in program.scope_index(mod):
+            if owner is None or scope is not owner.node:
+                continue
+            params, _ = owner.params()
+            pset = set(params)
+            for call, binding, _origin in _donating_sites(
+                    program, mod, nodes, owner):
+                for i in binding.donate_argnums:
+                    if i < len(call.args):
+                        a = call.args[i]
+                        if isinstance(a, ast.Name) and a.id in pset:
+                            out[owner.qual][a.id] = call.lineno
+    for _ in range(3):
+        changed = False
+        for qual, fi in program.functions.items():
+            params, _ = fi.params()
+            pset = set(params)
+            for node, callee in program.call_sites.get(qual, ()):
+                if callee.qual == qual:
+                    continue
+                dp = out.get(callee.qual, {})
+                for pname, arg in callee.arg_to_param(node).items():
+                    if pname in dp and isinstance(arg, ast.Name) \
+                            and arg.id in pset \
+                            and arg.id not in out[qual]:
+                        out[qual][arg.id] = node.lineno
+                        changed = True
+        if not changed:
+            break
+    return out
+
+
+def _scope_bindings(program: Program, mod: ModuleInfo,
+                    scope_nodes, owner: Optional[FunctionInfo]
+                    ) -> Dict[str, Tuple[JitBinding, str]]:
+    """name -> (binding, origin) for donating callables bound to local
+    names in this scope.  origin: 'local' (direct jit assignment — the
+    per-file donated-reuse rule owns that case) or 'builder'."""
+    out: Dict[str, Tuple[JitBinding, str]] = {}
+    for node in scope_nodes:
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if not names:
+            continue
+        direct = jit_binding_from_call(node.value, None)
+        if direct is not None:
+            if direct.donate_argnums:
+                for n in names:
+                    out[n] = (direct, "local")
+            continue
+        callee = program.resolve_call(mod, owner, node.value)
+        if callee is not None:
+            b = builder_binding(program, callee.module, callee)
+            if b is not None and b.donate_argnums:
+                for n in names:
+                    out[n] = (b, "builder")
+    return out
+
+
+def _donating_sites(program: Program, mod: ModuleInfo, scope_nodes,
+                    owner: Optional[FunctionInfo],
+                    mod_bindings=None):
+    """(call, binding, origin) for every donating call in the scope.
+    origin in {'local', 'builder', 'attr', 'immediate', 'module'} —
+    'module' is a module-level binding called from inside a function
+    (invisible to the per-scope donated-reuse rule)."""
+    local = _scope_bindings(program, mod, scope_nodes, owner)
+    cls = mod.classes.get(owner.class_name) \
+        if owner is not None and owner.class_name else None
+    for node in scope_nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Name) and func.id in local:
+            binding, origin = local[func.id]
+            yield node, binding, origin
+        elif isinstance(func, ast.Name) and mod_bindings \
+                and func.id in mod_bindings:
+            binding, _origin = mod_bindings[func.id]
+            yield node, binding, "module"
+        elif isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id == "self":
+            b = program.attr_binding(cls, func.attr)
+            if b is not None and b.donate_argnums:
+                yield node, b, "attr"
+        elif isinstance(func, ast.Call):
+            b = jit_binding_from_call(func, None)
+            if b is not None and b.donate_argnums:
+                yield node, b, "immediate"
+
+
+@rule("donation-lifetime",
+      "donated buffers tracked across call boundaries: reuse after a "
+      "self-bound/builder-produced donating call, helpers that stash "
+      "an alias of a later-donated buffer, helpers that donate their "
+      "own parameter, and one buffer at donated + non-donated "
+      "positions of a single call",
+      scope="program")
+def check_donation_lifetime(program: Program) -> Iterator[Finding]:
+    donating_params = _compute_donating_params(program)
+    # names of helpers that donate a param — a module mentioning none
+    # of them and never saying "donate"/"jit" cannot produce a site
+    dp_names = {program.functions[q].name
+                for q, s in donating_params.items() if s}
+    stash_cache: Dict[str, Set[str]] = {}
+    for mod in program.modules.values():
+        src = mod.ctx.source
+        if "donate" not in src and "jit" not in src \
+                and not any(n in src for n in dp_names):
+            continue
+        index = program.scope_index(mod)
+        mod_bindings = _scope_bindings(program, mod, index[0][2], None)
+        for scope, owner, nodes in index:
+            mb = mod_bindings if scope is not mod.ctx.tree else None
+            yield from _donation_scope(program, mod, scope, owner, nodes,
+                                       donating_params, stash_cache, mb)
+
+
+def _donation_scope(program: Program, mod: ModuleInfo, scope: ast.AST,
+                    owner: Optional[FunctionInfo], scope_nodes,
+                    donating_params,
+                    stash_cache: Dict[str, Set[str]],
+                    mod_bindings=None) -> Iterator[Finding]:
+    sites: List[Tuple[ast.Call, Tuple[int, ...], str, str]] = []
+    for call, binding, origin in _donating_sites(program, mod, scope_nodes,
+                                                 owner, mod_bindings):
+        sites.append((call, binding.donate_argnums, origin, ""))
+    # helpers that donate their own parameter: the caller's arg dies too
+    for node in scope_nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        callee = program.resolve_call(mod, owner, node)
+        if callee is None or (owner is not None
+                              and callee.qual == owner.qual):
+            continue
+        dp = donating_params.get(callee.qual, {})
+        if not dp:
+            continue
+        params, _ = callee.params()
+        nums = tuple(i for i, p in enumerate(params)
+                     if p in dp and i < len(node.args))
+        if nums:
+            sites.append((node, nums, "interproc",
+                          f" (which donates it at "
+                          f"{callee.name}:{min(dp.values())})"))
+    if not sites:
+        return
+
+    refs = _maximal_refs(scope)
+    for call, nums, origin, note in sites:
+        for i in nums:
+            if i >= len(call.args):
+                continue
+            expr = dotted(call.args[i])
+            if expr is None:
+                continue
+            # (c) same buffer also passed at a non-donated position
+            for j, other in enumerate(call.args):
+                if j != i and dotted(other) == expr:
+                    yield Finding(
+                        "donation-lifetime", mod.path, call.lineno,
+                        call.col_offset,
+                        f"{expr!r} passed at donated position {i} AND "
+                        f"position {j} of the same call — the alias is "
+                        "read from a donated buffer")
+                    break
+            # (b) a helper stored an alias before the donation
+            for node in scope_nodes:
+                if not isinstance(node, ast.Call) or node is call \
+                        or node.lineno > call.lineno:
+                    continue
+                callee = program.resolve_call(mod, owner, node)
+                if callee is None:
+                    continue
+                if callee.qual not in stash_cache:
+                    stash_cache[callee.qual] = _stash_params(callee)
+                stash = stash_cache[callee.qual]
+                if not stash:
+                    continue
+                for pname, arg in callee.arg_to_param(node).items():
+                    if pname in stash and dotted(arg) == expr:
+                        yield Finding(
+                            "donation-lifetime", mod.path, call.lineno,
+                            call.col_offset,
+                            f"{expr!r} is donated here, but "
+                            f"{callee.name}() (line {node.lineno}) "
+                            f"stored an alias of it (param {pname!r}) "
+                            "— the stored reference reads a dead "
+                            "buffer after donation")
+            # (a) use-after for bindings the per-file rule cannot see
+            if origin == "local":
+                continue
+            stores = [ln for d, ln, st in refs
+                      if st and d == expr and ln >= call.lineno]
+            loads = [ln for d, ln, st in refs
+                     if not st and ln > call.lineno
+                     and (d == expr or d.startswith(expr + "."))]
+            for ln in sorted(loads):
+                if any(s <= ln for s in stores):
+                    break
+                label = {"attr": "a self-bound donating step",
+                         "builder": "a builder-produced donating step",
+                         "immediate": "an inline donating jit call",
+                         "module": "a module-level donating step",
+                         "interproc": "a helper"}[origin]
+                yield Finding(
+                    "donation-lifetime", mod.path, ln, 0,
+                    f"{expr!r} was donated at line {call.lineno} to "
+                    f"{label}{note} and is used again here — the "
+                    "buffer is invalid after donation")
+                break
+
+
+# --------------------------------------------------------------------------
+# retrace-hazard
+# --------------------------------------------------------------------------
+
+_SHAPE_CTORS = {"zeros", "ones", "full", "empty", "arange", "linspace",
+                "tile", "repeat", "broadcast_to", "eye"}
+_UNHASHABLE_NODES = (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                     ast.ListComp, ast.SetComp)
+
+
+def _decorated_binding(fi: FunctionInfo) -> Optional[JitBinding]:
+    """The JitBinding a ``@jax.jit`` / ``@partial(jax.jit, ...)``
+    decorator puts on ``fi`` — calling ``fi`` by name calls the wrapper."""
+    for dec in fi.node.decorator_list:
+        if _is_jit_decorator(dec) is None:
+            continue
+        if isinstance(dec, ast.Call):
+            b = jit_binding_from_call(dec, fi)
+            if b is not None:
+                return b
+        return JitBinding((), (), (), fi, fi.node.lineno)
+    return None
+
+
+def _jitted_call_sites(program: Program, mod: ModuleInfo,
+                       scope_nodes, owner: Optional[FunctionInfo],
+                       mod_bindings=None):
+    """(call, binding) for calls in the scope that invoke a KNOWN jitted
+    callable: local/builder/module-level bindings, self-attr bindings,
+    or directly called jit-decorated functions (imported or local)."""
+    local = _scope_bindings_all(program, mod, scope_nodes, owner)
+    cls = mod.classes.get(owner.class_name) \
+        if owner is not None and owner.class_name else None
+    for node in scope_nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id in local:
+                yield node, local[func.id]
+                continue
+            if mod_bindings and func.id in mod_bindings:
+                yield node, mod_bindings[func.id]
+                continue
+            target = program.resolve_symbol(mod, func.id)
+            if target is not None:
+                b = _decorated_binding(target)
+                if b is not None:
+                    yield node, b
+        elif isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id == "self" and cls is not None:
+            b = program.attr_binding(cls, func.attr)
+            if b is not None:
+                yield node, b
+
+
+def _scope_bindings_all(program: Program, mod: ModuleInfo,
+                        scope_nodes, owner: Optional[FunctionInfo]
+                        ) -> Dict[str, JitBinding]:
+    out: Dict[str, JitBinding] = {}
+    for node in scope_nodes:
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if not names:
+            continue
+        b = binding_for_value(program, mod, owner, node.value)
+        if b is not None:
+            for n in names:
+                out[n] = b
+    return out
+
+
+@rule("retrace-hazard",
+      "jit applied inside a Python loop, per-iteration-varying or "
+      "unhashable static arguments, and varying-shape constructors "
+      "fed to a jitted callable in a loop — each recompiles every "
+      "iteration",
+      scope="program")
+def check_retrace_hazard(program: Program) -> Iterator[Finding]:
+    for mod in program.modules.values():
+        parents = None
+        # (a) jit application inside a loop ("jit" must appear literally
+        # in the source for an application to exist here)
+        for node in (ast.walk(mod.ctx.tree)
+                     if "jit" in mod.ctx.source else ()):
+            if not isinstance(node, ast.Call):
+                continue
+            info = _jit_call_info(node)
+            if info is None or info[0] is None:
+                continue
+            if parents is None:
+                parents = program.parents(mod)
+            loops = [p for p in _ancestors(parents, node)
+                     if isinstance(p, (ast.For, ast.While, ast.AsyncFor))]
+            if not loops:
+                continue
+            if _is_cache_fill(parents, node):
+                continue
+            yield Finding(
+                "retrace-hazard", mod.path, node.lineno, node.col_offset,
+                "jax.jit applied inside a Python loop — each iteration "
+                "builds a fresh wrapper with an empty trace cache, so "
+                "every call re-traces; hoist the jitted callable out of "
+                "the loop (or store it in a keyed cache)")
+
+        # (b)/(c)/(d): call sites of known jitted callables
+        index = program.scope_index(mod)
+        mod_bindings = _scope_bindings_all(program, mod, index[0][2], None)
+        for scope, owner, nodes in index:
+            mb = mod_bindings if scope is not mod.ctx.tree else None
+            yield from _retrace_call_sites(program, mod, nodes, owner, mb)
+
+
+def _ancestors(parents, node: ast.AST):
+    cur = parents.get(id(node))
+    while cur is not None:
+        yield cur
+        cur = parents.get(id(cur))
+
+
+def _is_cache_fill(parents, node: ast.AST) -> bool:
+    """jit result stored under a key (``cache[k] = jax.jit(...)`` or
+    ``cache.setdefault(k, jax.jit(...))``): compiled once per key, which
+    is deliberate executable caching, not a per-iteration leak."""
+    parent = parents.get(id(node))
+    if isinstance(parent, ast.Assign):
+        return all(isinstance(t, (ast.Subscript, ast.Attribute))
+                   for t in parent.targets)
+    if isinstance(parent, ast.Call) \
+            and isinstance(parent.func, ast.Attribute) \
+            and parent.func.attr == "setdefault":
+        return True
+    return False
+
+
+def _retrace_call_sites(program: Program, mod: ModuleInfo, scope_nodes,
+                        owner: Optional[FunctionInfo],
+                        mod_bindings=None) -> Iterator[Finding]:
+    parents = None
+    for call, binding in _jitted_call_sites(program, mod, scope_nodes,
+                                            owner, mod_bindings):
+        if parents is None:
+            parents = program.parents(mod)
+        static_pos = set(binding.static_argnums)
+        static_kw = set(binding.static_argnames)
+        if binding.fn is not None:
+            params, _ = binding.fn.params()
+            static_pos |= {i for i, p in enumerate(params)
+                           if p in static_kw}
+        # (c) unhashable literal at a static position (loop or not)
+        for i in sorted(static_pos):
+            if i < len(call.args) \
+                    and isinstance(call.args[i], _UNHASHABLE_NODES):
+                yield Finding(
+                    "retrace-hazard", mod.path, call.lineno,
+                    call.col_offset,
+                    f"unhashable dict/list/set passed at static "
+                    f"position {i} — jit static args must hash; this "
+                    "raises (or recompiles) on every call")
+        for kwn in call.keywords:
+            if kwn.arg in static_kw \
+                    and isinstance(kwn.value, _UNHASHABLE_NODES):
+                yield Finding(
+                    "retrace-hazard", mod.path, call.lineno,
+                    call.col_offset,
+                    f"unhashable dict/list/set passed for static "
+                    f"argument {kwn.arg!r} — jit static args must hash")
+
+        loops = [p for p in _ancestors(parents, call)
+                 if isinstance(p, (ast.For, ast.While, ast.AsyncFor))]
+        if not loops:
+            continue
+        variant: Set[str] = set()
+        for l in loops:
+            variant |= _loop_variant_names(l)
+
+        def _variant_names_in(expr: ast.AST) -> Set[str]:
+            return {sub.id for sub in ast.walk(expr)
+                    if isinstance(sub, ast.Name) and sub.id in variant}
+
+        # (b) loop-varying value at a static position
+        checked: List[Tuple[str, ast.AST]] = []
+        for i in sorted(static_pos):
+            if i < len(call.args):
+                checked.append((f"static position {i}", call.args[i]))
+        for kwn in call.keywords:
+            if kwn.arg in static_kw:
+                checked.append((f"static argument {kwn.arg!r}", kwn.value))
+        for label, expr in checked:
+            hits = _variant_names_in(expr)
+            if hits:
+                yield Finding(
+                    "retrace-hazard", mod.path, call.lineno,
+                    call.col_offset,
+                    f"value at {label} varies per loop iteration "
+                    f"({', '.join(sorted(hits))}) — every new value "
+                    "recompiles the jitted function")
+        # (d) loop-varying shape constructor at ANY position
+        for expr in list(call.args) + [k.value for k in call.keywords]:
+            for sub in ast.walk(expr):
+                if not isinstance(sub, ast.Call):
+                    continue
+                d = dotted(sub.func) or ""
+                parts = d.split(".")
+                if parts[-1] not in _SHAPE_CTORS or len(parts) < 2:
+                    continue
+                hits = _variant_names_in(sub)
+                if hits:
+                    yield Finding(
+                        "retrace-hazard", mod.path, call.lineno,
+                        call.col_offset,
+                        f"argument built by {d}() with a "
+                        "per-iteration-varying size "
+                        f"({', '.join(sorted(hits))}) — every new "
+                        "shape re-traces the jitted function")
+                    break

@@ -1,0 +1,643 @@
+"""tpulint pass 1: whole-program module/symbol table and call graph.
+
+Walks every linted file once and produces a :class:`Program`:
+
+* **module table** — dotted module names derived from the package layout
+  on disk (``deepspeed_tpu/inference/engine.py`` →
+  ``deepspeed_tpu.inference.engine``), with per-module import maps
+  (``from ..ops import quant as q`` resolved to absolute targets);
+* **symbol table** — top-level functions and classes, methods bound by
+  class (single-inheritance base lookup across modules);
+* **call graph** — edges from every function/method to the defs its
+  calls resolve to: lexically-scoped locals, module top-levels,
+  imported symbols, ``self.meth(...)`` within the class hierarchy, and
+  ``var.meth(...)`` when ``var`` was constructed from a known class in
+  the same scope;
+* **jit reachability** — functions marked reachable-from-trace: jit /
+  pjit / shard_map decorated, passed to a jit/pjit/shard_map
+  application, or transitively called from one of those;
+* **donation table** — every ``donate_argnums`` binding, whether bound
+  to a local name, a ``self.attr``, or returned from a builder helper.
+
+Pass 2 (:mod:`dataflow`) runs its rules against this context.  Like the
+rest of tpulint the pass is pure ``ast`` — nothing is imported.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import FileContext
+from .rules import (_const_str_elems, _int_elems, _is_jit_decorator,
+                    _jit_call_info, dotted)
+
+# applications whose first function argument runs traced on device
+_TRACE_ENTRY_NAMES = {
+    "jit", "jax.jit", "pjit", "jax.pjit", "shard_map",
+    "jax.experimental.shard_map.shard_map", "jax.shard_map",
+}
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One def, bound to its module (and class, for methods)."""
+    qual: str                       # "pkg.mod::fn" / "pkg.mod::Cls.meth"
+    name: str
+    module: "ModuleInfo"
+    node: ast.FunctionDef
+    class_name: Optional[str] = None
+    _nested: Optional[Dict[str, ast.FunctionDef]] = \
+        dataclasses.field(default=None, repr=False, compare=False)
+    _constructed: Optional[Dict[str, str]] = \
+        dataclasses.field(default=None, repr=False, compare=False)
+
+    @property
+    def is_method(self) -> bool:
+        return self.class_name is not None
+
+    def nested_def(self, name: str) -> Optional[ast.FunctionDef]:
+        """A def nested (at any depth) inside this one, cached."""
+        if self._nested is None:
+            self._nested = {}
+            for node in ast.walk(self.node):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and node is not self.node:
+                    self._nested.setdefault(node.name, node)
+        return self._nested.get(name)
+
+    def constructed_class(self, var: str) -> Optional[str]:
+        """Class name when ``var = ClassName(...)`` appears in the body
+        (CamelCase heuristic), cached."""
+        if self._constructed is None:
+            self._constructed = {}
+            for node in ast.walk(self.node):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and isinstance(node.value, ast.Call):
+                    d = dotted(node.value.func)
+                    if d and d.split(".")[-1][:1].isupper():
+                        self._constructed.setdefault(
+                            node.targets[0].id, d.split(".")[-1])
+        return self._constructed.get(var)
+
+    _params_cache: Optional[tuple] = \
+        dataclasses.field(default=None, repr=False, compare=False)
+
+    def params(self) -> Tuple[List[str], Dict[str, ast.AST]]:
+        """(ordered positional+kwonly parameter names, defaults by name),
+        with ``self``/``cls`` dropped for methods."""
+        if self._params_cache is not None:
+            return self._params_cache
+        a = self.node.args
+        names = [p.arg for p in a.posonlyargs + a.args]
+        defaults: Dict[str, ast.AST] = {}
+        with_default = names[len(names) - len(a.defaults):] \
+            if a.defaults else []
+        for n, d in zip(with_default, a.defaults):
+            defaults[n] = d
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            names.append(p.arg)
+            if d is not None:
+                defaults[p.arg] = d
+        if self.is_method and names and names[0] in ("self", "cls") \
+                and not any(isinstance(d, ast.Name) and d.id == "staticmethod"
+                            for d in self.node.decorator_list):
+            names = names[1:]
+        self._params_cache = (names, defaults)
+        return self._params_cache
+
+    def arg_to_param(self, call: ast.Call) -> Dict[str, ast.AST]:
+        """Best-effort binding of a call site's argument expressions to
+        this function's parameter names (``self`` already dropped)."""
+        names, _ = self.params()
+        bound: Dict[str, ast.AST] = {}
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Starred):
+                break
+            if i < len(names):
+                bound[names[i]] = a
+        for kw in call.keywords:
+            if kw.arg and kw.arg in names:
+                bound[kw.arg] = kw.value
+        return bound
+
+
+@dataclasses.dataclass(frozen=True)
+class JitBinding:
+    """One jit/pjit application with its trace-relevant kwargs."""
+    donate_argnums: Tuple[int, ...]
+    static_argnums: Tuple[int, ...]
+    static_argnames: Tuple[str, ...]
+    fn: Optional["FunctionInfo"]    # the wrapped def when resolvable
+    lineno: int
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    module: "ModuleInfo"
+    node: ast.ClassDef
+    bases: List[str]                          # dotted, as written
+    methods: Dict[str, FunctionInfo] = dataclasses.field(
+        default_factory=dict)
+    # self.<attr> = jax.jit(...) bindings collected across ALL methods
+    attr_bindings: Dict[str, JitBinding] = dataclasses.field(
+        default_factory=dict)
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    name: str                                 # dotted, "" for loose files
+    path: str
+    ctx: FileContext
+    imports: Dict[str, str] = dataclasses.field(default_factory=dict)
+    functions: Dict[str, FunctionInfo] = dataclasses.field(
+        default_factory=dict)               # top-level only
+    classes: Dict[str, ClassInfo] = dataclasses.field(default_factory=dict)
+
+    @property
+    def package(self) -> str:
+        """The package this module's relative imports resolve against."""
+        if self.path.endswith("__init__.py"):
+            return self.name
+        return self.name.rpartition(".")[0]
+
+
+def module_name_for(path: Path) -> str:
+    """Dotted module name from the package layout on disk: walk up while
+    ``__init__.py`` marks the parent as a package."""
+    path = path.resolve()
+    parts = [path.stem] if path.stem != "__init__" else []
+    d = path.parent
+    while (d / "__init__.py").exists():
+        parts.insert(0, d.name)
+        d = d.parent
+    return ".".join(parts)
+
+
+def _resolve_import_from(pkg: str, node: ast.ImportFrom) -> str:
+    """Absolute dotted module for a (possibly relative) ``from`` import."""
+    if not node.level:
+        return node.module or ""
+    base = pkg.split(".") if pkg else []
+    if node.level > 1:
+        base = base[: len(base) - (node.level - 1)]
+    if node.module:
+        base = base + node.module.split(".")
+    return ".".join(base)
+
+
+class Program:
+    """The whole-program context handed to pass-2 (dataflow) rules."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.by_path: Dict[str, ModuleInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.calls: Dict[str, Set[str]] = {}          # qual -> callee quals
+        # every resolved call site per function, for fixpoint passes
+        self.call_sites: Dict[str, List[Tuple[ast.Call, FunctionInfo]]] = {}
+        self.jit_roots: Set[str] = set()
+        self.jit_reachable: Set[str] = set()
+        # FunctionInfo for the innermost def enclosing any AST node,
+        # keyed by (module path, id(node))
+        self._owner: Dict[Tuple[str, int], Optional[FunctionInfo]] = {}
+        # memoized resolve_call results (the AST is immutable here)
+        self._resolve_cache: Dict[Tuple[str, int, Optional[str]],
+                                  Optional["FunctionInfo"]] = {}
+        self._parents: Dict[str, Dict[int, ast.AST]] = {}
+        self._scope_index: Dict[str, list] = {}
+
+    def parents(self, mod: ModuleInfo) -> Dict[int, ast.AST]:
+        """node-id -> parent map for a module, built once and shared by
+        every pass-2 rule."""
+        out = self._parents.get(mod.path)
+        if out is None:
+            out = {}
+            for node in ast.walk(mod.ctx.tree):
+                for child in ast.iter_child_nodes(node):
+                    out[id(child)] = node
+            self._parents[mod.path] = out
+        return out
+
+    def scope_index(self, mod: ModuleInfo):
+        """[(scope, owner, nodes)] for the module body and every def —
+        ``nodes`` is the scope's own subtree EXCLUDING nested defs,
+        lambdas, and class-level statements (each def is its own scope;
+        ``owner`` is the program-level FunctionInfo it belongs to).
+        Built once per module and shared by every pass-2 rule."""
+        out = self._scope_index.get(mod.path)
+        if out is not None:
+            return out
+        out = []
+
+        def rec(scope: ast.AST, owner, nodes: list, in_class: bool):
+            stack = [(scope, in_class)]
+            while stack:
+                node, hidden = stack.pop()
+                if not hidden:
+                    nodes.append(node)
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        sub_nodes: list = []
+                        sub_owner = self.owner_of(mod, child)
+                        out.append((child, sub_owner, sub_nodes))
+                        rec(child, sub_owner, sub_nodes, False)
+                    elif isinstance(child, ast.Lambda):
+                        continue
+                    elif isinstance(child, ast.ClassDef):
+                        stack.append((child, True))
+                    else:
+                        stack.append((child, hidden))
+
+        top_nodes: list = []
+        out.append((mod.ctx.tree, None, top_nodes))
+        rec(mod.ctx.tree, None, top_nodes, False)
+        self._scope_index[mod.path] = out
+        return out
+
+    # -- lookups -----------------------------------------------------------
+
+    def ctx_for(self, path: str) -> Optional[FileContext]:
+        m = self.by_path.get(path)
+        return m.ctx if m else None
+
+    def function(self, qual: str) -> Optional[FunctionInfo]:
+        return self.functions.get(qual)
+
+    def owner_of(self, module: ModuleInfo,
+                 node: ast.AST) -> Optional[FunctionInfo]:
+        return self._owner.get((module.path, id(node)))
+
+    def is_traced(self, fi: FunctionInfo) -> bool:
+        return fi.qual in self.jit_reachable
+
+    def resolve_symbol(self, module: ModuleInfo,
+                       name: str) -> Optional[FunctionInfo]:
+        """A bare name in ``module`` scope -> top-level def here or in the
+        module it was imported from (one alias hop)."""
+        if name in module.functions:
+            return module.functions[name]
+        target = module.imports.get(name)
+        if not target:
+            return None
+        mod_name, _, sym = target.rpartition(".")
+        m = self.modules.get(mod_name)
+        if m and sym in m.functions:
+            return m.functions[sym]
+        return None
+
+    def resolve_class(self, module: ModuleInfo,
+                      name: str) -> Optional[ClassInfo]:
+        if name in module.classes:
+            return module.classes[name]
+        target = module.imports.get(name)
+        if not target:
+            return None
+        mod_name, _, sym = target.rpartition(".")
+        m = self.modules.get(mod_name)
+        if m and sym in m.classes:
+            return m.classes[sym]
+        return None
+
+    def method_on(self, cls: ClassInfo, name: str,
+                  _depth: int = 0) -> Optional[FunctionInfo]:
+        """``name`` on ``cls`` or (single-inheritance, best-effort) its
+        resolvable bases."""
+        if name in cls.methods:
+            return cls.methods[name]
+        if _depth >= 4:
+            return None
+        for base in cls.bases:
+            b = self.resolve_class(cls.module, base.split(".")[-1]) \
+                if "." in base else self.resolve_class(cls.module, base)
+            if b is not None and b is not cls:
+                found = self.method_on(b, name, _depth + 1)
+                if found is not None:
+                    return found
+        return None
+
+    def attr_binding(self, cls: Optional[ClassInfo],
+                     attr: str) -> Optional[JitBinding]:
+        """The jit binding stored on ``self.<attr>`` anywhere in ``cls``
+        (or its bases)."""
+        seen = 0
+        while cls is not None and seen < 5:
+            if attr in cls.attr_bindings:
+                return cls.attr_bindings[attr]
+            nxt = None
+            for base in cls.bases:
+                nxt = self.resolve_class(cls.module, base.split(".")[-1])
+                if nxt:
+                    break
+            cls, seen = nxt, seen + 1
+        return None
+
+    def resolve_call(self, module: ModuleInfo, caller: Optional[FunctionInfo],
+                     call: ast.Call) -> Optional[FunctionInfo]:
+        """The FunctionInfo a call expression dispatches to, or None."""
+        key = (module.path, id(call), caller.qual if caller else None)
+        if key in self._resolve_cache:
+            return self._resolve_cache[key]
+        out = self._resolve_call_uncached(module, caller, call)
+        self._resolve_cache[key] = out
+        return out
+
+    def _resolve_call_uncached(self, module: ModuleInfo,
+                               caller: Optional[FunctionInfo],
+                               call: ast.Call) -> Optional[FunctionInfo]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            # local defs nested inside the caller shadow module scope
+            if caller is not None and caller.nested_def(func.id) is not None:
+                return None         # nested defs aren't program symbols
+            return self.resolve_symbol(module, func.id)
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name) and base.id in ("self", "cls") \
+                    and caller is not None and caller.class_name:
+                cls = module.classes.get(caller.class_name)
+                if cls:
+                    return self.method_on(cls, func.attr)
+                return None
+            d = dotted(base)
+            if d is not None:
+                # module alias: np.foo, M.moe_ffn, jax.random.split ...
+                target = module.imports.get(d.split(".")[0])
+                if target:
+                    tail = d.split(".")[1:]
+                    mod_name = ".".join([target] + tail)
+                    m = self.modules.get(mod_name)
+                    if m and func.attr in m.functions:
+                        return m.functions[func.attr]
+                # instance of a known class constructed in this scope
+                if caller is not None and isinstance(base, ast.Name):
+                    cls_name = caller.constructed_class(base.id)
+                    if cls_name:
+                        cls = self.resolve_class(module, cls_name)
+                        if cls:
+                            return self.method_on(cls, func.attr)
+        return None
+
+
+def _nested_def(scope: ast.AST, name: str) -> Optional[ast.FunctionDef]:
+    for node in ast.walk(scope):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not scope and node.name == name:
+            return node
+    return None
+
+
+def jit_binding_from_call(call: ast.Call,
+                          fn: Optional[FunctionInfo]) -> Optional[JitBinding]:
+    """A JitBinding when ``call`` is a jit/pjit application."""
+    if _jit_call_info(call) is None:
+        return None
+    kw = {k.arg: k.value for k in call.keywords if k.arg}
+    donate = tuple(_int_elems(kw.get("donate_argnums",
+                                     ast.Constant(value=None))))
+    snums = tuple(_int_elems(kw.get("static_argnums",
+                                    ast.Constant(value=None))))
+    snames = tuple(s for s, _ in
+                   _const_str_elems(kw.get("static_argnames",
+                                           ast.Constant(value=None))))
+    return JitBinding(donate, snums, snames, fn, call.lineno)
+
+
+def _collect_module(ctx: FileContext) -> ModuleInfo:
+    path = Path(ctx.path)
+    mod = ModuleInfo(name=module_name_for(path), path=ctx.path, ctx=ctx)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                mod.imports[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            src = _resolve_import_from(mod.package, node)
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                mod.imports[a.asname or a.name] = \
+                    f"{src}.{a.name}" if src else a.name
+    prefix = mod.name or path.stem
+    for node in ctx.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fi = FunctionInfo(f"{prefix}::{node.name}", node.name, mod, node)
+            mod.functions[node.name] = fi
+        elif isinstance(node, ast.ClassDef):
+            ci = ClassInfo(node.name, mod, node,
+                           [d for d in (dotted(b) for b in node.bases) if d])
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fi = FunctionInfo(f"{prefix}::{node.name}.{sub.name}",
+                                      sub.name, mod, sub, node.name)
+                    ci.methods[sub.name] = fi
+            mod.classes[node.name] = ci
+    return mod
+
+
+def _index_owners(program: Program, mod: ModuleInfo) -> None:
+    """Map every AST node to the innermost program-level def owning it
+    (top-level functions and methods; nested defs belong to their
+    enclosing program-level def)."""
+    top: Dict[int, FunctionInfo] = {}
+    for fi in list(mod.functions.values()):
+        top[id(fi.node)] = fi
+    for ci in mod.classes.values():
+        for fi in ci.methods.values():
+            top[id(fi.node)] = fi
+
+    def walk(node: ast.AST, owner: Optional[FunctionInfo]) -> None:
+        nxt = top.get(id(node), owner)
+        program._owner[(mod.path, id(node))] = nxt
+        for child in ast.iter_child_nodes(node):
+            walk(child, nxt)
+
+    walk(mod.ctx.tree, None)
+
+
+def _collect_attr_bindings(program: Program, mod: ModuleInfo) -> None:
+    """``self.X = jax.jit(...)`` (directly, or via a builder method whose
+    returns are all donation-identical jit applications) in any method."""
+    for ci in mod.classes.values():
+        for fi in ci.methods.values():
+            for node in ast.walk(fi.node):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                binding = binding_for_value(program, mod, fi, node.value)
+                if binding is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        ci.attr_bindings.setdefault(t.attr, binding)
+
+
+def builder_binding(program: Program, mod: ModuleInfo,
+                    fi: FunctionInfo) -> Optional[JitBinding]:
+    """When every return of ``fi`` is a jit application with the same
+    donation/static signature, calling ``fi`` yields that binding —
+    the ``self._step = self._build_step(...)`` idiom."""
+    bindings: List[JitBinding] = []
+    # returns of fi ITSELF — nested defs (the wrapped step fns) have
+    # their own returns that must not disqualify the builder
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fi.node))
+    own_nodes: List[ast.AST] = []
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        own_nodes.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    for node in own_nodes:
+        if isinstance(node, ast.Return) and node.value is not None:
+            if not isinstance(node.value, ast.Call):
+                return None
+            wrapped, _ = (_jit_call_info(node.value) or (None, None))
+            target = None
+            if isinstance(wrapped, ast.Name):
+                local = fi.nested_def(wrapped.id)
+                if local is not None:
+                    target = FunctionInfo(
+                        f"{fi.qual}.<local>.{wrapped.id}", wrapped.id,
+                        mod, local, fi.class_name)
+            b = jit_binding_from_call(node.value, target)
+            if b is None:
+                return None
+            bindings.append(b)
+    if not bindings:
+        return None
+    sig = {(b.donate_argnums, b.static_argnums, b.static_argnames)
+           for b in bindings}
+    if len(sig) != 1:
+        return None
+    return bindings[0]
+
+
+def binding_for_value(program: Program, mod: ModuleInfo,
+                      fi: Optional[FunctionInfo],
+                      call: ast.Call) -> Optional[JitBinding]:
+    """JitBinding for the RHS of an assignment: a direct jit application,
+    or a call to a builder method/function whose returns are jit."""
+    direct = jit_binding_from_call(call, None)
+    if direct is not None:
+        wrapped, _ = _jit_call_info(call)
+        target = None
+        if isinstance(wrapped, ast.Name):
+            target = program.resolve_symbol(mod, wrapped.id)
+            if target is None and fi is not None:
+                local = fi.nested_def(wrapped.id)
+                if local is not None:
+                    target = FunctionInfo(
+                        f"{fi.qual}.<local>.{wrapped.id}", wrapped.id,
+                        mod, local, fi.class_name)
+        elif isinstance(wrapped, ast.Attribute) \
+                and isinstance(wrapped.value, ast.Name) \
+                and wrapped.value.id == "self" \
+                and fi is not None and fi.class_name:
+            cls = mod.classes.get(fi.class_name)
+            target = program.method_on(cls, wrapped.attr) if cls else None
+        if target is not None:
+            direct = dataclasses.replace(direct, fn=target)
+        return direct
+    builder = program.resolve_call(mod, fi, call)
+    if builder is not None:
+        return builder_binding(program, builder.module, builder)
+    return None
+
+
+def _collect_calls_and_roots(program: Program, mod: ModuleInfo) -> None:
+    all_fis: List[FunctionInfo] = list(mod.functions.values())
+    for ci in mod.classes.values():
+        all_fis.extend(ci.methods.values())
+
+    for fi in all_fis:
+        # decorator-marked trace entries
+        for dec in fi.node.decorator_list:
+            if _is_jit_decorator(dec) is not None \
+                    or dotted(dec) in _TRACE_ENTRY_NAMES \
+                    or (isinstance(dec, ast.Call)
+                        and dotted(dec.func) in _TRACE_ENTRY_NAMES):
+                program.jit_roots.add(fi.qual)
+        edges = program.calls.setdefault(fi.qual, set())
+        sites = program.call_sites.setdefault(fi.qual, [])
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = program.resolve_call(mod, fi, node)
+            if callee is not None:
+                edges.add(callee.qual)
+                sites.append((node, callee))
+
+    # functions passed (by name / self-attr) to jit/pjit/shard_map sites
+    for node in ast.walk(mod.ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        is_entry = d in _TRACE_ENTRY_NAMES or _jit_call_info(node) is not None
+        if not is_entry or not node.args:
+            continue
+        fn_expr = node.args[0]
+        owner = program.owner_of(mod, node)
+        target: Optional[FunctionInfo] = None
+        if isinstance(fn_expr, ast.Name):
+            target = program.resolve_symbol(mod, fn_expr.id)
+        elif isinstance(fn_expr, ast.Attribute) \
+                and isinstance(fn_expr.value, ast.Name) \
+                and fn_expr.value.id == "self" \
+                and owner is not None and owner.class_name:
+            cls = mod.classes.get(owner.class_name)
+            if cls:
+                target = program.method_on(cls, fn_expr.attr)
+        if target is not None:
+            program.jit_roots.add(target.qual)
+        elif isinstance(fn_expr, ast.Name) and owner is not None:
+            # a nested def traced from inside its enclosing function:
+            # mark the ENCLOSING program-level def so rules that ask
+            # "does trace-context code live here" see it
+            if owner.nested_def(fn_expr.id) is not None:
+                program.jit_roots.add(owner.qual)
+
+
+def build_program(ctxs: Iterable[FileContext]) -> Program:
+    program = Program()
+    for ctx in ctxs:
+        mod = _collect_module(ctx)
+        # loose single files (fixtures, tmp modules) keyed by stem
+        key = mod.name or Path(mod.path).stem
+        mod.name = key
+        program.modules[key] = mod
+        program.by_path[ctx.path] = mod
+        for fi in mod.functions.values():
+            program.functions[fi.qual] = fi
+        for ci in mod.classes.values():
+            for fi in ci.methods.values():
+                program.functions[fi.qual] = fi
+    for mod in program.modules.values():
+        _index_owners(program, mod)
+    for mod in program.modules.values():
+        _collect_attr_bindings(program, mod)
+    for mod in program.modules.values():
+        _collect_calls_and_roots(program, mod)
+
+    # BFS: everything reachable from a trace entry is traced
+    frontier = list(program.jit_roots)
+    program.jit_reachable = set(frontier)
+    while frontier:
+        nxt: List[str] = []
+        for qual in frontier:
+            for callee in program.calls.get(qual, ()):
+                if callee not in program.jit_reachable:
+                    program.jit_reachable.add(callee)
+                    nxt.append(callee)
+        frontier = nxt
+    return program

@@ -84,11 +84,22 @@ def _int_elems(node: ast.AST) -> List[int]:
     return []
 
 
+# memoized by tree identity: several rules need the same maps for the
+# same file in one run.  Single-slot caches: rules for one file run
+# back-to-back, and bounding at one entry means a long-lived process
+# (pytest session, editor daemon) never accumulates pinned ASTs.
+_DEFS_MEMO: List[tuple] = []
+_ENC_MEMO: List[tuple] = []
+
+
 def _function_defs(tree: ast.AST) -> Dict[str, List[ast.FunctionDef]]:
+    if _DEFS_MEMO and _DEFS_MEMO[0][0] is tree:
+        return _DEFS_MEMO[0][1]
     defs: Dict[str, List[ast.FunctionDef]] = {}
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             defs.setdefault(node.name, []).append(node)
+    _DEFS_MEMO[:] = [(tree, defs)]
     return defs
 
 
@@ -96,6 +107,8 @@ def _enclosing_map(tree: ast.AST) -> Dict[int, Optional[ast.AST]]:
     """id(node) -> innermost enclosing FunctionDef (None at module
     scope) — lets name lookups respect lexical scoping, so a local
     closure named ``step`` never aliases a method named ``step``."""
+    if _ENC_MEMO and _ENC_MEMO[0][0] is tree:
+        return _ENC_MEMO[0][1]
     enc: Dict[int, Optional[ast.AST]] = {id(tree): None}
 
     def walk(node, current):
@@ -106,6 +119,7 @@ def _enclosing_map(tree: ast.AST) -> Dict[int, Optional[ast.AST]]:
                 else current)
 
     walk(tree, None)
+    _ENC_MEMO[:] = [(tree, enc)]
     return enc
 
 
@@ -204,6 +218,8 @@ def _is_static_expr(node: ast.AST) -> bool:
       "device->host sync inside jit-traced code (.item(), float()/int() "
       "on array values, np.asarray/np.array on traced values)")
 def check_host_sync(ctx: FileContext) -> Iterator[Finding]:
+    if "jit" not in ctx.source:       # no traced code, nothing to sync
+        return
     for fn in _traced_functions(ctx.tree):
         for node in ast.walk(fn):
             if not isinstance(node, ast.Call):
@@ -251,23 +267,13 @@ _SERVING_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray",
                        "numpy.array", "onp.asarray", "onp.array"}
 
 
-def _serving_marked_lines(source: str) -> Set[int]:
+def _serving_marked_lines(ctx: FileContext) -> Set[int]:
     """Line numbers of ``# tpulint: serving-loop`` COMMENT tokens (a
     docstring mentioning the marker must not mark anything)."""
-    import io
     import re
-    import tokenize
 
     pat = re.compile(r"#\s*tpulint:\s*" + _SERVING_MARK + r"\b")
-    out: Set[int] = set()
-    try:
-        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
-    except (tokenize.TokenError, IndentationError, SyntaxError):
-        return out
-    for tok in tokens:
-        if tok.type == tokenize.COMMENT and pat.search(tok.string):
-            out.add(tok.start[0])
-    return out
+    return {line for line, text in ctx.comments if pat.search(text)}
 
 
 @rule("serving-sync",
@@ -275,7 +281,7 @@ def _serving_marked_lines(source: str) -> Set[int]:
       "inside a '# tpulint: serving-loop' marked method — route token "
       "fetches through the one pragma'd emit point")
 def check_serving_sync(ctx: FileContext) -> Iterator[Finding]:
-    marked = _serving_marked_lines(ctx.source)
+    marked = _serving_marked_lines(ctx)
     if not marked:
         return
     for fn in ast.walk(ctx.tree):
@@ -366,6 +372,8 @@ def _params_of(fn: ast.FunctionDef):
       "jit static_argnums/static_argnames that don't exist, or whose "
       "defaults are unhashable (recompile/TypeError hazards)")
 def check_static_args(ctx: FileContext) -> Iterator[Finding]:
+    if "jit" not in ctx.source:
+        return
     for call, fn in _jit_sites(ctx.tree):
         kw = {k.arg: k.value for k in call.keywords if k.arg}
         line = getattr(call, "lineno", fn.lineno if fn else 0)
@@ -444,6 +452,8 @@ def _local_axis_vocab(ctx: FileContext) -> Set[str]:
       "lax collective axis names cross-checked against the mesh axes "
       "declared in comm/mesh.py")
 def check_axis_name(ctx: FileContext) -> Iterator[Finding]:
+    if not any(c in ctx.source for c in _COLLECTIVES):
+        return
     valid = ctx.mesh_axes | _local_axis_vocab(ctx)
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
@@ -513,6 +523,8 @@ def _handler_surfaces(handler: ast.ExceptHandler) -> bool:
       "bare except / except Exception that falls back without logging "
       "the swallowed error (the silent-disable bug pattern)")
 def check_silent_except(ctx: FileContext) -> Iterator[Finding]:
+    if "except" not in ctx.source:
+        return
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.ExceptHandler):
             continue
@@ -537,6 +549,9 @@ def check_silent_except(ctx: FileContext) -> Iterator[Finding]:
       "stray print()/pdb/breakpoint in library code — route through "
       "utils.logging", library_only=True)
 def check_print(ctx: FileContext) -> Iterator[Finding]:
+    if "print" not in ctx.source and "pdb" not in ctx.source \
+            and "breakpoint" not in ctx.source:
+        return
     for node in ast.walk(ctx.tree):
         if isinstance(node, ast.Call):
             d = dotted(node.func)
@@ -598,6 +613,8 @@ def _maximal_refs(scope: ast.AST):
       "buffer passed at a donate_argnums position and then used again — "
       "donated buffers are invalidated by the call")
 def check_donated_reuse(ctx: FileContext) -> Iterator[Finding]:
+    if "donate_argnums" not in ctx.source:
+        return
     scopes = [ctx.tree] + [n for n in ast.walk(ctx.tree)
                            if isinstance(n, (ast.FunctionDef,
                                              ast.AsyncFunctionDef))]
