@@ -97,18 +97,44 @@ Round-3 llama legs (measured 2026-07-31 on the v5e):
 """
 
 import json
+import sys
 import time
 
 import numpy as np
 
 
-def main():
-    import jax
+def _serving_device():
+    """First device of the default backend — falling back to CPU when
+    the configured platform cannot initialize (every BENCH_r0* on a
+    TPU-less container died rc=1 with JaxRuntimeError right here at
+    jax.devices(); a bench that cannot measure the accelerator should
+    still measure the code).  The platform actually used is recorded in
+    the result JSON."""
+    import os
 
+    import jax
+    try:
+        return jax.devices()[0]
+    except Exception as e:
+        print(  # tpulint: disable=print — CLI diagnostic on stderr
+            f"bench: default JAX backend unavailable "
+            f"({type(e).__name__}: {str(e).splitlines()[0][:120]}); "
+            f"falling back to JAX_PLATFORMS=cpu", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"   # children / late imports
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            import jax.extend.backend as jeb
+            jeb.clear_backends()
+        except Exception:  # tpulint: disable=silent-except — API probe
+            jax.clear_backends()    # pre-0.4.34 spelling
+        return jax.devices()[0]
+
+
+def main():
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import build_model
 
-    dev = jax.devices()[0]
+    dev = _serving_device()
     on_tpu = dev.platform == "tpu"
 
     seq = 1024 if on_tpu else 128
@@ -198,6 +224,7 @@ def main():
 
     serve = leg(serving_bench, on_tpu)
     pipe = leg(pipeline_serving_bench, on_tpu)
+    prefix = leg(shared_prefix_serving_bench, on_tpu)
     llama_train = leg(llama_train_bench, on_tpu, peak)
     llama_serve = leg(llama8b_serving_bench, on_tpu)
     moe = leg(moe_train_bench, on_tpu, peak)
@@ -206,6 +233,7 @@ def main():
         "metric": "gpt2s_train_tokens_per_sec_chip",
         "value": round(tok_s, 1),
         "unit": "tokens/s",
+        "platform": dev.platform,
         "vs_baseline": round(vs_baseline, 4),
         "mfu": round(mfu, 4) if on_tpu else 0.0,
     }
@@ -214,7 +242,8 @@ def main():
         out["serving_decode_tok_s"] = round(serve[1], 1)
     else:
         out.update(serve)
-    print(json.dumps({**out, **pipe, **llama_train, **llama_serve, **moe}))
+    print(json.dumps({**out, **pipe, **prefix, **llama_train,  # tpulint: disable=print — the bench's one JSON output line
+                      **llama_serve, **moe}))
 
 
 def moe_train_bench(on_tpu: bool, peak: float):
@@ -714,6 +743,68 @@ def pipeline_serving_bench(on_tpu: bool):
     h2 = breakdown["pipe2"]["host_crit_ms_per_step"]
     out["pipeline_host_overhead_ratio"] = round(h2 / h1, 3) if h1 else 0.0
     out["pipeline_step_breakdown_ms"] = breakdown
+    return out
+
+
+def shared_prefix_serving_bench(on_tpu: bool):
+    """Prefix-cache serving leg: N requests sharing a 64-token system
+    prompt (the few-shot/system-prompt traffic shape prefix caching
+    targets), arriving one after another — each admitted after the
+    previous request produced its first token, so later requests can
+    alias the registered prompt blocks.  The token budget is set BELOW
+    the prompt length: with SplitFuse's fixed-shape steps the cache's
+    win is fewer prefill steps (a cache-hit request starts prefill at
+    the first uncached token), which is both prefill-token throughput
+    and TTFT.  Reports tok/s for prefix_cache on vs off at identical
+    shapes, the speedup, and the engine's hit-rate counters."""
+    import numpy as np
+
+    from deepspeed_tpu.inference import (InferenceConfig, InferenceEngine,
+                                         SamplingParams)
+    from deepspeed_tpu.models import build_model
+
+    n_req = 8
+    shared_len = 64
+    tail_len = 64 if on_tpu else 32
+    budget = 64 if on_tpu else 32
+    model = build_model(
+        "gpt2",
+        **(dict(max_seq_len=1024) if on_tpu else
+           dict(num_layers=2, d_model=128, num_heads=4, vocab_size=1024,
+                max_seq_len=256)))
+    r = np.random.RandomState(0)
+    vocab = model.config.vocab_size
+    shared = list(r.randint(0, vocab, shared_len))
+    prompts = {uid: shared + list(r.randint(0, vocab, tail_len))
+               for uid in range(n_req)}
+    sp = SamplingParams(temperature=0.0, max_new_tokens=1)
+    out = {}
+    for mode in ("off", "on"):
+        eng = InferenceEngine(model, InferenceConfig(
+            token_budget=budget, max_seqs=4,
+            kv_block_size=64 if on_tpu else 16,
+            num_kv_blocks=64 if on_tpu else 48,
+            prefix_cache=mode))
+        # warm the compile caches with an unrelated prompt (both modes
+        # pay it; its blocks never match the shared prefix)
+        eng.generate({-1: list(r.randint(0, vocab,
+                                         shared_len + tail_len))}, sp)
+        eng.reset_timings()
+        t0 = time.perf_counter()
+        for uid, p in prompts.items():
+            eng.generate({uid: list(p)}, sp)
+        dt = time.perf_counter() - t0
+        total_prompt = n_req * (shared_len + tail_len)
+        out[f"shared_prefix_prefill_tok_s_{mode}"] = \
+            round(total_prompt / dt, 1)
+        if mode == "on":
+            tm = eng.timings
+            out["shared_prefix_cached_tokens"] = tm["cached_tokens"]
+            out["shared_prefix_hit_rate"] = round(
+                tm["cached_tokens"] / max(tm["prompt_tokens"], 1), 3)
+    out["shared_prefix_speedup"] = round(
+        out["shared_prefix_prefill_tok_s_on"]
+        / max(out["shared_prefix_prefill_tok_s_off"], 1e-9), 2)
     return out
 
 

@@ -37,7 +37,7 @@ from ..utils.logging import logger
 from .model import pipelined_ragged_step, ragged_forward
 from .ragged.state import (FEEDBACK_TOKEN, BatchStager, KVCacheConfig,
                            RaggedBatch, StateManager)
-from .sampler import SamplingParams, sample
+from .sampler import SamplingParams, sample_rows
 
 
 @dataclasses.dataclass
@@ -108,6 +108,19 @@ class InferenceConfig:
     # into the synchronous loop.  Host RAM pays one transient cache copy
     # instead.
     kv_donate: str = "auto"
+    # automatic prefix caching over the paged KV cache: full KV blocks
+    # are content-hashed by their token chain (rolling hash of
+    # (parent, block_tokens)) and an incoming prompt's longest cached
+    # block-aligned prefix is aliased — refcounted, read-only — into its
+    # block table, so prefill starts at the first uncached token
+    # (copy-on-write when a sequence must append into a shared block).
+    # Matching is pure host-side hashing: a miss adds ZERO device work,
+    # and blocks only alias within this engine's own pool, so "auto"
+    # (default) simply enables it on every backend; "off" disables
+    # (strict step-for-step reproduction of a cache-less engine), "on"
+    # forces.  Hit counters: engine.timings cached_tokens/prefix_hits/
+    # prompt_tokens, query()["cached_tokens"].
+    prefix_cache: str = "auto"
 
 
 # attn-impl probe results, memoized per (backend, shape signature)
@@ -149,6 +162,9 @@ class InferenceEngine:
         self.model = model
         self.cfg: TransformerConfig = model.config
         self.icfg = config or InferenceConfig()
+        if self.icfg.prefix_cache not in ("auto", "on", "off"):
+            raise ValueError(f"prefix_cache={self.icfg.prefix_cache!r}: "
+                             "expected 'auto', 'on', or 'off'")
         max_len = self.icfg.max_seq_len or self.cfg.max_seq_len
         # a sequence can never hold more blocks than the pool has
         self.max_blocks_per_seq = min(-(-max_len // self.icfg.kv_block_size),
@@ -162,7 +178,9 @@ class InferenceEngine:
             dtype=self.icfg.kv_dtype,
             quant=self.icfg.kv_quant or "none")
         self.state = StateManager(kv_cfg, max_seqs=self.icfg.max_seqs,
-                                  max_blocks_per_seq=self.max_blocks_per_seq)
+                                  max_blocks_per_seq=self.max_blocks_per_seq,
+                                  prefix_cache=self.icfg.prefix_cache
+                                  != "off")
         self.topology = topology if (
             topology is not None and topology.device_count > 1) else None
         self.params = jax.tree.map(
@@ -196,6 +214,7 @@ class InferenceEngine:
         self._pending: Dict[int, List[int]] = {}   # uid -> unprocessed toks
         self._ctx_exhausted: set = set()
         self._rng = jax.random.PRNGKey(0)
+        self._cow_fn = None           # lazy jitted prefix-cache block copy
         self._pstep_fns: Dict[tuple, object] = {}  # (bucket, sampler_key)
         self._burst_fns: Dict[tuple, object] = {}
         self._steps_done = 0
@@ -222,10 +241,18 @@ class InferenceEngine:
         donation — forces it synchronous), the wait for the collected
         step's sample array, and the pure device->host fetch.  A
         pipelined engine's per-step critical-path host overhead is
-        roughly wall/steps - (device_ms + wait_ms)/steps."""
+        roughly wall/steps - (device_ms + wait_ms)/steps.
+
+        Also zeroes the prefix-cache hit counters: ``prompt_tokens``
+        (total prompt tokens of admitted requests), ``cached_tokens``
+        (prompt tokens served from the cache — skipped prefill), and
+        ``prefix_hits`` (admitted requests with a nonzero match); hit
+        rate = cached_tokens / prompt_tokens."""
         self.timings = {"schedule_ms": 0.0, "stage_ms": 0.0,
                         "device_ms": 0.0, "wait_ms": 0.0,
-                        "readback_ms": 0.0, "steps": 0}
+                        "readback_ms": 0.0, "steps": 0,
+                        "prompt_tokens": 0, "cached_tokens": 0,
+                        "prefix_hits": 0}
 
     def refresh_params(self, params) -> None:
         """Swap the served weights (hybrid-engine policy refresh).
@@ -447,40 +474,44 @@ class InferenceEngine:
                     kv_host=getattr(self, "_kv_on_host", False),
                     shard_mesh=self._tp_mesh, stream=self._stream), mbs
 
-    def _donate_kv(self) -> tuple:
-        """donate_argnums for the per-step serving programs (the cache
-        rides argnum 2).  See ``InferenceConfig.kv_donate``: donation on
-        XLA:CPU blocks each dispatch until the in-flight producer of the
-        donated cache finishes, so a pipelined CPU engine trades one
-        transient cache copy for async dispatch."""
+    def _donate_kv(self) -> bool:
+        """Whether serving programs donate the paged cache.  See
+        ``InferenceConfig.kv_donate``: donation on XLA:CPU blocks each
+        dispatch until the in-flight producer of the donated cache
+        finishes, so a pipelined CPU engine trades one transient cache
+        copy for async dispatch."""
         mode = self.icfg.kv_donate
         if mode == "off":
-            return ()
+            return False
         if mode == "auto" and self.icfg.pipeline_depth >= 2 \
                 and self.icfg.decode_burst <= 1 \
                 and jax.default_backend() == "cpu":
             # burst engines route generate() to the strict-sync driver,
             # so their steps never pipeline — keep donating for them
-            return ()
-        return (2,)
+            return False
+        return True
 
-    def _serving_jit(self, fn):
-        """jit a serving program of signature (..., kv-at-argnum-2, ...)
-        -> (small replicated output, new_kv), with the cache donated
-        (see ``_donate_kv``) and its sharding (host placement / head
-        split) pinned."""
-        donate = self._donate_kv()
+    def _serving_jit(self, fn, kv_argnum: int = 2,
+                     kv_only_output: bool = False):
+        """jit a serving program whose paged-KV operand rides
+        ``kv_argnum`` and whose output is (small replicated output,
+        new_kv) — or bare new_kv with ``kv_only_output`` (the COW block
+        copy) — with the cache donated (see ``_donate_kv``) and its
+        sharding (host placement / head split) pinned.  THE one place
+        the KV donation/placement jit policy lives."""
+        donate = (kv_argnum,) if self._donate_kv() else ()
         if getattr(self, "_kv_on_host", False):
             # pin the cache output to host memory so the persistent
             # state never round-trips through HBM between steps
-            out_sh = (None, jax.tree.map(lambda x: x.sharding,
-                                         self.state.kv))
+            kv_sh = jax.tree.map(lambda x: x.sharding, self.state.kv)
+            out_sh = kv_sh if kv_only_output else (None, kv_sh)
             return jax.jit(fn, donate_argnums=donate, out_shardings=out_sh)
         if self._kv_nsh is not None:
             # logits/tokens replicated (one small host fetch), cache
             # keeps its head-split sharding across the donation
-            return jax.jit(fn, donate_argnums=donate,
-                           out_shardings=(self._repl, self._kv_nsh))
+            out_sh = self._kv_nsh if kv_only_output \
+                else (self._repl, self._kv_nsh)
+            return jax.jit(fn, donate_argnums=donate, out_shardings=out_sh)
         return jax.jit(fn, donate_argnums=donate)
 
     def _build_step(self, mbs: Optional[int] = None):
@@ -520,8 +551,8 @@ class InferenceEngine:
         bs = self.icfg.kv_block_size
         fw, mbs = self._resolve_fw(mbs)
 
-        def sample_fn(logits, r):
-            return sample(logits, sampling, r)
+        def sample_fn(logits, keys):
+            return sample_rows(logits, sampling, keys)
 
         def pstep(params, quant, kv, batch: RaggedBatch, prev_toks, rng):
             return pipelined_ragged_step(cfg, params, quant, kv, batch,
@@ -620,7 +651,10 @@ class InferenceEngine:
                 logger.warning(f"{label} probe: {name} failed "
                                f"({type(e).__name__}); skipping")
         # restore a pristine zero cache (the probe wrote its fake token)
+        # and drop any prefix-cache index entries — zeroed blocks no
+        # longer hold the content their hashes promise
         self.state.kv = self._kv_zeros()
+        self.state.reset_prefix_cache()
         if getattr(self, "_kv_on_host", False):
             self.state.kv = jax.device_put(self.state.kv,
                                            jax.memory.Space.Host)
@@ -726,6 +760,9 @@ class InferenceEngine:
             "seen_tokens": seq.seen_tokens if seq else 0,
             "generated": list(seq.tokens) if seq else [],
             "max_context": self.max_blocks_per_seq * self.icfg.kv_block_size,
+            # prompt tokens this sequence got from the prefix cache
+            # (prefill started at the first uncached token)
+            "cached_tokens": seq.cached_tokens if seq else 0,
         }
 
     # ------------------------------------------------------------------
@@ -734,39 +771,76 @@ class InferenceEngine:
         first (latency), then prompt chunks (throughput) — while
         *reserving* KV blocks and slots as requests are admitted so the
         collective admission can never exceed the pool
-        (reference: can_schedule engine_v2.py:184 + SchedulingResult)."""
+        (reference: can_schedule engine_v2.py:184 + SchedulingResult).
+
+        New prompts first consult the prefix cache: the longest cached
+        block-aligned prefix is aliased into the sequence's table and
+        those tokens never enter the budget — prefill starts at the
+        first uncached token.  Blocks/slots are tracked as *reservations*
+        against the live allocator (matching mutates it mid-round)."""
         budget = self.icfg.token_budget
-        free_blocks = self.state.allocator.free_blocks
-        free_slots = len(self.state._free_slots)
         bs = self.icfg.kv_block_size
+        # blocks/slots promised to earlier admits this round but only
+        # allocated for real in build_batch
+        reserved_blocks = 0
+        reserved_slots = 0
+        prefix_on = self.state.prefix_cache
         sched: List[tuple] = []
 
         def admit(uid, toks):
-            nonlocal budget, free_blocks, free_slots
+            nonlocal budget, reserved_blocks, reserved_slots
             seq = self.state.seqs.get(uid)
             ctx_rem = self.state.context_remaining(uid)
             if ctx_rem <= 0:
                 self._ctx_exhausted.add(uid)
                 return
-            n = min(len(toks), budget, ctx_rem)
-            needs_slot = seq is None or uid not in self.state._slots
-            if needs_slot and free_slots <= 0:
+            needs_slot = uid not in self.state._slots
+            if needs_slot and \
+                    len(self.state._free_slots) - reserved_slots <= 0:
                 return
+            new_prompt = seq is None
+            prompt_len = len(toks) if new_prompt else 0
+            cached = 0
+            if new_prompt and prefix_on and toks[0] != FEEDBACK_TOKEN:
+                # the match may revive cached-free blocks / take a COW
+                # copy ONLY from the headroom not already reserved by
+                # earlier admits this round
+                cached = self.state.match_prefix(
+                    uid, toks,
+                    max_pool_take=self.state.allocator.free_blocks
+                    - reserved_blocks)
+                if cached:
+                    del toks[:cached]
+                    seq = self.state.seqs[uid]
+                    needs_slot = False     # match_prefix claimed the slot
+                    ctx_rem = self.state.context_remaining(uid)
+            n = min(len(toks), budget, ctx_rem)
+            avail = self.state.allocator.free_blocks - reserved_blocks
+            need = 0
             while n > 0:
                 seen = seq.seen_tokens if seq else 0
                 have = len(seq.blocks) if seq else 0
                 need = max(0, -(-(seen + n) // bs) - have)
-                if need <= free_blocks:
+                if need <= avail:
                     break
                 n //= 2
+            if n <= 0 and not cached:
+                return
+            tm = self.timings
+            tm["prompt_tokens"] += prompt_len
+            if cached:
+                tm["cached_tokens"] += cached
+                tm["prefix_hits"] += 1
             if n <= 0:
+                # matched but the pool can't take the uncached remainder
+                # yet: the sequence keeps its aliased blocks and waits
                 return
             sched.append((uid, toks[:n]))
             del toks[:n]
             budget -= n
-            free_blocks -= need
+            reserved_blocks += need
             if needs_slot:
-                free_slots -= 1
+                reserved_slots += 1
 
         # decode requests (continuing sequences, single token) first,
         # then prompt chunks — one O(n) pass keyed on the entry itself
@@ -807,18 +881,17 @@ class InferenceEngine:
 
     @staticmethod
     def _rng_drawer(rng: Optional[jax.Array]):
-        """None, or a zero-arg callable yielding a fresh subkey per
-        dispatched step — drawn lazily (only when a step actually
-        launches) so the strict-sync and pipelined drivers consume the
-        caller's key stream identically: one split per launched step."""
+        """None, or a zero-arg callable yielding the BASE sampling key
+        for each dispatched step.  An explicit caller key is reused
+        verbatim for every step of the call: per-token randomness comes
+        from the (uid, position) fold inside the jitted step
+        (``sampler.row_keys``), which makes seeded outputs
+        schedule-invariant — pipeline depth, prompt chunking, decode
+        bursts, and prefix-cache hits all change the step stream, but
+        never a token's folded key."""
         if rng is None:
             return None
-        box = [rng]
-
-        def draw():
-            box[0], sub = jax.random.split(box[0])
-            return sub
-        return draw
+        return lambda: rng
 
     def _dispatch(self, sampling: SamplingParams,
                   rng=None) -> Optional[_InFlight]:  # tpulint: serving-loop
@@ -856,6 +929,7 @@ class InferenceEngine:
         batch = self._stage(
             self.state.build_batch(sched, self.icfg.token_budget,
                                    stager=self._stager))
+        self._drain_cow()       # COW copies land before the step's write
         t2 = time.perf_counter()
         if callable(rng):
             rng = rng()
@@ -898,6 +972,28 @@ class InferenceEngine:
                      if not self._pending.get(uid))
         self._dispatch_seq += 1
         return _InFlight(toks=toks, emit=emit, sid=self._dispatch_seq)
+
+    def _drain_cow(self) -> None:  # tpulint: serving-loop
+        """Execute queued copy-on-write block copies (a prefix-cache
+        match that covered a whole prompt aliases its last block as a
+        private copy) on device BEFORE the dispatch that appends into
+        the copy.  Pure async enqueue — no host sync; a round with no
+        full-cover match is a no-op."""
+        copies = self.state.take_cow_copies()
+        if not copies:
+            return
+        if self._cow_fn is None:
+            def copy_block(kv, src, dst):
+                return jax.tree.map(
+                    lambda x: x.at[:, dst].set(x[:, src]), kv)
+
+            # compiled once per engine (src/dst ride as traced scalars);
+            # donation/placement policy shared with the step programs
+            self._cow_fn = self._serving_jit(copy_block, kv_argnum=0,
+                                             kv_only_output=True)
+        for src, dst in copies:
+            self.state.kv = self._cow_fn(self.state.kv, np.int32(src),
+                                         np.int32(dst))
 
     def _mark_feedback(self, uid: int, st: _InFlight) -> None:
         """Queue uid's next decode token as a deferred on-device read of
@@ -958,16 +1054,17 @@ class InferenceEngine:
         cfg = self.cfg
         bs = self.icfg.kv_block_size
 
-        def sample_fn(logits, r):
-            return sample(logits, sampling, r)
+        def sample_fn(logits, keys):
+            return sample_rows(logits, sampling, keys)
 
         # quant is a jit argument (closure capture would bake the whole
         # quantized model into the HLO as constants — see _build_step)
-        def burst(params, quant, kv, block_tables, base_ctx, token0, rng):
+        def burst(params, quant, kv, block_tables, base_ctx, token0, uids,
+                  rng):
             prefix = snapshot_prefix(kv, block_tables, P, bs)
             toks, tail = decode_burst_forward(
                 cfg, params, prefix, base_ctx, token0, steps, sample_fn,
-                rng, quant=quant,
+                rng, uids=uids, quant=quant,
                 mixed_gemm=getattr(self, "_mixed_gemm_active", False))
             kv = scatter_tail(kv, tail, block_tables, base_ctx, bs)
             return toks, kv
@@ -1025,16 +1122,19 @@ class InferenceEngine:
                 raise RuntimeError(      # unreachable after the fit check
                     f"uid {uid}: cannot reserve {steps} tokens of KV")
 
+        self._drain_cow()        # pending COW copies precede burst writes
         st = self.state
         S = self.icfg.max_seqs
         base = np.zeros(S, np.int32)
         tok0 = np.zeros(S, np.int32)
+        uids_arr = np.zeros(S, np.uint32)
         tables = np.full((S, self.icfg.num_kv_blocks), -1, np.int32)
         for uid in pending:
             slot = st.slot(uid)
             seq = st.seqs[uid]
             base[slot] = seq.seen_tokens
             tok0[slot] = pending[uid][0]
+            uids_arr[slot] = np.uint32(uid & 0xFFFFFFFF)
             tables[slot, :len(seq.blocks)] = seq.blocks
         # prefix bucket: geometric (doubling) block-aligned sizes, so a
         # 32k-context engine compiles O(log) burst programs, not one per
@@ -1057,7 +1157,8 @@ class InferenceEngine:
         toks, self.state.kv = self._burst_fns[key](
             self.params, self._quant, self.state.kv,
             self._stage(jnp.asarray(tables)), self._stage(jnp.asarray(base)),
-            self._stage(jnp.asarray(tok0)), self._stage(rng))
+            self._stage(jnp.asarray(tok0)),
+            self._stage(jnp.asarray(uids_arr)), self._stage(rng))
         self._steps_done += steps
         toks_np = self._fetch_tokens(toks)             # ONE fetch
         out: Dict[int, List[int]] = {}

@@ -29,6 +29,7 @@ from ..compat import shard_map
 from ..models import layers as L
 from ..models.transformer import TransformerConfig, _norm
 from .ragged.state import RaggedBatch
+from .sampler import row_keys
 
 
 _KV_QMAX = {jnp.dtype(jnp.int8): 127.0,
@@ -347,6 +348,15 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
 
     ``kv``: [L, blocks, bs, 2, Hkv, D].  Rows of the logits output whose
     ``batch.logits_idx`` is -1 are garbage (callers mask by it).
+
+    Every path is position-absolute: a batch whose tokens START at a
+    nonzero context offset (chunked SplitFuse prefill — and, same
+    mechanism, a prefill resuming after a prefix-cache hit aliased the
+    leading blocks) needs no special handling: rope/learned positions
+    index ``batch.positions``, KV writes land at
+    ``block_tables[pos // bs], pos % bs``, and attention masks by
+    absolute key position ≤ query position over whatever the block
+    table references.
     ``attn_impl``: "xla" (gather) | "pallas" (streaming kernel).
     ``quant``: ZeRO-Inference weight-quant tree (inference/quantization
     ``quantize_model_params``) — one layer is dequantized at a time
@@ -458,9 +468,14 @@ def pipelined_ragged_step(cfg: TransformerConfig, params, quant, kv,
     (still on device — the engine reads a step's tokens back only after
     dispatching the next one).  ``batch.feedback_src[t] == s`` means
     token ``t``'s id is ``prev_toks[s]`` rather than
-    ``batch.token_ids[t]``; -1 keeps the host-staged id.  Returns
-    (sampled tokens [max_seqs] i32, new_kv); rows of the token output
-    whose ``batch.logits_idx`` is -1 are garbage (callers mask by the
+    ``batch.token_ids[t]``; -1 keeps the host-staged id.  ``rng`` is the
+    caller's BASE key; each row samples with a key folded by its
+    (uid, position) — see ``sampler.row_keys`` — so sampled values are
+    invariant to scheduling (pipeline depth, chunking, prefix-cache
+    hits).  ``sample_fn(logits, keys)`` consumes the per-row keys
+    (greedy ignores them and XLA drops the fold).  Returns (sampled
+    tokens [max_seqs] i32, new_kv); rows of the token output whose
+    ``batch.logits_idx`` is -1 are garbage (callers mask by the
     schedule, exactly like the logits of :func:`ragged_forward`)."""
     fb = batch.feedback_src
     if fb is not None:
@@ -470,7 +485,8 @@ def pipelined_ragged_step(cfg: TransformerConfig, params, quant, kv,
     logits, new_kv = ragged_forward(cfg, params, kv, batch, block_size,
                                     max_blocks_per_seq, quant=quant,
                                     **fw_kwargs)
-    return sample_fn(logits, rng), new_kv
+    keys = row_keys(rng, batch.seq_uids, batch.context_lens)
+    return sample_fn(logits, keys), new_kv
 
 
 # --------------------------------------------------------------------------
@@ -501,12 +517,16 @@ def snapshot_prefix(kv, block_tables, P: int, block_size: int):
 
 def decode_burst_forward(cfg: TransformerConfig, params, prefix,
                          base_ctx, token0, steps: int, sample_fn,
-                         rng, quant=None, mixed_gemm: bool = False):
+                         rng, uids=None, quant=None,
+                         mixed_gemm: bool = False):
     """Run ``steps`` decode iterations entirely on device.
 
     prefix: [L, S, P, 2, Hkv, D] dense read-only context (closure-sized
     operand); base_ctx: [S] i32 tokens already in context per slot;
-    token0: [S] i32 the last fed token per slot.  Returns
+    token0: [S] i32 the last fed token per slot; uids: [S] u32 the uid
+    occupying each slot (sampling keys fold the base ``rng`` by
+    (uid, position) exactly like the stepwise path, so seeded bursts
+    match seeded steps token-for-token).  Returns
     (tokens [steps, S], tail [L, S, steps, 2, Hkv, D]) — the caller
     scatters the tail back into the paged cache.
 
@@ -610,11 +630,12 @@ def decode_burst_forward(cfg: TransformerConfig, params, prefix,
         return y, tail_l
 
     tail0 = jnp.zeros((nL, S, steps, 2, Hkv, D), dt)
-    rngs = jax.random.split(rng, steps)
+    if uids is None:
+        uids = jnp.zeros(S, jnp.uint32)
 
     def iteration(carry, xs):
         tok, tail = carry
-        j, r = xs
+        j = xs
         pos = base_ctx + j                           # this token's position
         x = L.embed(embed_tab, tok).astype(dt)
         if cfg.embed_norm:              # bloom word_embeddings_layernorm
@@ -637,12 +658,15 @@ def decode_burst_forward(cfg: TransformerConfig, params, prefix,
             logits = x @ params["lm_head"]["kernel"].astype(dt)
             if cfg.head_bias:
                 logits = logits + params["lm_head"]["bias"].astype(dt)
-        nxt = sample_fn(logits.astype(jnp.float32), r)
+        # sampled token j lands at position pos+1 = its post-step context
+        # length — the same (uid, position) fold the stepwise path uses
+        keys = row_keys(rng, uids, pos + 1)
+        nxt = sample_fn(logits.astype(jnp.float32), keys)
         return (nxt, tail), nxt
 
     (_, tail), toks = jax.lax.scan(
         iteration, (token0, tail0),
-        (jnp.arange(steps, dtype=jnp.int32), rngs))
+        jnp.arange(steps, dtype=jnp.int32))
     return toks, tail
 
 

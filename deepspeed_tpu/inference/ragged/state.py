@@ -19,7 +19,8 @@ Differences forced/afforded by XLA:
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, NamedTuple, Optional
+import hashlib
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +34,19 @@ from .allocator import BlockedAllocator
 # token back (engine.py substitutes it inside the jitted step from the
 # prior step's [max_seqs] sample array).  Real token ids are >= 0.
 FEEDBACK_TOKEN = -1
+
+# root parent digest of every per-sequence block hash chain
+_CHAIN_ROOT = b"kv-prefix-chain-v1"
+
+
+def chain_hash(parent: bytes, tokens) -> bytes:
+    """Rolling content hash of one FULL KV block: digest of
+    ``(parent_hash, block_tokens)``.  128-bit blake2b — the index maps
+    digest -> physical block and a collision would silently alias wrong
+    KV, so a real cryptographic digest (not Python's ``hash``) is the
+    cheap insurance; hashing a 64-token block is ~1 µs."""
+    toks = np.asarray(tokens, np.int64).tobytes()
+    return hashlib.blake2b(parent + toks, digest_size=16).digest()
 
 
 @dataclasses.dataclass
@@ -79,6 +93,16 @@ class SequenceDescriptor:
     seen_tokens: int = 0                       # tokens already in KV
     blocks: List[int] = dataclasses.field(default_factory=list)
     tokens: List[int] = dataclasses.field(default_factory=list)  # generated
+    # --- prefix-cache state --------------------------------------------
+    cached_tokens: int = 0        # tokens served from the prefix cache
+    # token ids in KV order while every value is host-known; a deferred
+    # on-device token (FEEDBACK_TOKEN) or a device-side decode burst
+    # breaks the chain — blocks past the break are never content-hashed
+    chain: List[int] = dataclasses.field(default_factory=list)
+    chain_broken: bool = False
+    # per-full-block rolling hashes (parallel to ``blocks``' prefix);
+    # pre-seeded by a prefix match, extended as chain blocks fill
+    hashes: List[bytes] = dataclasses.field(default_factory=list)
 
     def blocks_needed(self, new_tokens: int, block_size: int) -> int:
         total = self.seen_tokens + new_tokens
@@ -104,6 +128,12 @@ class RaggedBatch(NamedTuple):
                                  # [T] i32: slot whose previous-step
                                  # on-device sample supplies this token's
                                  # id (-1 = token_ids holds the value)
+    seq_uids: Optional[jnp.ndarray] = None
+                                 # [max_seqs] u32: uid occupying each
+                                 # slot (masked to 32 bits; 0 when
+                                 # empty).  Feeds the schedule-invariant
+                                 # per-(uid, position) sampling keys —
+                                 # see sampler.sample_rows
 
 
 class BatchStager:
@@ -133,6 +163,7 @@ class BatchStager:
             "context_lens": np.zeros(S, np.int32),
             "logits_idx": np.full(S, -1, np.int32),
             "feedback_src": np.full(T, -1, np.int32),
+            "seq_uids": np.zeros(S, np.uint32),
         }
 
     def next_buffers(self) -> Dict[str, np.ndarray]:
@@ -146,22 +177,43 @@ class BatchStager:
         b["context_lens"].fill(0)
         b["logits_idx"].fill(-1)
         b["feedback_src"].fill(-1)
+        b["seq_uids"].fill(0)
         return b
 
 
 class StateManager:
-    """Owns allocator + sequence table + the paged KV cache
-    (reference: DSStateManager ragged_manager.py)."""
+    """Owns allocator + sequence table + the paged KV cache + the
+    prefix-cache hash index (reference: DSStateManager ragged_manager.py).
+
+    With ``prefix_cache=True``, every FULL block whose token chain is
+    host-known is registered in a ``digest -> physical block`` index as
+    it fills; :meth:`match_prefix` aliases an incoming prompt's longest
+    cached block-aligned prefix into the new sequence's block table
+    (refcounted, read-only) so prefill starts at the first uncached
+    token.  Unreferenced cached blocks rest on the allocator's LRU
+    cached-free pool until evicted for a fresh allocation."""
 
     def __init__(self, cfg: KVCacheConfig, max_seqs: int = 16,
-                 max_blocks_per_seq: Optional[int] = None):
+                 max_blocks_per_seq: Optional[int] = None,
+                 prefix_cache: bool = False):
         self.cfg = cfg
         self.max_seqs = max_seqs
         self.max_blocks_per_seq = max_blocks_per_seq or cfg.num_blocks
-        self.allocator = BlockedAllocator(cfg.num_blocks)
+        self.prefix_cache = prefix_cache
+        self.allocator = BlockedAllocator(cfg.num_blocks,
+                                          on_evict=self._on_evict)
         self.seqs: Dict[int, SequenceDescriptor] = {}
         self._slots: Dict[int, int] = {}       # uid -> batch row
         self._free_slots = list(range(max_seqs))
+        # prefix-cache index: chain digest -> physical block (1:1), plus
+        # the reverse map the eviction callback uses
+        self._hash_index: Dict[bytes, int] = {}
+        self._block_hash: Dict[int, bytes] = {}
+        # copy-on-write copies queued by match_prefix: (uid, src, dst).
+        # The ENGINE drains these with a device block copy before the
+        # next step dispatch (the scheduler itself never touches the
+        # device); release() drops a flushed sequence's entries
+        self.cow_pending: List[Tuple[int, int, int]] = []
         # paged KV: [L, blocks+1, block_size, 2, Hkv, D] — the extra row is
         # the trash block that padding tokens' KV writes are routed to
         # (plus per-vector scales when cfg.quant != "none")
@@ -180,13 +232,145 @@ class StateManager:
         return self._slots[uid]
 
     def release(self, uid: int) -> None:
-        """(reference: flush engine_v2.py:242)."""
+        """(reference: flush engine_v2.py:242).  Blocks drop one
+        reference each: a block whose content is index-registered and
+        whose refcount hits zero retires to the cached-free LRU pool
+        (matchable until evicted); the rest go back to the free list."""
         seq = self.seqs.pop(uid, None)
         if seq is None:
             return
+        if self.cow_pending:
+            # a queued-but-undrained COW copy must die with its owner:
+            # its dst block is freed right here and may be reallocated
+            # before the engine would have executed the copy
+            self.cow_pending = [c for c in self.cow_pending if c[0] != uid]
         if seq.blocks:
-            self.allocator.free(seq.blocks)
+            # retire TAIL blocks into the cached-free LRU first: a chain
+            # block is only matchable when every ancestor is still
+            # indexed, so eviction (oldest-released first) must consume
+            # chains leaf-first — a surviving cached prefix stays useful
+            self.allocator.free(list(reversed(seq.blocks)))
         self._free_slots.append(self._slots.pop(uid))
+
+    # ---- prefix cache ----------------------------------------------------
+    def _on_evict(self, block: int) -> None:
+        """Allocator reclaimed a cached-free block: drop its index entry
+        (nothing may match content about to be overwritten)."""
+        h = self._block_hash.pop(block, None)
+        if h is not None:
+            self._hash_index.pop(h, None)
+
+    def match_prefix(self, uid: int, tokens: List[int],
+                     max_pool_take: Optional[int] = None) -> int:
+        """Alias the longest cached block-aligned prefix of ``tokens``
+        into a NEW sequence ``uid`` and return the number of prompt
+        tokens served from the cache (0 = no match; the caller drops the
+        matched tokens from its pending queue, so prefill starts at the
+        first uncached token).
+
+        ``max_pool_take`` caps how many blocks the match may REMOVE from
+        the allocatable pool (reviving a cached-free block and the COW
+        copy below each count; aliasing a live block is free) — the
+        scheduler passes its unreserved headroom so a mid-round match
+        can never consume blocks already promised to an earlier admit.
+
+        At least one token is always left for the prefill step (the
+        forward must run to produce the first logits).  When the cached
+        chain covers the whole prompt, the last matched block therefore
+        becomes a shared *partial* block from this sequence's view — it
+        is copy-on-write'd: a fresh block is allocated, a device copy
+        (queued on ``cow_pending``) duplicates the content, and the
+        sequence's table points at the private copy while the original
+        stays in the index for future matchers."""
+        bs = self.cfg.block_size
+        if (not self.prefix_cache or uid in self.seqs
+                or not self._free_slots or len(tokens) <= bs):
+            return 0
+        if max_pool_take is None:
+            max_pool_take = self.allocator.free_blocks
+        parent = _CHAIN_ROOT
+        hashes: List[bytes] = []
+        blocks: List[int] = []
+        takes = 0
+        for k in range(min(len(tokens) // bs, self.max_blocks_per_seq)):
+            h = chain_hash(parent, tokens[k * bs:(k + 1) * bs])
+            b = self._hash_index.get(h)
+            if b is None:
+                break
+            t = 1 if self.allocator.refcount(b) == 0 else 0
+            if takes + t > max_pool_take:
+                break
+            takes += t
+            hashes.append(h)
+            blocks.append(b)
+            parent = h
+        if not blocks:
+            return 0
+        for b in blocks:
+            self.allocator.ref(b)
+        matched = len(blocks) * bs
+        if matched >= len(tokens):
+            # full cover: re-schedule the last token so the step has
+            # output; it re-writes position matched-1 inside the last
+            # matched block -> copy-on-write (the rewrite is
+            # content-equivalent but must not touch a shared block)
+            matched = len(tokens) - 1
+            if takes < max_pool_take and self.allocator.free_blocks >= 1:
+                src = blocks[-1]
+                [dst] = self.allocator.allocate(1)
+                self.cow_pending.append((uid, src, dst))
+                self.allocator.free([src])     # swap our alias for the copy
+                blocks[-1] = dst
+            else:
+                # no room for the private copy: drop back to a
+                # block-aligned match instead
+                self.allocator.free([blocks.pop()])
+                hashes.pop()
+                matched = len(blocks) * bs
+                if not blocks:
+                    return 0
+        seq = self.get_or_create(uid)
+        seq.blocks = list(blocks)
+        seq.seen_tokens = matched
+        seq.cached_tokens = matched
+        seq.chain = list(tokens[:matched])
+        seq.hashes = hashes
+        return matched
+
+    def _register_chain_blocks(self, seq: SequenceDescriptor) -> None:
+        """Content-hash and index any chain blocks that just became full
+        (called from build_batch after the chain is extended — so a block
+        is matchable from the very step that fills it; device ordering
+        makes the write land before any aliasing step's read)."""
+        bs = self.cfg.block_size
+        while len(seq.hashes) < len(seq.chain) // bs:
+            k = len(seq.hashes)
+            parent = seq.hashes[-1] if seq.hashes else _CHAIN_ROOT
+            h = chain_hash(parent, seq.chain[k * bs:(k + 1) * bs])
+            seq.hashes.append(h)
+            if h not in self._hash_index:
+                b = seq.blocks[k]
+                self._hash_index[h] = b
+                self._block_hash[b] = h
+                self.allocator.mark_cached(b)
+
+    def reset_prefix_cache(self) -> None:
+        """Drop every index entry; cached-free blocks become plain free.
+        (Used when cache CONTENT is invalidated, e.g. the engine's
+        attn-impl probe rewrites the pool with synthetic tokens.)"""
+        for b in list(self._block_hash):
+            self.allocator.unmark_cached(b)
+        self._block_hash.clear()
+        self._hash_index.clear()
+        self.cow_pending.clear()
+
+    def take_cow_copies(self) -> List[Tuple[int, int]]:
+        """Hand the queued (src, dst) copy-on-write block copies to the
+        engine (which executes them on device BEFORE the next step) and
+        clear the queue."""
+        out = [(s, d) for _, s, d in self.cow_pending]
+        self.cow_pending.clear()
+        return out
 
     # ---- scheduling query ------------------------------------------------
     @property
@@ -223,8 +407,12 @@ class StateManager:
 
     def advance(self, uid: int, n_tokens: int) -> None:
         """Account tokens written device-side (burst iterations past the
-        first host-fed token)."""
-        self.seqs[uid].seen_tokens += n_tokens
+        first host-fed token).  Burst-written KV bypasses build_batch, so
+        the content hash chain ends here — prompt blocks registered
+        earlier stay matchable."""
+        seq = self.seqs[uid]
+        seq.seen_tokens += n_tokens
+        seq.chain_broken = True
 
     # ---- batch building --------------------------------------------------
     def build_batch(self, requests: List[tuple], token_budget: int,
@@ -249,6 +437,7 @@ class StateManager:
             context_lens = bufs["context_lens"]
             logits_idx = bufs["logits_idx"]
             feedback_src = bufs["feedback_src"]
+            seq_uids = bufs["seq_uids"]
         else:
             token_ids = np.zeros(T, np.int32)
             positions = np.zeros(T, np.int32)
@@ -260,12 +449,14 @@ class StateManager:
             context_lens = np.zeros(self.max_seqs, np.int32)
             logits_idx = np.full(self.max_seqs, -1, np.int32)
             feedback_src = np.full(T, -1, np.int32)
+            seq_uids = np.zeros(self.max_seqs, np.uint32)
 
         # keep existing sequences' tables valid even if not in this batch
         for uid, seq in self.seqs.items():
             s = self._slots[uid]
             block_tables[s, :len(seq.blocks)] = seq.blocks
             context_lens[s] = seq.seen_tokens
+            seq_uids[s] = np.uint32(uid & 0xFFFFFFFF)
 
         cursor = 0
         n_seqs = 0
@@ -291,16 +482,24 @@ class StateManager:
                 # step's on-device sample at this sequence's slot
                 token_ids[cursor] = 0
                 feedback_src[cursor] = s
+                # the host never learns this KV row's token id in order,
+                # so content hashing stops here for this sequence
+                seq.chain_broken = True
             else:
                 token_ids[cursor:cursor + n] = new_tokens
+                if self.prefix_cache and not seq.chain_broken:
+                    seq.chain.extend(int(t) for t in new_tokens)
             positions[cursor:cursor + n] = np.arange(
                 seq.seen_tokens, seq.seen_tokens + n)
             seq_slot[cursor:cursor + n] = s
             seq.seen_tokens += n
             context_lens[s] = seq.seen_tokens
+            seq_uids[s] = np.uint32(uid & 0xFFFFFFFF)
             logits_idx[s] = cursor + n - 1
             cursor += n
             n_seqs += 1
+            if self.prefix_cache and not seq.chain_broken:
+                self._register_chain_blocks(seq)
 
         return RaggedBatch(
             token_ids=jnp.asarray(token_ids),
@@ -311,4 +510,5 @@ class StateManager:
             context_lens=jnp.asarray(context_lens),
             logits_idx=jnp.asarray(logits_idx),
             n_tokens=cursor, n_seqs=n_seqs,
-            feedback_src=jnp.asarray(feedback_src))
+            feedback_src=jnp.asarray(feedback_src),
+            seq_uids=jnp.asarray(seq_uids))

@@ -56,3 +56,36 @@ def sample(logits: jnp.ndarray, params: SamplingParams,
                                      axis=-1)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def row_keys(rng: jax.Array, uids: jnp.ndarray,
+             context_lens: jnp.ndarray) -> jnp.ndarray:
+    """[max_seqs] per-row sampling keys: ``fold_in(fold_in(rng, uid),
+    position)`` where position is the sampled token's index in its
+    sequence (= context length after the step).
+
+    This makes a sequence's sampled-token randomness a pure function of
+    (base key, uid, position) — invariant to HOW the serving loop
+    scheduled the work.  That is what keeps seeded sampling
+    token-for-token identical across pipeline depths, decode bursts, and
+    prefix-cache hits/misses (a cache hit collapses prefill steps, so
+    any per-step key stream would diverge)."""
+    def one(u, c):
+        return jax.random.fold_in(jax.random.fold_in(rng, u), c)
+    return jax.vmap(one)(uids, context_lens)
+
+
+def sample_rows(logits: jnp.ndarray, params: SamplingParams,
+                keys: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """logits [S, V] + per-row keys [S, key] → token ids [S].
+
+    The per-row-keyed sibling of :func:`sample` the serving steps bake
+    in; greedy ignores ``keys`` entirely (XLA dead-code-eliminates the
+    key computation, so the seeded machinery costs nothing at
+    temperature 0)."""
+    if params.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if keys is None:
+        raise ValueError("temperature sampling requires per-row keys "
+                         "(the engine supplies them automatically)")
+    return jax.vmap(lambda l, k: sample(l[None], params, k)[0])(logits, keys)

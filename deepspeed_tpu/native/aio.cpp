@@ -42,6 +42,7 @@
 #include <functional>
 #include <memory>
 #include <mutex>
+#include <poll.h>
 #include <string>
 #include <sys/stat.h>
 #include <sys/types.h>
@@ -368,18 +369,29 @@ public:
     // pool likewise completes its queue before destruction)
     if (reaper_.joinable() && !dead_.load()) wait();
     if (reaper_.joinable()) {
+      // the reaper's primary wake channel is stop_ + its bounded poll
+      // (it re-checks stop_ at least every poll timeout), so shutdown
+      // can never be stranded by a submission failure.  The NOP
+      // sentinel below is a best-effort INSTANT wake: if pushing or
+      // submitting it fails in any way we just fall through to the
+      // bounded-poll path instead of looping on errno forever (the old
+      // sentinel-MUST-land loop hung the destructor on any errno
+      // outside EINTR/EAGAIN/EBUSY).
+      stop_.store(true);
       {
         std::lock_guard<std::mutex> lk(mu_);
         struct io_uring_sqe sqe;
         std::memset(&sqe, 0, sizeof(sqe));
         sqe.opcode = IORING_OP_NOP;
         sqe.user_data = ~0ull;           // stop sentinel
-        while (!ring_.push(sqe))
-          uring::sys_enter(ring_.fd, 0, 1, IORING_ENTER_GETEVENTS);
-        while (uring::sys_enter(ring_.fd, 1, 0, 0) < 0 &&
-               (errno == EINTR || errno == EAGAIN || errno == EBUSY))
-          ;                       // the sentinel MUST reach the kernel —
-      }                           // reaper_.join() hangs otherwise
+        if (ring_.push(sqe)) {
+          for (int tries = 0; tries < 64; ++tries) {
+            if (uring::sys_enter(ring_.fd, 1, 0, 0) >= 0) break;
+            if (errno != EINTR && errno != EAGAIN && errno != EBUSY)
+              break;
+          }
+        }
+      }
       reaper_.join();
     }
     for (char *b : bounce_) free(b);
@@ -490,7 +502,16 @@ private:
       if (r >= 0) return;
       if ((errno == EINTR || errno == EAGAIN || errno == EBUSY) &&
           tries < 1000) {
-        uring::sys_enter(ring_.fd, 0, 1, IORING_ENTER_GETEVENTS);
+        // transient submit failure.  Only an op already in flight can
+        // post the completion whose reaping frees resources, so a
+        // min_complete=1 GETEVENTS here (holding mu_!) would block
+        // forever whenever nothing else is pending.  Instead: a BOUNDED
+        // poll — woken early by CQ readiness when ops are outstanding
+        // (pending_ counts this op too, hence > 1), a pure short
+        // backoff when they are not; tries*timeout bounds the total
+        // wait before the poison path.
+        struct pollfd pfd = {ring_.fd, POLLIN, 0};
+        poll(&pfd, 1, pending_.load() > 1 ? 50 : 2);
         continue;
       }
       // fatal: the SQE may or may not ever be consumed later — poison
@@ -537,8 +558,14 @@ private:
         n = ring_.pop(cqe, 64);
       }
       if (n == 0) {
-        int r = uring::sys_enter(ring_.fd, 0, 1, IORING_ENTER_GETEVENTS);
-        if (r < 0 && errno != EINTR && errno != EAGAIN) {
+        if (stop_.load()) return;   // shutdown: second wake channel —
+        // never depends on a sentinel SQE reaching the kernel
+        // bounded CQ wait: the ring fd polls readable when completions
+        // are pending, and the timeout re-checks stop_ so a lost
+        // wakeup can strand this thread for at most one interval
+        struct pollfd pfd = {ring_.fd, POLLIN, 0};
+        int r = poll(&pfd, 1, 100);
+        if (r < 0 && errno != EINTR) {
           // ring unusable: poison the engine (submits fail fast) and
           // fail everything still pending so wait() returns
           dead_.store(true);
@@ -624,7 +651,8 @@ private:
   std::atomic<long> odirect_ops_;
   std::atomic<long> tasks_total_;
   std::atomic<bool> dead_{false};
-};
+  std::atomic<bool> stop_{false};   // reaper shutdown flag (dtor sets it;
+};                                  // the bounded poll observes it)
 
 }  // namespace
 

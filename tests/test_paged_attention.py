@@ -102,6 +102,62 @@ class TestPagedAttentionKernel:
         assert len(out[1]) > 0
 
 
+class TestAliasedBlockTables:
+    """Prefix-cache aliasing at the attention level: two sequences'
+    block tables referencing the SAME physical block must read identical
+    KV from it — attention is a pure gather by block id, so aliasing is
+    invisible to the kernel.  Checked against a de-aliased reference
+    where the shared content is duplicated into a private block."""
+
+    def _aliased_batch(self, bs=8, Hkv=2, D=16, nblocks=12):
+        r = np.random.RandomState(5)
+        kv = np.asarray(r.randn(nblocks + 1, bs, 2, Hkv, D), np.float32)
+        # both sequences share physical block 4 for positions 0..7, then
+        # diverge; the de-aliased reference gives seq 1 a private copy
+        # (block 9) with identical content
+        kv[9] = kv[4]
+        tables = np.full((4, nblocks), -1, np.int32)
+        tables[0, :2] = [4, 2]
+        tables[1, :2] = [4, 7]
+        dealiased = tables.copy()
+        dealiased[1, 0] = 9
+        # one decode token per sequence, deep enough to read the shared
+        # block AND the private tail
+        tok_pos = [(0, 12), (1, 14)]
+        T = 4
+        positions = np.zeros(T, np.int32)
+        seq_slot = np.zeros(T, np.int32)
+        valid = np.zeros(T, bool)
+        for i, (s, p) in enumerate(tok_pos):
+            seq_slot[i], positions[i], valid[i] = s, p, True
+
+        def batch(tab):
+            return RaggedBatch(
+                token_ids=jnp.zeros(T, jnp.int32),
+                positions=jnp.asarray(positions),
+                seq_slot=jnp.asarray(seq_slot),
+                token_valid=jnp.asarray(valid),
+                block_tables=jnp.asarray(tab),
+                context_lens=jnp.zeros(4, jnp.int32),
+                logits_idx=jnp.full(4, -1, jnp.int32),
+                n_tokens=2, n_seqs=2)
+        return jnp.asarray(kv), batch(tables), batch(dealiased), bs, valid
+
+    @pytest.mark.parametrize("impl", [_paged_attention,
+                                      _paged_attention_pallas])
+    def test_shared_block_reads_identical_kv(self, impl):
+        kv, aliased, dealiased, bs, valid = self._aliased_batch()
+        D = kv.shape[4]
+        q = jnp.asarray(np.random.RandomState(6).randn(
+            aliased.token_ids.shape[0], 4, D), jnp.float32)
+        scale = 1.0 / np.sqrt(D)
+        out_alias = impl(kv, q, aliased, bs, 4, scale)
+        out_ref = impl(kv, q, dealiased, bs, 4, scale)
+        np.testing.assert_allclose(np.asarray(out_alias)[valid],
+                                   np.asarray(out_ref)[valid],
+                                   atol=1e-6, rtol=1e-6)
+
+
 def SamplingParams_greedy():
     from deepspeed_tpu.inference import SamplingParams
     return SamplingParams(temperature=0.0, max_new_tokens=6)

@@ -5,8 +5,18 @@ pool, or the slot pool — and the batch it admits must always build
 without tripping ``build_batch``'s own guards (reference analog:
 ``can_schedule`` engine_v2.py:184 + SchedulingResult).
 
+With the prefix cache in play (identical-prompt traffic drawn from a
+small pool of shared prefixes, plus release/re-admit interleavings) the
+accounting invariants get sharper: blocks may be ALIASED across live
+sequences (refcount = number of holders), released cached blocks rest
+on the cached-free LRU pool, and after every op
+``referenced + cached_free + free == total`` must hold exactly —
+releasing everything must return the pool to fully reclaimable.
+
 Pure host-side: the engine is constructed but no step is ever
 dispatched, so hundreds of scheduler rounds run in milliseconds."""
+
+from collections import Counter
 
 import numpy as np
 import pytest
@@ -54,15 +64,29 @@ def _check_invariants(eng, sched):
 
 def _check_pool_accounting(eng):
     st = eng.state
-    held = [b for seq in st.seqs.values() for b in seq.blocks]
-    # no block owned twice, and free + held covers the pool exactly
-    assert len(held) == len(set(held)), "block aliased across sequences"
-    assert st.allocator.free_blocks + len(held) \
-        == st.allocator.total_blocks
+    al = st.allocator
+    held = Counter(b for seq in st.seqs.values() for b in seq.blocks)
+    # no sequence lists a block twice; aliasing ACROSS sequences is the
+    # prefix cache working as designed — each holder owns one reference
+    for seq in st.seqs.values():
+        assert len(seq.blocks) == len(set(seq.blocks)), \
+            "block repeated within one sequence"
+    for b, holders in held.items():
+        assert al.refcount(b) == holders, \
+            f"block {b}: refcount {al.refcount(b)} != {holders} holders"
+    # the allocator's three pools partition the block space exactly:
+    # referenced + cached_free + free == total (no leak, no double-free)
+    al.assert_invariants()
+    assert al.referenced_blocks == len(held)
+    assert al.free_blocks + len(held) == al.total_blocks
     # slots unique and consistent
     slots = list(st._slots.values())
     assert len(slots) == len(set(slots))
     assert len(slots) + len(st._free_slots) == st.max_seqs
+    # every queued COW copy belongs to a live sequence and targets a
+    # block that sequence actually holds
+    for uid, src, dst in st.cow_pending:
+        assert uid in st.seqs and dst in st.seqs[uid].blocks
 
 
 @pytest.mark.parametrize("seed", range(4))
@@ -94,6 +118,62 @@ def test_schedule_never_overcommits(model, seed):
                 eng.state.build_batch(sched, eng.icfg.token_budget,
                                       stager=eng._stager)
         _check_pool_accounting(eng)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_prefix_cache_fuzz_invariants(model, seed):
+    """Identical-prompt / release / re-admit interleavings under a tight
+    pool: matches alias live AND cached-free blocks, full-cover matches
+    queue COW copies, flushes retire hashed blocks to the cached-free
+    pool, and eviction reclaims them — while after EVERY op refcounts
+    equal holder counts, nothing leaks or double-frees, and
+    ``referenced + cached_free + free == total``.  Finally releasing
+    every sequence returns the pool to fully reclaimable."""
+    r = np.random.RandomState(100 + seed)
+    eng = InferenceEngine(model, InferenceConfig(
+        token_budget=16, max_seqs=3, kv_block_size=8, num_kv_blocks=10,
+        max_seq_len=48, prefix_cache="on"))
+    # a small pool of shared prefixes => identical-prompt traffic with
+    # real hit probability; lengths straddle block boundaries (8) so
+    # both block-aligned and full-cover (COW) matches occur
+    prefixes = [list(r.randint(1, 128, n)) for n in (8, 16, 17, 24, 12)]
+    next_uid = 0
+    matched_any = False
+    for _ in range(300):
+        op = r.randint(5)
+        live = list(eng.state.seqs)
+        if op == 0:                          # identical-prompt admit
+            p = prefixes[r.randint(len(prefixes))]
+            tail = list(r.randint(1, 128, r.randint(0, 6)))
+            eng.put(next_uid, p + tail)
+            next_uid += 1
+        elif op == 1 and live:               # decode continuation
+            uid = live[r.randint(len(live))]
+            if not eng._pending.get(uid):
+                eng.put(uid, [int(r.randint(1, 128))])
+        elif op == 2 and live:               # release a random live seq
+            eng.flush(live[r.randint(len(live))])
+        elif op == 3:                        # unique prompt (cache miss
+            eng.put(next_uid,                # + eviction pressure)
+                    list(r.randint(1, 128, r.randint(1, 40))))
+            next_uid += 1
+        else:
+            sched = eng._schedule()
+            _check_invariants(eng, sched)
+            if sched:
+                eng.state.build_batch(sched, eng.icfg.token_budget,
+                                      stager=eng._stager)
+            matched_any = matched_any or eng.timings["prefix_hits"] > 0
+        _check_pool_accounting(eng)
+    assert matched_any, "fuzz never exercised a prefix-cache hit"
+    # releasing all sequences must leave every block reclaimable
+    for uid in list(eng.state.seqs):
+        eng.flush(uid)
+    al = eng.state.allocator
+    al.assert_invariants()
+    assert al.referenced_blocks == 0
+    assert al.free_blocks == al.total_blocks
+    assert eng.state.cow_pending == []
 
 
 def test_schedule_feedback_markers_admit_like_decodes(model):
