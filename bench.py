@@ -130,7 +130,9 @@ def _serving_device():
         return jax.devices()[0]
 
 
-def main():
+def main(trace_path=None):
+    """``trace_path``: export a Chrome trace (Perfetto-loadable) of the
+    pipelined serving leg's depth-2 run (``--trace out.json``)."""
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import build_model
 
@@ -171,6 +173,8 @@ def main():
     for _ in range(2):                      # compile + steady state
         m = engine.train_batch(next(it))
     float(m["loss"])                        # drain warmup before timing
+    engine.metrics.reset()                  # telemetry covers the timed
+    #                                         window only, not the compile
     # median of several windows — shared/tunneled chips are noisy; each
     # window ends with a host fetch of a step-output scalar, the only
     # reliable completion barrier (block_until_ready is advisory here)
@@ -186,6 +190,9 @@ def main():
 
     tokens_per_step = engine.train_batch_size * (seq - 1)
     tok_s = n * tokens_per_step / dt
+    # host-phase telemetry of the timed window (docs/OBSERVABILITY.md):
+    # per-phase ms counters + the host-wall histogram summary
+    train_metrics = engine.metrics_snapshot()
 
     # model FLOPs: 6 * n_params * tokens (fwd+bwd), attention extra term
     from deepspeed_tpu.runtime import param_count
@@ -223,7 +230,7 @@ def main():
                     f"{(str(e).splitlines() or [''])[0][:120]}"}
 
     serve = leg(serving_bench, on_tpu)
-    pipe = leg(pipeline_serving_bench, on_tpu)
+    pipe = leg(pipeline_serving_bench, on_tpu, trace_path)
     prefix = leg(shared_prefix_serving_bench, on_tpu)
     llama_train = leg(llama_train_bench, on_tpu, peak)
     llama_serve = leg(llama8b_serving_bench, on_tpu)
@@ -236,12 +243,9 @@ def main():
         "platform": dev.platform,
         "vs_baseline": round(vs_baseline, 4),
         "mfu": round(mfu, 4) if on_tpu else 0.0,
+        "train_metrics": train_metrics,
     }
-    if isinstance(serve, tuple):
-        out["serving_ttft_p50_ms"] = round(serve[0], 1)
-        out["serving_decode_tok_s"] = round(serve[1], 1)
-    else:
-        out.update(serve)
+    out.update(serve)
     print(json.dumps({**out, **pipe, **prefix, **llama_train,  # tpulint: disable=print — the bench's one JSON output line
                       **llama_serve, **moe}))
 
@@ -293,6 +297,7 @@ def moe_train_bench(on_tpu: bool, peak: float):
         for _ in range(2):
             m = engine.train_batch(next(it))
         float(m["loss"])
+        engine.metrics.reset()              # exclude compile from telemetry
         n = 5 if on_tpu else 2
         t0 = time.perf_counter()
         for _ in range(n):
@@ -310,6 +315,7 @@ def moe_train_bench(on_tpu: bool, peak: float):
             out["moe8x_train_mfu_active"] = round(
                 tok_s * fpt / peak, 4) if on_tpu else 0.0
         out[f"moe8x_train_tok_s_{mode}"] = round(tok_s, 1)
+        out[f"moe8x_train_metrics_{mode}"] = engine.metrics_snapshot()
         del engine, loader, it, data, model
         gc.collect()
     return out
@@ -362,6 +368,7 @@ def llama_train_bench(on_tpu: bool, peak: float):
     for _ in range(2):
         m = engine.train_batch(next(it))
     float(m["loss"])
+    engine.metrics.reset()                  # exclude compile from telemetry
     n = 5 if on_tpu else 2
     t0 = time.perf_counter()
     for _ in range(n):
@@ -376,6 +383,7 @@ def llama_train_bench(on_tpu: bool, peak: float):
     return {
         "llama07b_train_tok_s": round(tok_s, 1),
         "llama07b_train_mfu": round(mfu, 4),
+        "llama07b_train_metrics": engine.metrics_snapshot(),
     }
 
 
@@ -513,6 +521,8 @@ def llama8b_serving_bench(on_tpu: bool):
     while eng.step(sampling=sp).get(-1) is None:
         pass
     eng.flush(-1)
+    eng.reset_metrics()     # warmup compile must not contaminate the
+    #                         reported request-lifecycle aggregate
 
     # --- prefill: prompt throughput + TTFT
     for uid in range(n_seqs):
@@ -563,6 +573,7 @@ def llama8b_serving_bench(on_tpu: bool):
         f"{name}_ttft_p50_ms": round(ttft_p50, 1),
         f"{name}_decode_tok_s": round(decode_tok_s, 1),
         f"{name}_decode_ms_per_tok_ema": round(ema, 2),
+        f"{name}_request_metrics": eng.request_metrics()["aggregate"],
         **{f"{name}_{k}": v for k, v in sla.items()},
     }
 
@@ -671,10 +682,15 @@ def sla_goodput_sweep(eng, on_tpu: bool, prompt_len: int):
             "goodput_curve": curve}
 
 
-def pipeline_serving_bench(on_tpu: bool):
+def pipeline_serving_bench(on_tpu: bool, trace_path=None):
     """Pipelined vs strict-sync serving loop at identical shapes: decode
     tokens/s for pipeline_depth 1 vs 2 plus the engine's per-step
-    host-overhead breakdown (schedule / stage / device / readback ms).
+    host-overhead breakdown (schedule / stage / device / readback ms)
+    and the request-lifecycle aggregate (TTFT/TPOT histograms) of the
+    timed run.  With ``trace_path``, the depth-2 leg runs with span
+    tracing on and exports a Chrome trace of the timed region (open in
+    Perfetto: one track per pipeline stage, the dispatch-ahead overlap
+    visible directly).
     The pipeline's win is the host work it moves off the critical path:
     schedule+stage of step N+1 and the token readback of step N overlap
     step N/N+1's device compute, so the per-token host overhead
@@ -706,11 +722,15 @@ def pipeline_serving_bench(on_tpu: bool):
             token_budget=1024 if on_tpu else 64, max_seqs=n_seqs,
             kv_block_size=64 if on_tpu else 16,
             num_kv_blocks=1024 if on_tpu else 64,
-            pipeline_depth=depth))
+            pipeline_depth=depth,
+            trace=bool(trace_path) and depth == 2))
         # warm the compile caches (probe + both context buckets) outside
         # the timed region
         eng.generate({u: list(p) for u, p in prompts.items()}, sp)
-        eng.reset_timings()
+        # full telemetry reset: timings counters, request records, AND
+        # the span ring, so every exported number covers the timed
+        # region only
+        eng.reset_metrics()
         t0 = time.perf_counter()
         toks = eng.generate({u: list(p) for u, p in prompts.items()}, sp)
         dt = time.perf_counter() - t0
@@ -718,6 +738,10 @@ def pipeline_serving_bench(on_tpu: bool):
         tl = eng.timings
         steps = max(tl["steps"], 1)
         out[f"pipe{depth}_decode_tok_s"] = round(produced / dt, 1)
+        out[f"pipe{depth}_request_metrics"] = \
+            eng.request_metrics()["aggregate"]
+        if trace_path and depth == 2:
+            out["trace_file"] = eng.tracer.export_chrome_trace(trace_path)
         breakdown[f"pipe{depth}"] = {
             "schedule_ms": round(tl["schedule_ms"] / steps, 3),
             "stage_ms": round(tl["stage_ms"] / steps, 3),
@@ -789,7 +813,7 @@ def shared_prefix_serving_bench(on_tpu: bool):
         # pay it; its blocks never match the shared prefix)
         eng.generate({-1: list(r.randint(0, vocab,
                                          shared_len + tail_len))}, sp)
-        eng.reset_timings()
+        eng.reset_metrics()
         t0 = time.perf_counter()
         for uid, p in prompts.items():
             eng.generate({uid: list(p)}, sp)
@@ -802,6 +826,8 @@ def shared_prefix_serving_bench(on_tpu: bool):
             out["shared_prefix_cached_tokens"] = tm["cached_tokens"]
             out["shared_prefix_hit_rate"] = round(
                 tm["cached_tokens"] / max(tm["prompt_tokens"], 1), 3)
+            out["shared_prefix_request_metrics"] = \
+                eng.request_metrics()["aggregate"]
     out["shared_prefix_speedup"] = round(
         out["shared_prefix_prefill_tok_s_on"]
         / max(out["shared_prefix_prefill_tok_s_off"], 1e-9), 2)
@@ -843,6 +869,8 @@ def serving_bench(on_tpu: bool):
     while eng.step(sampling=sp).get(-1) is None:
         pass
     eng.flush(-1)
+    eng.reset_metrics()     # the warmup request's compile-dominated TTFT
+    #                         must not contaminate the reported aggregate
 
     # --- TTFT: enqueue all prompts, time each seq's first sampled token
     for uid in range(n_seqs):
@@ -871,8 +899,21 @@ def serving_bench(on_tpu: bool):
         out = eng.decode_burst(sampling=sp)
         produced += sum(len(v) for v in out.values())
     dt = time.perf_counter() - t0
-    return ttft_p50_ms, produced / dt
+    # flush everything so per-request TPOT (observed at finish) lands in
+    # the histograms, then report the leg's lifecycle aggregate
+    for uid in range(n_seqs):
+        eng.flush(uid)
+    req = eng.request_metrics()["aggregate"]
+    return {"serving_ttft_p50_ms": round(ttft_p50_ms, 1),
+            "serving_decode_tok_s": round(produced / dt, 1),
+            "serving_request_metrics": req}
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trace", metavar="OUT.json", default=None,
+                    help="export a Chrome trace (Perfetto-loadable) of "
+                    "the pipelined serving leg's depth-2 timed run")
+    main(trace_path=ap.parse_args().trace)

@@ -404,6 +404,17 @@ class FlopsProfilerConfig(ConfigModel):
 
 
 @dataclass
+class TelemetryConfig(ConfigModel):
+    """Host-side telemetry (telemetry/ — docs/OBSERVABILITY.md): the
+    metrics registry is always on (plain host counter bumps); ``trace``
+    additionally records per-phase spans of every training step into a
+    ring buffer for Chrome-trace export
+    (``engine.tracer.export_chrome_trace(path)``, open in Perfetto)."""
+    trace: bool = False
+    trace_capacity: int = 1 << 16       # spans retained (ring wraps)
+
+
+@dataclass
 class TensorBoardConfig(ConfigModel):
     enabled: bool = False
     output_path: str = ""
@@ -550,6 +561,7 @@ class Config(ConfigModel):
         default_factory=ActivationCheckpointingConfig)
     comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
     flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     tensorboard: TensorBoardConfig = field(default_factory=TensorBoardConfig)
     csv_monitor: CSVConfig = field(default_factory=CSVConfig)
     wandb: WandbConfig = field(default_factory=WandbConfig)

@@ -33,6 +33,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..comm.mesh import FSDP_AXIS, MeshTopology, TENSOR_AXIS
 from ..models.transformer import Model, TransformerConfig
+from ..telemetry import (CounterDictView, MetricsRegistry, RequestTracker,
+                         SpanTracer)
 from ..utils.logging import logger
 from .model import pipelined_ragged_step, ragged_forward
 from .ragged.state import (FEEDBACK_TOKEN, BatchStager, KVCacheConfig,
@@ -121,6 +123,17 @@ class InferenceConfig:
     # forces.  Hit counters: engine.timings cached_tokens/prefix_hits/
     # prompt_tokens, query()["cached_tokens"].
     prefix_cache: str = "auto"
+    # span tracing of the serving loop (telemetry/tracer.py): host-side
+    # perf_counter_ns spans for every pipeline stage (schedule / stage /
+    # dispatch / wait / readback, COW drains, prefix-cache lookups) into
+    # a preallocated ring buffer; export with
+    # ``engine.tracer.export_chrome_trace(path)`` and open in Perfetto.
+    # Off by default: the per-span cost is tiny but nonzero.  The
+    # metrics registry (``engine.metrics``) and per-request lifecycle
+    # records (``engine.request_metrics()``) are ALWAYS on — they are
+    # host-side counter bumps that never touch device arrays.
+    trace: bool = False
+    trace_capacity: int = 1 << 16   # spans retained (ring wraps beyond)
 
 
 # attn-impl probe results, memoized per (backend, shape signature)
@@ -231,7 +244,44 @@ class InferenceEngine:
         self._dispatch_seq = 0
         self._fb_step: Dict[int, int] = {}   # uid -> sid its marker defers to
         self._zero_key = jax.random.PRNGKey(0)
-        self.reset_timings()
+        self._setup_telemetry()
+
+    def _setup_telemetry(self) -> None:
+        """Build the metrics registry, the span tracer, and the
+        request-lifecycle tracker (docs/OBSERVABILITY.md).  Everything
+        here is host-side counters/floats — telemetry never touches
+        device arrays on the serving path (tpulint telemetry-hotpath +
+        serving-sync keep it that way)."""
+        self.metrics = MetricsRegistry()
+        self.tracer = SpanTracer(capacity=self.icfg.trace_capacity,
+                                 enabled=self.icfg.trace)
+        self.requests = RequestTracker(self.metrics)
+        reg = self.metrics
+        ms = {k: reg.counter(f"serving_{k}_total",
+                             f"cumulative serving-loop {k.split('_')[0]} "
+                             "phase milliseconds")
+              for k in ("schedule_ms", "stage_ms", "device_ms", "wait_ms",
+                        "readback_ms")}
+        ints = {
+            "steps": reg.counter("serving_steps_total",
+                                 "dispatched serving steps",
+                                 int_valued=True),
+            "prompt_tokens": reg.counter(
+                "serving_prompt_tokens_total",
+                "prompt tokens of admitted requests", int_valued=True),
+            "cached_tokens": reg.counter(
+                "serving_cached_tokens_total",
+                "prompt tokens served from the prefix cache",
+                int_valued=True),
+            "prefix_hits": reg.counter(
+                "serving_prefix_hits_total",
+                "admitted requests with a nonzero prefix match",
+                int_valued=True),
+            "generated_tokens": reg.counter(
+                "serving_generated_tokens_total",
+                "tokens emitted to live sequences", int_valued=True),
+        }
+        self.timings = CounterDictView({**ms, **ints})
 
     def reset_timings(self) -> None:
         """Zero the cumulative per-phase breakdown the serving loop
@@ -243,16 +293,49 @@ class InferenceEngine:
         pipelined engine's per-step critical-path host overhead is
         roughly wall/steps - (device_ms + wait_ms)/steps.
 
-        Also zeroes the prefix-cache hit counters: ``prompt_tokens``
-        (total prompt tokens of admitted requests), ``cached_tokens``
-        (prompt tokens served from the cache — skipped prefill), and
-        ``prefix_hits`` (admitted requests with a nonzero match); hit
-        rate = cached_tokens / prompt_tokens."""
-        self.timings = {"schedule_ms": 0.0, "stage_ms": 0.0,
-                        "device_ms": 0.0, "wait_ms": 0.0,
-                        "readback_ms": 0.0, "steps": 0,
-                        "prompt_tokens": 0, "cached_tokens": 0,
-                        "prefix_hits": 0}
+        Also zeroes the token counters: ``prompt_tokens`` (total prompt
+        tokens of admitted requests), ``cached_tokens`` (prompt tokens
+        served from the prefix cache — skipped prefill), ``prefix_hits``
+        (admitted requests with a nonzero match; hit rate =
+        cached_tokens / prompt_tokens), and ``generated_tokens``
+        (tokens emitted to live sequences).
+
+        ``engine.timings`` is a dict-shaped view over ``engine.metrics``
+        registry counters — this resets exactly those counters; use
+        :meth:`reset_metrics` to also clear request records, latency
+        histograms, and the span ring."""
+        self.timings.reset()
+
+    def reset_metrics(self) -> None:
+        """Full telemetry reset: every registry metric (timings view
+        included), the request-lifecycle tracker, and the span ring —
+        what a bench leg calls between warmup and its timed region."""
+        self.metrics.reset()
+        self.requests.clear()
+        self.tracer.clear()
+
+    def request_metrics(self) -> Dict:
+        """Per-request lifecycle story + fleet aggregate:
+        ``{"aggregate": {requests/finished/open, ttft_ms/tpot_ms/
+        queue_wait_ms summaries}, "requests": [record dicts]}`` —
+        records carry queue_wait/TTFT/TPOT/e2e ms and prompt/cached/
+        generated token counts that reconcile exactly with the
+        ``engine.timings`` counters (tests/test_telemetry.py holds the
+        invariant)."""
+        return {"aggregate": self.requests.aggregate(),
+                "requests": [r.as_dict() for r in self.requests.records()]}
+
+    def metrics_snapshot(self) -> Dict:
+        """JSON-able snapshot of every serving metric (counters +
+        latency histograms); see also ``engine.metrics.prometheus_text()``
+        and ``engine.metrics.write_jsonl(path)``."""
+        return self.metrics.snapshot()
+
+    def publish_metrics(self, monitor, step: int = 0) -> None:
+        """Fan the current metric values out through a ``monitor/``
+        writer (CSV/TensorBoard/WandB/Comet) — serving metrics ride the
+        same pipeline as training scalars."""
+        self.metrics.publish(monitor, step)
 
     def refresh_params(self, params) -> None:
         """Swap the served weights (hybrid-engine policy refresh).
@@ -744,10 +827,14 @@ class InferenceEngine:
     # request API (reference: engine_v2.put :107)
     # ------------------------------------------------------------------
     def put(self, uid: int, tokens: Sequence[int]) -> None:
+        # lifecycle arrival: the first put for a uid with no open record
+        # opens one (continuation puts are an O(1) no-op inside)
+        self.requests.on_arrival(uid)
         self._pending.setdefault(uid, []).extend(int(t) for t in tokens)
 
     def flush(self, uid: int) -> None:
         """(reference: engine_v2.flush :242)."""
+        self.requests.on_finish(uid)
         self._pending.pop(uid, None)
         self._fb_step.pop(uid, None)
         self.state.release(uid)
@@ -805,10 +892,12 @@ class InferenceEngine:
                 # the match may revive cached-free blocks / take a COW
                 # copy ONLY from the headroom not already reserved by
                 # earlier admits this round
-                cached = self.state.match_prefix(
-                    uid, toks,
-                    max_pool_take=self.state.allocator.free_blocks
-                    - reserved_blocks)
+                with self.tracer.span("prefix_match", track="schedule",
+                                      uid=uid):
+                    cached = self.state.match_prefix(
+                        uid, toks,
+                        max_pool_take=self.state.allocator.free_blocks
+                        - reserved_blocks)
                 if cached:
                     del toks[:cached]
                     seq = self.state.seqs[uid]
@@ -831,6 +920,12 @@ class InferenceEngine:
             if cached:
                 tm["cached_tokens"] += cached
                 tm["prefix_hits"] += 1
+            if prompt_len or cached:
+                # lifecycle admission — SAME statement block as the
+                # engine counters above, so per-request token sums
+                # reconcile with them by construction
+                self.requests.on_admitted(uid, prompt_len, cached,
+                                          time.perf_counter())
             if n <= 0:
                 # matched but the pool can't take the uncached remainder
                 # yet: the sequence keeps its aliased blocks and waits
@@ -968,6 +1063,17 @@ class InferenceEngine:
         tm["stage_ms"] += (t2 - t1) * 1e3
         tm["device_ms"] += (t3 - t2) * 1e3
         tm["steps"] += 1
+        for uid, _ in sched:
+            self.requests.on_prefill_start(uid, t3)
+        tr = self.tracer
+        if tr.enabled:
+            # reuse the phase timestamps already taken for timings — one
+            # track per pipeline stage (docs/OBSERVABILITY.md)
+            sid = self._dispatch_seq + 1
+            tr.record("schedule", t0, t1, track="schedule", sid=sid)
+            tr.record("stage", t1, t2, track="stage", sid=sid)
+            tr.record("dispatch", t2, t3, track="dispatch", sid=sid,
+                      n_tokens=sum(len(t) for _, t in sched))
         emit = tuple((uid, self.state.slot(uid)) for uid, _ in sched
                      if not self._pending.get(uid))
         self._dispatch_seq += 1
@@ -991,9 +1097,10 @@ class InferenceEngine:
             # donation/placement policy shared with the step programs
             self._cow_fn = self._serving_jit(copy_block, kv_argnum=0,
                                              kv_only_output=True)
-        for src, dst in copies:
-            self.state.kv = self._cow_fn(self.state.kv, np.int32(src),
-                                         np.int32(dst))
+        with self.tracer.span("cow_drain", track="stage", n=len(copies)):
+            for src, dst in copies:
+                self.state.kv = self._cow_fn(self.state.kv, np.int32(src),
+                                             np.int32(dst))
 
     def _mark_feedback(self, uid: int, st: _InFlight) -> None:
         """Queue uid's next decode token as a deferred on-device read of
@@ -1021,14 +1128,25 @@ class InferenceEngine:
         jax.block_until_ready(st.toks)
         t1 = time.perf_counter()
         toks_np = self._fetch_tokens(st.toks)
-        self.timings["wait_ms"] += (t1 - t0) * 1e3
-        self.timings["readback_ms"] += (time.perf_counter() - t1) * 1e3
+        t2 = time.perf_counter()
+        tm = self.timings
+        tm["wait_ms"] += (t1 - t0) * 1e3
+        tm["readback_ms"] += (t2 - t1) * 1e3
+        tr = self.tracer
+        if tr.enabled:
+            tr.record("wait", t0, t1, track="wait", sid=st.sid)
+            tr.record("readback", t1, t2, track="readback", sid=st.sid)
         out: Dict[int, int] = {}
         for uid, slot in st.emit:
             tok = int(toks_np[slot])
             seq = self.state.seqs.get(uid)
             if seq is not None and self.state._slots.get(uid) == slot:
                 seq.tokens.append(tok)
+                # emitted to a live sequence: the engine generated-token
+                # counter and the request record move together (parity
+                # invariant, tests/test_telemetry.py)
+                tm["generated_tokens"] += 1
+                self.requests.on_tokens(uid, 1, t2)
             out[uid] = tok
             if self._fb_step.get(uid) == st.sid:
                 self._fb_step.pop(uid)
@@ -1154,13 +1272,23 @@ class InferenceEngine:
             self._burst_fns[key] = self._build_burst(steps, sampling, P)
         if rng is None:
             self._rng, rng = jax.random.split(self._rng)
+        t0 = time.perf_counter()
         toks, self.state.kv = self._burst_fns[key](
             self.params, self._quant, self.state.kv,
             self._stage(jnp.asarray(tables)), self._stage(jnp.asarray(base)),
             self._stage(jnp.asarray(tok0)),
             self._stage(jnp.asarray(uids_arr)), self._stage(rng))
+        t1 = time.perf_counter()
         self._steps_done += steps
         toks_np = self._fetch_tokens(toks)             # ONE fetch
+        t2 = time.perf_counter()
+        tr = self.tracer
+        if tr.enabled:
+            tr.record("burst", t0, t1, track="dispatch", steps=steps,
+                      n_seqs=len(pending))
+            tr.record("burst_readback", t1, t2, track="readback",
+                      steps=steps)
+        tm = self.timings
         out: Dict[int, List[int]] = {}
         for uid in pending:
             slot = st.slot(uid)
@@ -1175,6 +1303,11 @@ class InferenceEngine:
                 seq_toks = seq_toks[:i + 1]
                 adv = i + 1
             st.seqs[uid].tokens.extend(seq_toks)
+            # emitted to a live sequence: the engine counter and the
+            # request record move together (the same parity invariant
+            # _collect holds — tests/test_telemetry.py)
+            tm["generated_tokens"] += len(seq_toks)
+            self.requests.on_tokens(uid, len(seq_toks), t2, t_dispatch=t0)
             # the burst wrote `steps` KV rows (fed token + first steps-1
             # sampled); only the pre-stop prefix is committed
             st.advance(uid, adv)

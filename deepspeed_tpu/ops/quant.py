@@ -633,22 +633,6 @@ def dequantize_rowwise12(qt: QuantizedTensor, dtype=None) -> jax.Array:
     return _dequantize_rowwise_minifloat(qt, dtype)
 
 
-def _pack_6bit(u):
-    return _pack_codes(u, 4, 6)
-
-
-def _unpack_6bit(p):
-    return _unpack_codes(p, 4, 6)
-
-
-def _pack_12bit(u):
-    return _pack_codes(u, 2, 12)
-
-
-def _unpack_12bit(p):
-    return _unpack_codes(p, 2, 12)
-
-
 def selective_dequantize(qt: QuantizedTensor, rows: jax.Array,
                          dtype=None) -> jax.Array:
     """Dequantize only the selected first-dim rows of a grouped

@@ -39,6 +39,7 @@ from ..comm.collectives import init_distributed
 from ..config.config import Config, ConfigError, load_config
 from ..parallel.zero import ZeroPolicy
 from ..parallel import sharding as shd
+from ..telemetry import MetricsRegistry, SpanTracer
 from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from .loss_scaler import LossScaler, LossScaleState, all_finite
@@ -249,6 +250,7 @@ class Engine:
 
         self.timers = SynchronizedWallClockTimer()
         self.tput = ThroughputTimer(batch_size=self.train_batch_size)
+        self._setup_telemetry()
         if monitor is None and (config.tensorboard.enabled
                                 or config.csv_monitor.enabled
                                 or config.wandb.enabled
@@ -271,6 +273,44 @@ class Engine:
             f"| zero_stage={self.zero.stage} | mesh={self.topology.axis_sizes} "
             f"| batch={self.train_batch_size} (micro={self.micro_batch_size} "
             f"x gas={self.gas} x dp={self.topology.dp_world_size})")
+
+    # ------------------------------------------------------------------
+    # telemetry (docs/OBSERVABILITY.md)
+    # ------------------------------------------------------------------
+    def _setup_telemetry(self) -> None:
+        """Metrics registry + span tracer for the training step's host
+        phases.  Everything is host-side floats — the step itself is one
+        fused jit program, so the phases telemetry can see are the host
+        work around it: data-efficiency pre-step, batch staging, the
+        (async) dispatch, and the metrics fetch.  Serving metrics and
+        these training counters share the registry/export machinery
+        (telemetry/metrics.py), and :meth:`_finish_step` fans both
+        through the same ``monitor/`` writers as the loss scalars."""
+        tcfg = self.config.telemetry
+        self.metrics = MetricsRegistry()
+        self.tracer = SpanTracer(capacity=tcfg.trace_capacity,
+                                 enabled=tcfg.trace)
+        reg = self.metrics
+        self._phase_ms = {
+            k: reg.counter(f"train_{k}_ms_total",
+                           f"cumulative host milliseconds in the {k} "
+                           "phase of train_batch")
+            for k in ("pre_step", "stage", "dispatch", "fetch")}
+        self._c_steps = reg.counter("train_steps_total",
+                                    "optimizer steps taken",
+                                    int_valued=True)
+        self._h_step_host = reg.histogram(
+            "train_step_host_ms",
+            (0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
+             1000.0, 2000.0, 5000.0, 10000.0, 60000.0),
+            "host-side wall ms per train_batch call (dispatch is async: "
+            "device time appears here only when something blocks)")
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """JSON-able snapshot of the training metrics registry; see also
+        ``engine.metrics.prometheus_text()`` and
+        ``engine.metrics.write_jsonl(path)``."""
+        return self.metrics.snapshot()
 
     # ------------------------------------------------------------------
     # sharding setup
@@ -1480,14 +1520,19 @@ class Engine:
         local view is fine under multi-host; see ``shard_batch``); with
         gas>1, leaves are reshaped to [gas, micro, ...] for the scan.
         """
+        t0 = time.perf_counter()
         if rng is None:
             rng = jax.random.PRNGKey(self.config.seed + self.global_steps)
         if self.curriculum or self.pld or self._ltd_cfg or self.moq:
             batch = self._data_efficiency_pre_step(batch, rng)
         if self._nvme is not None:
+            # the NVMe-streamed step runs as many per-layer programs; its
+            # phases are not the four this instrumentation names
             return self._train_batch_nvme(batch, rng)
+        t1 = time.perf_counter()
         step_fn = self._pick_train_step()
         batch = self.shard_batch(batch)
+        t2 = time.perf_counter()
         self.tput.start()
         try:
             self.state, metrics = step_fn(self.state, batch, rng)
@@ -1507,6 +1552,19 @@ class Engine:
             step_fn = self._pick_train_step()
             self.state, metrics = step_fn(self.state, batch, rng)
         self._offload_validated = True
+        t3 = time.perf_counter()
+        self._phase_ms["pre_step"].inc((t1 - t0) * 1e3)
+        self._phase_ms["stage"].inc((t2 - t1) * 1e3)
+        self._phase_ms["dispatch"].inc((t3 - t2) * 1e3)
+        self._h_step_host.observe((t3 - t0) * 1e3)
+        tr = self.tracer
+        if tr.enabled:
+            # one track per phase — reuses the timestamps above, so
+            # tracing adds no clock reads to the step path
+            sid = self.global_steps + 1
+            tr.record("pre_step", t0, t1, track="pre_step", step=sid)
+            tr.record("stage", t1, t2, track="stage", step=sid)
+            tr.record("dispatch", t2, t3, track="dispatch", step=sid)
         return self._finish_step(batch, rng, metrics)
 
     def _pick_train_step(self):
@@ -1525,6 +1583,7 @@ class Engine:
     def _finish_step(self, batch, rng, metrics) -> Dict[str, Any]:
         self.global_steps += 1
         self.global_samples += self.train_batch_size
+        self._c_steps.inc()
         # metrics stay on device — a host fetch every step would stall the
         # async dispatch pipeline (and on tunneled TPUs pay a round trip
         # per value); fetch once, and only when someone actually looks
@@ -1537,7 +1596,13 @@ class Engine:
         need_host = (self.global_steps % self.config.steps_per_print == 0
                      or self.monitor is not None)
         if need_host:
+            t_f0 = time.perf_counter()
             fetched = jax.device_get(metrics)        # ONE transfer
+            t_f1 = time.perf_counter()
+            self._phase_ms["fetch"].inc((t_f1 - t_f0) * 1e3)
+            if self.tracer.enabled:
+                self.tracer.record("fetch", t_f0, t_f1, track="fetch",
+                                   step=self.global_steps)
             self._last_metrics_host = fetched
             if self.global_steps % self.config.steps_per_print == 0:
                 log_dist(
@@ -1552,6 +1617,13 @@ class Engine:
                     "Train/grad_norm": float(fetched["grad_norm"]),
                     "Train/loss_scale": float(fetched["loss_scale"]),
                 })
+                # registry fan-out rides the SAME writer pipeline as the
+                # loss scalars (telemetry/metrics.py publish): per-phase
+                # host-ms counters + step histogram land in CSV/TB/WandB
+                # at the print cadence (every step would 5x the writer
+                # volume for numbers that only move slowly)
+                if self.global_steps % self.config.steps_per_print == 0:
+                    self.metrics.publish(self.monitor, self.global_steps)
             metrics = fetched
         return metrics
 

@@ -1,0 +1,25 @@
+"""Low-overhead host-side telemetry: span tracing, metrics, request
+lifecycle records (docs/OBSERVABILITY.md).
+
+Three pieces, composable and JAX-free:
+
+* :class:`SpanTracer` — ring-buffer span tracer on ``perf_counter_ns``
+  with Chrome-trace / JSONL export (serving-loop + training-step
+  phases).
+* :class:`MetricsRegistry` — labeled counters / gauges / fixed-bucket
+  histograms with Prometheus text exposition, JSONL snapshots, and
+  fan-out through the ``monitor/`` writer interface.
+* :class:`RequestTracker` — per-request lifecycle records (TTFT / TPOT /
+  queue wait / token accounting) for the inference engine.
+"""
+
+from .metrics import (Counter, CounterDictView, Gauge, Histogram,
+                      MetricsRegistry, parse_prometheus_text)
+from .lifecycle import (QUEUE_WAIT_BUCKETS_MS, RequestRecord,
+                        RequestTracker, TPOT_BUCKETS_MS, TTFT_BUCKETS_MS)
+from .tracer import SpanTracer
+
+__all__ = ["SpanTracer", "MetricsRegistry", "Counter", "Gauge",
+           "Histogram", "CounterDictView", "parse_prometheus_text",
+           "RequestTracker", "RequestRecord", "TTFT_BUCKETS_MS",
+           "TPOT_BUCKETS_MS", "QUEUE_WAIT_BUCKETS_MS"]

@@ -1,0 +1,215 @@
+"""Request-lifecycle records for the serving engine.
+
+Every request the engine sees walks one state machine
+(docs/OBSERVABILITY.md):
+
+    arrival --> admitted --> prefill_start --> first_token --> finish
+    (put)       (scheduler    (first dispatch   (first emitted  (flush)
+                 takes its     carrying its      token)
+                 prompt)       tokens launches)
+
+and its :class:`RequestRecord` yields the per-request latency story:
+
+* **queue wait** — arrival -> admitted (scheduler backlog / pool
+  pressure);
+* **TTFT** — arrival -> first emitted token (what the user feels);
+* **TPOT** — mean inter-token time over the decode tail
+  (``(t_last - t_first) / (generated - 1)``).
+
+Token accounting mirrors the engine counters *by construction*: the
+tracker is bumped at the same statements that bump
+``engine.timings["prompt_tokens"/"cached_tokens"/"generated_tokens"]``,
+so ``sum(per-request) == engine counter`` is an invariant the tests
+enforce (a drift means someone added an accounting site and forgot one
+side).
+
+All timestamps are monotonic ``time.perf_counter()`` seconds; the
+tracker performs dict lookups and float stores only — never device
+work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional
+
+from .metrics import MetricsRegistry
+
+# fixed histogram bucket edges (ms) — powers-of-ten-ish ladders wide
+# enough for CPU-fallback tests and tunneled-TPU serving alike
+TTFT_BUCKETS_MS = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
+                   1000.0, 2000.0, 5000.0, 10000.0, 30000.0)
+TPOT_BUCKETS_MS = (0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0,
+                   500.0, 1000.0, 5000.0)
+QUEUE_WAIT_BUCKETS_MS = TTFT_BUCKETS_MS
+
+
+@dataclasses.dataclass
+class RequestRecord:
+    """One request's lifecycle timestamps + token accounting."""
+    uid: int
+    t_arrival: float
+    t_admitted: Optional[float] = None
+    t_prefill_start: Optional[float] = None
+    t_first_token: Optional[float] = None
+    t_last_token: Optional[float] = None
+    t_finish: Optional[float] = None
+    # decode-tail anchor for TPOT.  Stepwise emission: == t_first_token.
+    # When the record's FIRST emission is a multi-token burst (all n
+    # tokens materialize at one readback instant), this anchors at the
+    # burst's dispatch time instead, so the tail isn't zero-width and
+    # TPOT doesn't collapse to 0 (see RequestTracker.on_tokens).
+    t_tail_start: Optional[float] = None
+    prompt_tokens: int = 0
+    cached_tokens: int = 0
+    generated_tokens: int = 0
+
+    @property
+    def queue_wait_ms(self) -> Optional[float]:
+        if self.t_admitted is None:
+            return None
+        return (self.t_admitted - self.t_arrival) * 1e3
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        if self.t_first_token is None:
+            return None
+        return (self.t_first_token - self.t_arrival) * 1e3
+
+    @property
+    def tpot_ms(self) -> Optional[float]:
+        """Mean time per output token over the decode tail; needs at
+        least two emitted tokens to have a tail."""
+        if self.t_first_token is None or self.t_last_token is None \
+                or self.generated_tokens < 2:
+            return None
+        tail0 = self.t_tail_start if self.t_tail_start is not None \
+            else self.t_first_token
+        return (self.t_last_token - tail0) * 1e3 \
+            / (self.generated_tokens - 1)
+
+    @property
+    def e2e_ms(self) -> Optional[float]:
+        if self.t_finish is None:
+            return None
+        return (self.t_finish - self.t_arrival) * 1e3
+
+    def as_dict(self) -> Dict[str, Any]:
+        ms = {k: (None if v is None else round(v, 4))
+              for k, v in (("queue_wait_ms", self.queue_wait_ms),
+                           ("ttft_ms", self.ttft_ms),
+                           ("tpot_ms", self.tpot_ms),
+                           ("e2e_ms", self.e2e_ms))}
+        return {"uid": self.uid,
+                "prompt_tokens": self.prompt_tokens,
+                "cached_tokens": self.cached_tokens,
+                "generated_tokens": self.generated_tokens,
+                "finished": self.t_finish is not None,
+                **ms}
+
+
+class RequestTracker:
+    """Open-record table + bounded finished ring, feeding the latency
+    histograms and request counters of a :class:`MetricsRegistry`."""
+
+    def __init__(self, registry: MetricsRegistry,
+                 max_finished: int = 4096):
+        self.registry = registry
+        self.open: Dict[int, RequestRecord] = {}
+        self.finished: Deque[RequestRecord] = deque(maxlen=max_finished)
+        self._h_ttft = registry.histogram(
+            "serving_ttft_ms", TTFT_BUCKETS_MS,
+            "arrival to first emitted token")
+        self._h_tpot = registry.histogram(
+            "serving_tpot_ms", TPOT_BUCKETS_MS,
+            "mean inter-token latency over the decode tail")
+        self._h_queue = registry.histogram(
+            "serving_queue_wait_ms", QUEUE_WAIT_BUCKETS_MS,
+            "arrival to first scheduler admission")
+        self._c_arrived = registry.counter(
+            "serving_requests_total", "requests ever opened",
+            int_valued=True)
+        self._c_finished = registry.counter(
+            "serving_requests_finished_total", "requests flushed",
+            int_valued=True)
+
+    def clear(self) -> None:
+        self.open.clear()
+        self.finished.clear()
+
+    # ------------------------------------------------------------------
+    # lifecycle events (all O(1) dict/float work)
+    # ------------------------------------------------------------------
+    def on_arrival(self, uid: int,
+                   now: Optional[float] = None) -> RequestRecord:
+        rec = self.open.get(uid)
+        if rec is not None:
+            return rec                       # continuation put
+        rec = RequestRecord(uid, now if now is not None
+                            else time.perf_counter())
+        self.open[uid] = rec
+        self._c_arrived.inc()
+        return rec
+
+    def on_admitted(self, uid: int, prompt_tokens: int,
+                    cached_tokens: int, now: float) -> None:
+        rec = self.open.get(uid)
+        if rec is None:                      # direct-API putless entry
+            rec = self.on_arrival(uid, now)
+        if rec.t_admitted is None:
+            rec.t_admitted = now
+            self._h_queue.observe((now - rec.t_arrival) * 1e3)
+        rec.prompt_tokens += prompt_tokens
+        rec.cached_tokens += cached_tokens
+
+    def on_prefill_start(self, uid: int, now: float) -> None:
+        rec = self.open.get(uid)
+        if rec is not None and rec.t_prefill_start is None:
+            rec.t_prefill_start = now
+
+    def on_tokens(self, uid: int, n: int, now: float,
+                  t_dispatch: Optional[float] = None) -> None:
+        """``t_dispatch``: for an ``n > 1`` burst emission (all tokens
+        land at one readback), the burst's dispatch time — used as the
+        decode-tail anchor when these are the record's first tokens.
+        TTFT stays at ``now``: the tokens are not visible to the host
+        before readback."""
+        rec = self.open.get(uid)
+        if rec is None or n <= 0:
+            return
+        if rec.t_first_token is None:
+            rec.t_first_token = now
+            rec.t_tail_start = t_dispatch \
+                if (t_dispatch is not None and n > 1) else now
+            self._h_ttft.observe((now - rec.t_arrival) * 1e3)
+        rec.t_last_token = now
+        rec.generated_tokens += n
+
+    def on_finish(self, uid: int, now: Optional[float] = None) -> None:
+        rec = self.open.pop(uid, None)
+        if rec is None:
+            return
+        rec.t_finish = now if now is not None else time.perf_counter()
+        tpot = rec.tpot_ms
+        if tpot is not None:
+            self._h_tpot.observe(tpot)
+        self._c_finished.inc()
+        self.finished.append(rec)
+
+    # ------------------------------------------------------------------
+    def records(self) -> List[RequestRecord]:
+        """Finished (oldest first) then still-open records."""
+        return list(self.finished) + list(self.open.values())
+
+    def aggregate(self) -> Dict[str, Any]:
+        """Compact summary for bench JSON / dashboards."""
+        return {
+            "requests": int(self._c_arrived.value()),
+            "finished": int(self._c_finished.value()),
+            "open": len(self.open),
+            "ttft_ms": self._h_ttft.summary(),
+            "tpot_ms": self._h_tpot.summary(),
+            "queue_wait_ms": self._h_queue.summary(),
+        }

@@ -342,9 +342,9 @@ class TestPackedFP6:
     FP6 GEMM's prepacked weights; previously emulated at int8 width)."""
 
     def test_pack_unpack_lossless(self):
-        from deepspeed_tpu.ops.quant import _pack_6bit, _unpack_6bit
+        from deepspeed_tpu.ops.quant import _pack_codes, _unpack_codes
         u = jnp.arange(64, dtype=jnp.uint32)[None].repeat(3, 0)
-        assert bool((_unpack_6bit(_pack_6bit(u))
+        assert bool((_unpack_codes(_pack_codes(u, 4, 6), 4, 6)
                      == u.astype(jnp.int32)).all())
 
     def test_roundtrip_and_size(self):
@@ -389,9 +389,9 @@ class TestPackedFP6:
 
 class TestPackedFP12:
     def test_pack_unpack_lossless(self):
-        from deepspeed_tpu.ops.quant import _pack_12bit, _unpack_12bit
+        from deepspeed_tpu.ops.quant import _pack_codes, _unpack_codes
         u = jnp.arange(4096, dtype=jnp.uint32)[None]
-        assert bool((_unpack_12bit(_pack_12bit(u))
+        assert bool((_unpack_codes(_pack_codes(u, 2, 12), 2, 12)
                      == u.astype(jnp.int32)).all())
 
     def test_roundtrip_size_and_serving(self):

@@ -1,0 +1,604 @@
+"""Telemetry subsystem tests (docs/OBSERVABILITY.md): span-tracer units
+(nesting, ring wraparound, disabled-mode cost), Chrome-trace schema
+validation of an exported file, metrics registry + Prometheus
+text-exposition round-trip, monitor fan-out, and request-lifecycle
+accounting parity — the sum of per-request prompt/cached/generated
+token counts must reconcile EXACTLY with the engine counters across
+mixed chunked traffic, prefix cache on/off, pipeline depth 1/2, and
+decode bursts (both sides are bumped at the same statements; a drift
+means an accounting site was added on one side only)."""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference import (InferenceConfig, InferenceEngine,
+                                     SamplingParams)
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.telemetry import (CounterDictView, MetricsRegistry,
+                                     RequestTracker, SpanTracer,
+                                     parse_prometheus_text)
+
+
+def tiny_model(**over):
+    kw = dict(vocab_size=128, num_layers=2, d_model=64, num_heads=4,
+              num_kv_heads=2, d_ff=128, max_seq_len=128)
+    kw.update(over)
+    return build_model("llama-tiny", **kw)
+
+
+def make_engine(m, **over):
+    kw = dict(token_budget=32, max_seqs=4, kv_block_size=16,
+              num_kv_blocks=64, kv_dtype=jnp.float32,
+              param_dtype=jnp.float32)
+    kw.update(over)
+    return InferenceEngine(m, InferenceConfig(**kw))
+
+
+@pytest.fixture(scope="module")
+def model():
+    return tiny_model()
+
+
+# --------------------------------------------------------------------------
+# span tracer units
+# --------------------------------------------------------------------------
+
+class TestSpanTracer:
+    def test_disabled_is_shared_noop(self):
+        tr = SpanTracer(capacity=8, enabled=False)
+        s1 = tr.span("a")
+        s2 = tr.span("b", track="t", k=1)
+        assert s1 is s2                      # one shared no-op object
+        with s1:
+            pass
+        tr.record("x", 0.0, 1.0)
+        tr.instant("y")
+        assert len(tr) == 0 and tr.events() == []
+
+    def test_span_nesting_depth(self):
+        tr = SpanTracer(capacity=16, enabled=True)
+        with tr.span("outer", track="t"):
+            with tr.span("inner", track="t"):
+                pass
+        evs = tr.events()
+        # inner exits (and records) first
+        assert [e["name"] for e in evs] == ["inner", "outer"]
+        assert evs[0]["depth"] == 1 and evs[1]["depth"] == 0
+        # containment: outer started before inner and ended after
+        assert evs[1]["ts_ns"] <= evs[0]["ts_ns"]
+        assert (evs[1]["ts_ns"] + evs[1]["dur_ns"]
+                >= evs[0]["ts_ns"] + evs[0]["dur_ns"])
+
+    def test_ring_wraparound(self):
+        tr = SpanTracer(capacity=4, enabled=True)
+        for i in range(10):
+            tr.instant(f"e{i}")
+        assert len(tr) == 4
+        assert tr.dropped == 6
+        # oldest-first, wraparound-corrected: the last 4 recorded
+        assert [e["name"] for e in tr.events()] == ["e6", "e7", "e8", "e9"]
+        tr.clear()
+        assert len(tr) == 0 and tr.dropped == 0
+
+    def test_record_explicit_endpoints_and_args(self):
+        tr = SpanTracer(capacity=8, enabled=True)
+        tr.record("step", 1.5, 1.75, track="loop", sid=3)
+        (ev,) = tr.events()
+        assert ev["track"] == "loop"
+        assert ev["ts_ns"] == int(1.5e9)
+        assert ev["dur_ns"] == int(0.25e9)
+        assert ev["args"] == {"sid": 3}
+
+    def test_enable_disable_and_capacity_validation(self):
+        tr = SpanTracer(capacity=4)
+        assert not tr.enabled
+        tr.enable()
+        tr.instant("x")
+        tr.disable()
+        tr.instant("y")
+        assert [e["name"] for e in tr.events()] == ["x"]
+        with pytest.raises(ValueError, match="capacity"):
+            SpanTracer(capacity=0)
+
+    def test_disabled_overhead_smoke(self):
+        """Disabled-mode cost: 50k no-op span entries must be ~free (no
+        clock reads, no allocation) — generous bound for CI noise."""
+        tr = SpanTracer(capacity=8, enabled=False)
+        t0 = time.perf_counter()
+        for _ in range(50_000):
+            with tr.span("hot"):
+                pass
+        dt = time.perf_counter() - t0
+        assert len(tr) == 0
+        assert dt < 2.0, f"disabled tracer cost {dt:.3f}s for 50k spans"
+
+
+class TestChromeTrace:
+    def _tracer(self):
+        tr = SpanTracer(capacity=64, enabled=True)
+        tr.record("schedule", 0.001, 0.002, track="schedule", sid=1)
+        tr.record("dispatch", 0.002, 0.004, track="dispatch", sid=1)
+        tr.record("wait", 0.004, 0.005, track="wait", sid=1)
+        tr.instant("evict", track="schedule")
+        return tr
+
+    def test_chrome_trace_schema(self, tmp_path):
+        path = str(tmp_path / "trace.json")
+        assert self._tracer().export_chrome_trace(path) == path
+        doc = json.load(open(path))
+        assert doc["displayTimeUnit"] == "ms"
+        assert doc["otherData"]["dropped_spans"] == 0
+        evs = doc["traceEvents"]
+        assert isinstance(evs, list)
+        meta = [e for e in evs if e["ph"] == "M"]
+        names = {e["args"]["name"] for e in meta
+                 if e["name"] == "thread_name"}
+        assert names == {"schedule", "dispatch", "wait"}
+        assert any(e["name"] == "process_name" for e in meta)
+        # one tid per track, stable sort indices
+        sort_meta = [e for e in meta if e["name"] == "thread_sort_index"]
+        assert len(sort_meta) == 3
+        for e in evs:
+            if e["ph"] == "X":
+                assert isinstance(e["ts"], float)
+                assert isinstance(e["dur"], float) and e["dur"] >= 0
+                assert isinstance(e["tid"], int) and e["pid"] == 1
+            elif e["ph"] == "i":
+                assert e["s"] == "t" and "dur" not in e
+        # durations in microseconds
+        disp = next(e for e in evs if e.get("name") == "dispatch"
+                    and e["ph"] == "X")
+        assert abs(disp["dur"] - 2000.0) < 1e-6
+
+    def test_jsonl_export(self, tmp_path):
+        path = str(tmp_path / "spans.jsonl")
+        self._tracer().export_jsonl(path)
+        lines = [json.loads(ln) for ln in open(path)]
+        assert len(lines) == 4
+        assert lines[0]["name"] == "schedule"
+        assert lines[-1]["instant"] is True
+
+
+# --------------------------------------------------------------------------
+# metrics registry
+# --------------------------------------------------------------------------
+
+class TestMetricsRegistry:
+    def test_counter_gauge_basics(self):
+        reg = MetricsRegistry()
+        c = reg.counter("toks", int_valued=True)
+        c.inc(3)
+        c.inc()
+        assert c.value() == 4
+        assert reg.counter("toks") is c          # get-or-create identity
+        g = reg.gauge("depth")
+        g.set(2.5)
+        g.inc(0.5)
+        assert g.value() == 3.0
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("toks")
+
+    def test_labels(self):
+        reg = MetricsRegistry()
+        c = reg.counter("req")
+        c.inc(2, phase="prefill")
+        c.inc(1, phase="decode")
+        assert c.value(phase="prefill") == 2
+        assert c.value(phase="decode") == 1
+        assert len(list(c.series())) == 2
+
+    def test_histogram_math(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", (1.0, 10.0, 100.0))
+        for v in (0.5, 5.0, 5.0, 50.0, 500.0):
+            h.observe(v)
+        assert h.count() == 5
+        assert h.sum() == 560.5
+        assert h.mean() == pytest.approx(112.1)
+        bc = h.bucket_counts()
+        assert bc == {"1": 1, "10": 3, "100": 4, "+Inf": 5}
+        # quantiles: monotone in q, overflow clamps to the last edge
+        assert h.percentile(0.2) <= h.percentile(0.5) \
+            <= h.percentile(0.9) <= h.percentile(1.0) == 100.0
+        with pytest.raises(ValueError, match="sorted"):
+            reg.histogram("bad", (3.0, 1.0))
+
+    def test_snapshot_is_jsonable(self):
+        reg = MetricsRegistry()
+        reg.counter("steps", int_valued=True).inc(7)
+        reg.counter("labeled").inc(1, k="v")
+        reg.histogram("h", (1.0, 2.0)).observe(1.5)
+        snap = json.loads(json.dumps(reg.snapshot()))
+        assert snap["steps"] == 7
+        assert snap["h"]["count"] == 1
+        assert snap["labeled"] == {'{k="v"}': 1}
+
+    def test_prometheus_roundtrip(self):
+        reg = MetricsRegistry()
+        reg.counter("serving_steps_total", "steps", int_valued=True).inc(5)
+        reg.gauge("queue_depth").set(3)
+        reg.counter("hits").inc(2, cache="prefix")
+        h = reg.histogram("ttft_ms", (10.0, 100.0), "ttft")
+        h.observe(7.0)
+        h.observe(70.0)
+        h.observe(700.0)
+        text = reg.prometheus_text()
+        assert "# TYPE serving_steps_total counter" in text
+        assert "# HELP serving_steps_total steps" in text
+        parsed = parse_prometheus_text(text)
+        assert parsed["serving_steps_total"]["type"] == "counter"
+        assert parsed["serving_steps_total"]["samples"][
+            ("serving_steps_total", ())] == 5.0
+        assert parsed["hits"]["samples"][
+            ("hits", (("cache", "prefix"),))] == 2.0
+        hs = parsed["ttft_ms"]["samples"]
+        assert hs[("ttft_ms_count", ())] == 3.0
+        assert hs[("ttft_ms_sum", ())] == 777.0
+        assert hs[("ttft_ms_bucket", (("le", "10"),))] == 1.0
+        assert hs[("ttft_ms_bucket", (("le", "100"),))] == 2.0
+        assert hs[("ttft_ms_bucket", (("le", "+Inf"),))] == 3.0
+
+    def test_write_jsonl(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("c").inc()
+        path = str(tmp_path / "metrics.jsonl")
+        reg.write_jsonl(path, step=1)
+        reg.counter("c").inc()
+        reg.write_jsonl(path, step=2)
+        lines = [json.loads(ln) for ln in open(path)]
+        assert [ln["step"] for ln in lines] == [1, 2]
+        assert [ln["metrics"]["c"] for ln in lines] == [1, 2]
+        assert all("time" in ln for ln in lines)
+
+    def test_monitor_fanout(self):
+        """Registry values ride the monitor/ writer event shape
+        ((name, value, step) triples — monitor/monitor.py)."""
+        class StubMonitor:
+            events = []
+
+            def write_events(self, evs):
+                self.events.extend(evs)
+
+        reg = MetricsRegistry()
+        reg.counter("steps").inc(4)
+        reg.histogram("lat_ms", (1.0, 10.0)).observe(2.0)
+        mon = StubMonitor()
+        reg.publish(mon, step=9)
+        d = {name: (value, step) for name, value, step in mon.events}
+        assert d["steps"] == (4.0, 9)
+        assert d["lat_ms_count"] == (1.0, 9)
+        assert d["lat_ms_sum"] == (2.0, 9)
+        assert "lat_ms_p50" in d
+        reg.publish(None, step=10)               # no-op without a monitor
+
+    def test_reset_keeps_registrations(self):
+        reg = MetricsRegistry()
+        c = reg.counter("c")
+        h = reg.histogram("h", (1.0,))
+        c.inc(3)
+        h.observe(0.5)
+        reg.reset()
+        assert reg.counter("c") is c and c.value() == 0
+        assert h.count() == 0 and "h" in reg
+
+    def test_counter_dict_view(self):
+        reg = MetricsRegistry()
+        cs = {"a_ms": reg.counter("a_ms_total"),
+              "n": reg.counter("n_total", int_valued=True)}
+        tm = CounterDictView(cs)
+        tm["a_ms"] += 1.5
+        tm["n"] += 2
+        assert tm["a_ms"] == 1.5
+        assert tm["n"] == 2 and isinstance(tm["n"], int)
+        assert sorted(tm) == ["a_ms", "n"]
+        assert len(tm) == 2
+        assert dict(tm) == {"a_ms": 1.5, "n": 2}
+        tm["n"] = 0                              # reset-style assignment
+        assert reg.counter("n_total").value() == 0
+        with pytest.raises(TypeError):
+            del tm["n"]
+        with pytest.raises(KeyError):
+            tm["unknown"]
+        tm["a_ms"] += 1.0
+        tm.reset()
+        assert tm["a_ms"] == 0.0
+
+
+# --------------------------------------------------------------------------
+# request lifecycle units
+# --------------------------------------------------------------------------
+
+class TestRequestTracker:
+    def test_lifecycle_math(self):
+        reg = MetricsRegistry()
+        t = RequestTracker(reg)
+        t.on_arrival(7, now=100.0)
+        t.on_admitted(7, prompt_tokens=10, cached_tokens=4, now=100.5)
+        t.on_prefill_start(7, 100.6)
+        t.on_tokens(7, 1, 101.0)
+        t.on_tokens(7, 1, 101.2)
+        t.on_tokens(7, 1, 101.4)
+        t.on_finish(7, now=101.5)
+        (rec,) = t.records()
+        assert rec.queue_wait_ms == pytest.approx(500.0)
+        assert rec.ttft_ms == pytest.approx(1000.0)
+        assert rec.tpot_ms == pytest.approx(200.0)   # (101.4-101.0)/2
+        assert rec.e2e_ms == pytest.approx(1500.0)
+        assert (rec.prompt_tokens, rec.cached_tokens,
+                rec.generated_tokens) == (10, 4, 3)
+        d = rec.as_dict()
+        assert d["finished"] is True and d["uid"] == 7
+        agg = t.aggregate()
+        assert agg["requests"] == 1 and agg["finished"] == 1
+        assert agg["ttft_ms"]["count"] == 1
+        assert agg["tpot_ms"]["count"] == 1
+        assert agg["queue_wait_ms"]["count"] == 1
+
+    def test_single_token_request_has_no_tpot(self):
+        t = RequestTracker(MetricsRegistry())
+        t.on_arrival(1, now=0.0)
+        t.on_admitted(1, 3, 0, now=0.1)
+        t.on_tokens(1, 1, 0.2)
+        t.on_finish(1, now=0.3)
+        (rec,) = t.records()
+        assert rec.tpot_ms is None               # no decode tail
+        assert t.aggregate()["tpot_ms"]["count"] == 0
+
+    def test_burst_emission_anchors_decode_tail(self):
+        """An n>1 burst lands all tokens at one readback instant; the
+        decode tail anchors at the burst's dispatch time so TPOT
+        doesn't collapse to zero, while TTFT stays at readback (the
+        host can't see the tokens earlier)."""
+        t = RequestTracker(MetricsRegistry())
+        t.on_arrival(1, now=0.0)
+        t.on_admitted(1, 2, 0, now=0.1)
+        t.on_tokens(1, 4, 1.0, t_dispatch=0.2)   # one 4-token burst
+        t.on_finish(1, now=1.1)
+        (rec,) = t.records()
+        assert rec.ttft_ms == pytest.approx(1000.0)
+        assert rec.tpot_ms == pytest.approx((1.0 - 0.2) * 1e3 / 3)
+        # stepwise records are unaffected: tail anchor == first token
+        t.on_arrival(2, now=0.0)
+        t.on_tokens(2, 1, 1.0)
+        t.on_tokens(2, 1, 1.5)
+        t.on_finish(2, now=1.6)
+        rec2 = t.records()[-1]
+        assert rec2.tpot_ms == pytest.approx(500.0)
+
+    def test_continuation_arrival_is_noop(self):
+        t = RequestTracker(MetricsRegistry())
+        r1 = t.on_arrival(1, now=0.0)
+        r2 = t.on_arrival(1, now=5.0)
+        assert r1 is r2 and r1.t_arrival == 0.0
+        assert t.aggregate()["requests"] == 1
+
+    def test_finished_ring_is_bounded(self):
+        t = RequestTracker(MetricsRegistry(), max_finished=2)
+        for uid in range(4):
+            t.on_arrival(uid, now=float(uid))
+            t.on_finish(uid, now=float(uid) + 1)
+        assert [r.uid for r in t.records()] == [2, 3]
+        assert t.aggregate()["finished"] == 4    # counter keeps the total
+
+
+# --------------------------------------------------------------------------
+# engine integration: accounting parity + trace export + back-compat
+# --------------------------------------------------------------------------
+
+def _assert_parity(eng):
+    """Sum of per-request token counts == engine counters, exactly."""
+    recs = eng.request_metrics()["requests"]
+    tm = eng.timings
+    assert sum(r["prompt_tokens"] for r in recs) == tm["prompt_tokens"]
+    assert sum(r["cached_tokens"] for r in recs) == tm["cached_tokens"]
+    assert sum(r["generated_tokens"] for r in recs) \
+        == tm["generated_tokens"]
+
+
+class TestEngineTelemetry:
+    MIXED = {0: list(range(1, 51)), 1: [3, 1, 4], 2: list(range(60, 80))}
+
+    @pytest.mark.parametrize("depth", [1, 2])
+    def test_parity_mixed_chunked_traffic(self, model, depth):
+        """Prompts straddling the token budget (chunked prefill + decode
+        mixed steps) at both pipeline depths."""
+        eng = make_engine(model, pipeline_depth=depth, token_budget=16)
+        sp = SamplingParams(max_new_tokens=6)
+        out = eng.generate({u: list(p) for u, p in self.MIXED.items()}, sp)
+        _assert_parity(eng)
+        tm = eng.timings
+        assert tm["prompt_tokens"] == sum(len(p) for p in
+                                          self.MIXED.values())
+        assert tm["generated_tokens"] >= sum(len(v) for v in out.values())
+        agg = eng.request_metrics()["aggregate"]
+        assert agg["requests"] == agg["finished"] == len(self.MIXED)
+        assert agg["open"] == 0
+        # every finished record carries the full latency story
+        for r in eng.request_metrics()["requests"]:
+            assert r["finished"]
+            assert r["queue_wait_ms"] is not None \
+                and r["queue_wait_ms"] >= 0
+            assert r["ttft_ms"] is not None and r["ttft_ms"] >= 0
+            assert r["tpot_ms"] is not None and r["tpot_ms"] >= 0
+            assert r["e2e_ms"] >= r["ttft_ms"]
+            assert r["generated_tokens"] == len(out[r["uid"]])
+
+    @pytest.mark.parametrize("mode", ["off", "on"])
+    def test_parity_prefix_cache(self, model, mode):
+        """Shared-prefix traffic arriving sequentially: the cache-on
+        engine serves prompt tokens from the cache; per-request
+        cached_tokens reconcile with the hit counters either way."""
+        shared = list(range(1, 33))              # two full 16-tok blocks
+        prompts = {u: shared + [100 + u, 101 + u, 102 + u]
+                   for u in range(3)}
+        eng = make_engine(model, prefix_cache=mode)
+        sp = SamplingParams(max_new_tokens=2)
+        for u, p in prompts.items():             # sequential: later
+            eng.generate({u: list(p)}, sp)       # requests can hit
+        _assert_parity(eng)
+        tm = eng.timings
+        if mode == "on":
+            assert tm["cached_tokens"] > 0 and tm["prefix_hits"] >= 2
+        else:
+            assert tm["cached_tokens"] == 0 == tm["prefix_hits"]
+        assert eng.request_metrics()["aggregate"]["finished"] == 3
+
+    def test_parity_decode_burst(self, model):
+        """The burst path (device-side multi-token decode) bumps the
+        same counters as the stepwise collect."""
+        eng = make_engine(model, decode_burst=4)
+        sp = SamplingParams(max_new_tokens=8)
+        out = eng.generate({0: [5, 17, 99], 1: [7, 7, 1, 2]}, sp)
+        assert all(len(v) == 8 for v in out.values())
+        _assert_parity(eng)
+        assert eng.timings["generated_tokens"] \
+            >= sum(len(v) for v in out.values())
+
+    def test_trace_export_has_serving_span_types(self, model, tmp_path):
+        """A pipelined generate() with tracing on exports a valid Chrome
+        trace carrying >= 4 distinct serving-loop span types, one track
+        each (the acceptance-criteria artifact)."""
+        eng = make_engine(model, pipeline_depth=2, trace=True)
+        eng.generate({0: list(range(1, 40)), 1: [9, 8, 7]},
+                     SamplingParams(max_new_tokens=5))
+        path = str(tmp_path / "serving_trace.json")
+        eng.tracer.export_chrome_trace(path)
+        doc = json.load(open(path))
+        spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        names = {e["name"] for e in spans}
+        assert {"schedule", "stage", "dispatch", "wait",
+                "readback"} <= names
+        tracks = {e["args"]["name"] for e in doc["traceEvents"]
+                  if e.get("ph") == "M" and e["name"] == "thread_name"}
+        assert len(tracks) >= 4
+        # spans carry their dispatch sequence id for cross-track joins
+        assert any("sid" in e.get("args", {}) for e in spans)
+
+    def test_trace_disabled_by_default(self, model):
+        eng = make_engine(model)
+        eng.generate({0: [1, 2, 3]}, SamplingParams(max_new_tokens=3))
+        assert not eng.tracer.enabled and len(eng.tracer) == 0
+
+    def test_timings_backcompat_and_resets(self, model):
+        """engine.timings stays a dict-shaped accumulator (bench.py and
+        older tests read/reset it) while the same numbers live in the
+        registry."""
+        eng = make_engine(model)
+        eng.generate({0: [1, 2, 3, 4]}, SamplingParams(max_new_tokens=4))
+        tm = eng.timings
+        assert set(tm) == {"schedule_ms", "stage_ms", "device_ms",
+                           "wait_ms", "readback_ms", "steps",
+                           "prompt_tokens", "cached_tokens",
+                           "prefix_hits", "generated_tokens"}
+        assert tm["steps"] > 0 and isinstance(tm["steps"], int)
+        assert dict(tm)["steps"] == tm["steps"]
+        # the registry sees the same number
+        assert eng.metrics.get("serving_steps_total").value() \
+            == tm["steps"]
+        eng.reset_timings()
+        assert tm["steps"] == 0 and tm["schedule_ms"] == 0.0
+        # reset_timings does NOT clear request records ...
+        assert eng.request_metrics()["aggregate"]["finished"] == 1
+        # ... reset_metrics clears everything
+        eng.generate({1: [1, 2]}, SamplingParams(max_new_tokens=2))
+        eng.reset_metrics()
+        assert eng.timings["steps"] == 0
+        assert eng.request_metrics()["requests"] == []
+        assert len(eng.tracer) == 0
+        assert eng.request_metrics()["aggregate"]["ttft_ms"]["count"] == 0
+
+    def test_prometheus_and_snapshot_from_engine(self, model):
+        eng = make_engine(model)
+        eng.generate({0: [1, 2, 3, 4, 5]}, SamplingParams(max_new_tokens=4))
+        snap = json.loads(json.dumps(eng.metrics_snapshot()))
+        assert snap["serving_steps_total"] == eng.timings["steps"]
+        assert snap["serving_ttft_ms"]["count"] == 1
+        parsed = parse_prometheus_text(eng.metrics.prometheus_text())
+        assert parsed["serving_steps_total"]["samples"][
+            ("serving_steps_total", ())] == float(eng.timings["steps"])
+        assert parsed["serving_ttft_ms"]["samples"][
+            ("serving_ttft_ms_count", ())] == 1.0
+
+    def test_engine_monitor_fanout(self, model):
+        class StubMonitor:
+            def __init__(self):
+                self.events = []
+
+            def write_events(self, evs):
+                self.events.extend(evs)
+
+        eng = make_engine(model)
+        eng.generate({0: [1, 2, 3]}, SamplingParams(max_new_tokens=3))
+        mon = StubMonitor()
+        eng.publish_metrics(mon, step=1)
+        names = {n for n, _, _ in mon.events}
+        assert "serving_steps_total" in names
+        assert "serving_ttft_ms_count" in names
+
+
+# --------------------------------------------------------------------------
+# training-engine telemetry
+# --------------------------------------------------------------------------
+
+class TestTrainingTelemetry:
+    def _engine(self, monitor=None, **telemetry):
+        import deepspeed_tpu as ds
+
+        m = build_model("gpt2", max_seq_len=32, num_layers=2, d_model=32,
+                        num_heads=2, vocab_size=64)
+        return ds.initialize(model=m, config={
+            "train_micro_batch_size_per_device": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "mesh": {"data": -1},
+            "steps_per_print": 1,
+            "telemetry": telemetry,
+        }, monitor=monitor), m
+
+    def _batch(self, eng):
+        from deepspeed_tpu.runtime.dataloader import (DataLoader,
+                                                      synthetic_lm_data)
+
+        data = synthetic_lm_data(64, eng.train_batch_size * 4, 32)
+        return next(iter(DataLoader(data, eng.train_batch_size)))
+
+    def test_step_phases_and_trace(self):
+        eng, _ = self._engine(trace=True)
+        for _ in range(2):
+            eng.train_batch(self._batch(eng))
+        snap = eng.metrics_snapshot()
+        assert snap["train_steps_total"] == 2
+        assert snap["train_step_host_ms"]["count"] == 2
+        for k in ("train_pre_step_ms_total", "train_stage_ms_total",
+                  "train_dispatch_ms_total"):
+            assert snap[k] >= 0.0
+        names = {e["name"] for e in eng.tracer.events()}
+        assert {"pre_step", "stage", "dispatch", "fetch"} <= names
+
+    def test_registry_rides_monitor_pipeline(self):
+        class StubMonitor:
+            enabled = True
+
+            def __init__(self):
+                self.events = []
+
+            def write_events(self, evs):
+                self.events.extend(evs)
+
+            def write_scalars(self, step, scalars):
+                self.write_events([(k, float(v), step)
+                                   for k, v in scalars.items()])
+
+        mon = StubMonitor()
+        eng, _ = self._engine(monitor=mon)
+        eng.train_batch(self._batch(eng))
+        names = {n for n, _, _ in mon.events}
+        # loss scalars AND registry metrics through ONE writer
+        assert "Train/loss" in names
+        assert "train_steps_total" in names
+        assert "train_step_host_ms_count" in names

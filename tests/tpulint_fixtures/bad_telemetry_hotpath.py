@@ -1,0 +1,34 @@
+"""Known-bad: wall-clock reads and telemetry calls on the hot paths
+(tpulint: telemetry-hotpath)."""
+import time
+
+import jax
+
+tracer = object()
+metrics = object()
+
+
+class Engine:
+    def step(self):  # tpulint: serving-loop
+        t0 = time.time()                    # BAD: non-monotonic wall clock
+        self._run()
+        return time.time() - t0             # BAD: same, on the hot path
+
+    def _run(self):
+        return 0
+
+
+@jax.jit
+def traced_step(x):
+    with tracer.span("fwd"):                # BAD: telemetry inside jit
+        y = x * 2
+    tracer.record("fwd", 0.0, 1.0)          # BAD: baked into the trace
+    return y
+
+
+def helper(x):
+    metrics.inc("tokens", 1)                # BAD: jit-reachable via below
+    return x
+
+
+helper_jit = jax.jit(helper)

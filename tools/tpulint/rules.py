@@ -666,3 +666,55 @@ def check_donated_reuse(ctx: FileContext) -> Iterator[Finding]:
                         "used again here — the buffer is invalid after "
                         "donation")
                     break
+
+
+# --------------------------------------------------------------------------
+# rule: telemetry-hotpath — telemetry must never slow (or break) the
+# paths it measures
+# --------------------------------------------------------------------------
+
+# receiver segments that identify a telemetry object (engine.tracer /
+# engine.metrics and the module-level spellings docs/OBSERVABILITY.md
+# prescribes); matched as whole dotted-name segments, so a name like
+# `geometrics` never trips it
+_TELEMETRY_SEGMENTS = {"tracer", "metrics", "telemetry"}
+
+
+@rule("telemetry-hotpath",
+      "time.time() inside a '# tpulint: serving-loop' marked method "
+      "(telemetry clocks are monotonic perf_counter only — wall clocks "
+      "step under NTP), or a tracer/metrics call inside a jit-traced "
+      "function (host telemetry state referenced during tracing is baked "
+      "into the compiled program at best, a tracer error at worst)")
+def check_telemetry_hotpath(ctx: FileContext) -> Iterator[Finding]:
+    marked = _serving_marked_lines(ctx)
+    if marked:
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            header = range(fn.lineno, fn.body[0].lineno + 1)
+            if not any(ln in marked for ln in header):
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and dotted(node.func) == "time.time":
+                    yield Finding(
+                        "telemetry-hotpath", ctx.path, node.lineno,
+                        node.col_offset,
+                        "time.time() in a serving-loop method — the "
+                        "wall clock is non-monotonic (NTP steps corrupt "
+                        "span/latency math); use time.perf_counter()")
+    if "jit" not in ctx.source:
+        return
+    for fn in _traced_functions(ctx.tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func) or ""
+            if set(d.split(".")) & _TELEMETRY_SEGMENTS:
+                yield Finding(
+                    "telemetry-hotpath", ctx.path, node.lineno,
+                    node.col_offset,
+                    f"{d}() inside a jit-traced function — telemetry is "
+                    "host-side only; record around the dispatch, never "
+                    "inside the trace")
