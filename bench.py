@@ -232,6 +232,7 @@ def main(trace_path=None):
     serve = leg(serving_bench, on_tpu)
     pipe = leg(pipeline_serving_bench, on_tpu, trace_path)
     prefix = leg(shared_prefix_serving_bench, on_tpu)
+    overload = leg(overload_serving_bench, on_tpu)
     llama_train = leg(llama_train_bench, on_tpu, peak)
     llama_serve = leg(llama8b_serving_bench, on_tpu)
     moe = leg(moe_train_bench, on_tpu, peak)
@@ -246,8 +247,8 @@ def main(trace_path=None):
         "train_metrics": train_metrics,
     }
     out.update(serve)
-    print(json.dumps({**out, **pipe, **prefix, **llama_train,  # tpulint: disable=print — the bench's one JSON output line
-                      **llama_serve, **moe}))
+    print(json.dumps({**out, **pipe, **prefix, **overload,  # tpulint: disable=print — the bench's one JSON output line
+                      **llama_train, **llama_serve, **moe}))
 
 
 def moe_train_bench(on_tpu: bool, peak: float):
@@ -832,6 +833,31 @@ def shared_prefix_serving_bench(on_tpu: bool):
         out["shared_prefix_prefill_tok_s_on"]
         / max(out["shared_prefix_prefill_tok_s_off"], 1e-9), 2)
     return out
+
+
+def overload_serving_bench(on_tpu: bool):
+    """Overload-policy leg (docs/SERVING.md "Surviving overload"): the
+    loadgen harness replays a seeded bursty trace at offered rates
+    below and beyond capacity — with faults injected — and the SLO
+    summaries (terminal-status mix, preemptions, TTFT/TPOT percentiles,
+    deterministic step-indexed queue delays) land in the BENCH JSON as
+    TTFT/TPOT-vs-load curves.  Every leg re-asserts token parity and
+    the allocator partition; the replay raises rather than hangs if the
+    engine wedges, so a scheduling regression fails the bench loudly."""
+    from tools.loadgen import run_sweep
+
+    qps = (2.0, 8.0, 32.0)
+    sweep = run_sweep(qps, n_requests=24 if on_tpu else 16,
+                      arrival="bursty", seed=0,
+                      shed_policy="evict-lowest")
+    curve = {str(q): {k: leg[k] for k in
+                      ("statuses", "preemptions", "steps",
+                       "ttft_ms_p50", "ttft_ms_p95",
+                       "tpot_ms_p50", "tpot_ms_p95",
+                       "ttft_steps_p95", "ttft_steps_hi_p95")}
+             for q, leg in ((q, sweep["legs"][str(q)]) for q in qps)}
+    return {"overload_slo_curve": curve,
+            "overload_qps_axis": list(qps)}
 
 
 def serving_bench(on_tpu: bool):

@@ -1,4 +1,5 @@
 from .engine import InferenceConfig, InferenceEngine
+from .overload import AdmissionVerdict, OverloadConfig
 from .sampler import SamplingParams, sample
 from .ragged.state import (BatchStager, FEEDBACK_TOKEN, KVCacheConfig,
                            StateManager, RaggedBatch)
@@ -6,5 +7,6 @@ from .ragged.allocator import BlockedAllocator
 from .weight_stream import NVMeWeightStore
 
 __all__ = ["InferenceConfig", "InferenceEngine", "SamplingParams", "sample",
+           "OverloadConfig", "AdmissionVerdict",
            "KVCacheConfig", "StateManager", "RaggedBatch", "BatchStager",
            "FEEDBACK_TOKEN", "BlockedAllocator", "NVMeWeightStore"]

@@ -37,6 +37,9 @@ from ..telemetry import (CounterDictView, MetricsRegistry, RequestTracker,
                          SpanTracer)
 from ..utils.logging import logger
 from .model import pipelined_ragged_step, ragged_forward
+from .overload import (AdmissionVerdict, OverloadConfig, RequestMeta,
+                       admission_decision, effective_priority,
+                       select_victim)
 from .ragged.state import (FEEDBACK_TOKEN, BatchStager, KVCacheConfig,
                            RaggedBatch, StateManager)
 from .sampler import SamplingParams, sample_rows
@@ -134,6 +137,15 @@ class InferenceConfig:
     # host-side counter bumps that never touch device arrays.
     trace: bool = False
     trace_capacity: int = 1 << 16   # spans retained (ring wraps beyond)
+    # overload policy (inference/overload.py, docs/SERVING.md "Surviving
+    # overload"): bounded admission queue + shed policy, priority /
+    # deadline-aware scheduling with anti-starvation aging,
+    # preemption-by-eviction when the block pool or slot table starves a
+    # higher tier, and per-step chunked-prefill budget caps.  None uses
+    # OverloadConfig() defaults, which reproduce the legacy cooperative
+    # behavior exactly (unbounded queue, no chunk cap, preemption inert
+    # while every request shares one priority tier).
+    overload: Optional[OverloadConfig] = None
 
 
 # attn-impl probe results, memoized per (backend, shape signature)
@@ -149,6 +161,10 @@ class _InFlight(NamedTuple):
     toks: jax.Array
     emit: Tuple[Tuple[int, int], ...]
     sid: int
+    # every uid the step scheduled tokens for (emitting or not): a
+    # sequence with an uncollected scheduled step is never a preemption
+    # victim — its KV blocks are still being written
+    uids: Tuple[int, ...] = ()
 
 
 class InferenceEngine:
@@ -244,7 +260,20 @@ class InferenceEngine:
         self._dispatch_seq = 0
         self._fb_step: Dict[int, int] = {}   # uid -> sid its marker defers to
         self._zero_key = jax.random.PRNGKey(0)
+        # --- overload policy state (inference/overload.py) -------------
+        self.ocfg = self.icfg.overload or OverloadConfig()
+        self._meta: Dict[int, RequestMeta] = {}   # uid -> admission meta
+        self._deadline_uids: set = set()          # uids with a deadline
+        self._inflight_sched: Dict[int, int] = {} # uid -> uncollected steps
+        self._preempting: set = set()             # release() = preemption
+        self._preempt_gen: Dict[int, List[int]] = {}  # pre-eviction tokens
+        self._closing: Dict[int, str] = {}   # uid -> staged terminal status
+        self._reaped: set = set()   # engine-closed uids drivers must drop
         self._setup_telemetry()
+        # every KV release — flush, preemption, deadline expiry, or a
+        # direct StateManager.release — flows through one close-out hook
+        # so request_metrics() can never leak an open record
+        self.state.on_release = self._on_state_release
 
     def _setup_telemetry(self) -> None:
         """Build the metrics registry, the span tracer, and the
@@ -826,26 +855,160 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     # request API (reference: engine_v2.put :107)
     # ------------------------------------------------------------------
-    def put(self, uid: int, tokens: Sequence[int]) -> None:
-        # lifecycle arrival: the first put for a uid with no open record
-        # opens one (continuation puts are an O(1) no-op inside)
-        self.requests.on_arrival(uid)
-        self._pending.setdefault(uid, []).extend(int(t) for t in tokens)
+    def put(self, uid: int, tokens: Sequence[int], priority: int = 0,
+            deadline_ms: Optional[float] = None) -> AdmissionVerdict:
+        """Enqueue a new request or continue a known one; returns an
+        :class:`AdmissionVerdict` (truthy iff the tokens entered the
+        engine) instead of growing the backlog unboundedly.
+
+        ``priority``: lower = more important (nice-level semantics;
+        default 0).  ``deadline_ms``: relative to arrival — a request
+        still unfinished when it elapses is terminally closed with
+        status ``deadline_exceeded``.  Both only matter on the FIRST
+        put for a uid; continuations keep the admitted values and are
+        never shed (the request already holds KV or a queue place).
+        With the default :class:`OverloadConfig` (unbounded queue) the
+        verdict is always truthy — legacy callers that ignore the
+        return value see the legacy behavior."""
+        now = time.perf_counter()
+        toks = [int(t) for t in tokens]
+        if uid in self._meta or uid in self.state.seqs \
+                or uid in self._pending:
+            self.requests.on_arrival(uid, now)
+            self._pending.setdefault(uid, []).extend(toks)
+            return AdmissionVerdict(True, "continued")
+        ocfg = self.ocfg
+        queued: List[tuple] = []
+        if ocfg.max_queued_requests is not None \
+                or ocfg.max_queued_tokens is not None:
+            # requests still waiting for their FIRST admission (a live
+            # sequence is not queued — it is never shed here)
+            for quid, qt in self._pending.items():
+                if not qt or quid in self.state.seqs:
+                    continue
+                m = self._meta.get(quid)
+                queued.append((
+                    quid,
+                    effective_priority(m.priority if m else 0,
+                                       m.t_arrival if m else now,
+                                       now, ocfg.aging_ms),
+                    len(qt)))
+        action, victims = admission_decision(ocfg, priority, len(toks),
+                                             queued, now)
+        if action == "shed":
+            # terminal from birth: the record exists (the load harness
+            # counts shed vs finished) but never holds KV or budget
+            self.requests.on_arrival(uid, now)
+            self.requests.on_finish(uid, now, status="shed")
+            return AdmissionVerdict(False, "shed",
+                                    reason="admission queue bound")
+        for victim in victims:
+            self._finish(victim, "shed")
+            self._reaped.add(victim)
+        if action == "degrade":
+            priority = max(priority, ocfg.degrade_priority)
+        self._meta[uid] = RequestMeta(priority=priority,
+                                      deadline_ms=deadline_ms,
+                                      t_arrival=now,
+                                      degraded=(action == "degrade"))
+        if deadline_ms is not None:
+            self._deadline_uids.add(uid)
+        self.requests.on_arrival(uid, now)
+        self._pending.setdefault(uid, []).extend(toks)
+        return AdmissionVerdict(
+            True, "degraded" if action == "degrade" else "queued",
+            evicted_uids=victims)
 
     def flush(self, uid: int) -> None:
         """(reference: engine_v2.flush :242)."""
-        self.requests.on_finish(uid)
+        self._finish(uid, "finished")
+
+    def cancel(self, uid: int) -> None:
+        """Client abort: terminally close ``uid`` wherever it is —
+        queued (drops its backlog entry), running (KV released back
+        through the refcounted allocator), or already gone (no-op).
+        Safe mid-flight: an uncollected step's emit for a cancelled uid
+        is discarded by the slot guard in ``_collect``, and its stale KV
+        writes land in rows no surviving sequence reads."""
+        self._finish(uid, "cancelled")
+        self._reaped.add(uid)
+
+    def _finish(self, uid: int, status: str) -> None:
+        """Terminally close a request through whichever exit applies: a
+        live sequence releases its KV (the ``on_release`` hook below
+        does the bookkeeping), a queued-only request just drops its
+        backlog entry.  Idempotent — closing an already-closed or
+        unknown uid is a no-op."""
+        if uid in self.state.seqs:
+            self._closing[uid] = status
+            try:
+                self.state.release(uid)   # -> _on_state_release
+            finally:
+                self._closing.pop(uid, None)
+            return
+        self._forget(uid, status)
+
+    def _forget(self, uid: int, status: str) -> None:
+        """Drop every per-request bookkeeping entry and close the
+        lifecycle record terminally — the ONE teardown both exit shapes
+        (queued-only close, KV-release close) share; add any future
+        per-request state here and it is cleaned on every path."""
         self._pending.pop(uid, None)
         self._fb_step.pop(uid, None)
-        self.state.release(uid)
+        self._meta.pop(uid, None)
+        self._deadline_uids.discard(uid)
+        self._preempt_gen.pop(uid, None)
+        self._ctx_exhausted.discard(uid)
+        self.requests.on_finish(uid, status=status)
+
+    def _on_state_release(self, uid: int) -> None:
+        """``StateManager.on_release`` hook: a sequence's KV was just
+        freed.  Preemption is the one non-terminal release (the request
+        re-queues and its record stays open); every other path closes
+        the lifecycle record — ``flush`` ("finished"), engine close-outs
+        (the status staged in ``_closing``: deadline expiry, cancel,
+        context exhaustion), or a direct ``StateManager.release`` from
+        outside the engine ("released").  This is what makes
+        ``request_metrics()`` leak-free: there is no way to drop KV
+        without a terminal lifecycle event."""
+        if uid in self._preempting:
+            return
+        self._forget(uid, self._closing.get(uid, "released"))
+
+    def _drain_reaped(self) -> set:
+        """Uids the ENGINE terminally closed since the last call
+        (deadline expiry, ``cancel()``, shed-by-eviction) — the
+        ``generate()`` drivers drop them from their active sets;
+        direct-API callers can poll ``query()["status"]`` instead."""
+        out = self._reaped
+        self._reaped = set()
+        return out
 
     def query(self, uid: int) -> Dict:
-        """(reference: engine_v2.query :158)."""
+        """(reference: engine_v2.query :158).  ``status`` is ``queued``
+        (admitted, waiting for KV — including preempted-and-requeued),
+        ``running`` (holds KV), a terminal status (``finished`` /
+        ``shed`` / ``cancelled`` / ``deadline_exceeded`` /
+        ``context_exhausted`` / ``released``), or ``unknown`` for a uid
+        the engine never saw (or one whose record aged out of the
+        finished ring) — so load-harness clients can tell shed from
+        done instead of reading silent zeros."""
         seq = self.state.seqs.get(uid)
+        if seq is not None:
+            status = "running"
+        elif self._pending.get(uid) or uid in self._meta:
+            status = "queued"
+        else:
+            s = self.requests.status_of(uid)
+            status = "queued" if s == "open" else (s or "unknown")
+        gen = self._preempt_gen.get(uid, [])
         return {
+            "status": status,
             "pending_tokens": len(self._pending.get(uid, [])),
             "seen_tokens": seq.seen_tokens if seq else 0,
-            "generated": list(seq.tokens) if seq else [],
+            # across preemptions: tokens generated before each eviction
+            # are stashed so the full output survives the re-prefill
+            "generated": list(gen) + (list(seq.tokens) if seq else []),
             "max_context": self.max_blocks_per_seq * self.icfg.kv_block_size,
             # prompt tokens this sequence got from the prefix cache
             # (prefill started at the first uncached token)
@@ -854,37 +1017,58 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------
     def _schedule(self) -> List[tuple]:  # tpulint: serving-loop
-        """Dynamic SplitFuse: pack the fixed token budget — decode tokens
-        first (latency), then prompt chunks (throughput) — while
-        *reserving* KV blocks and slots as requests are admitted so the
-        collective admission can never exceed the pool
-        (reference: can_schedule engine_v2.py:184 + SchedulingResult).
+        """Dynamic SplitFuse + overload policy: pack the fixed token
+        budget — decode tokens first (latency), then prompt chunks
+        (throughput) — while *reserving* KV blocks and slots as requests
+        are admitted so the collective admission can never exceed the
+        pool (reference: can_schedule engine_v2.py:184 +
+        SchedulingResult).
 
         New prompts first consult the prefix cache: the longest cached
         block-aligned prefix is aliased into the sequence's table and
         those tokens never enter the budget — prefill starts at the
         first uncached token.  Blocks/slots are tracked as *reservations*
-        against the live allocator (matching mutates it mid-round)."""
+        against the live allocator (matching mutates it mid-round).
+
+        Overload policy (docs/SERVING.md "Surviving overload"): expired
+        deadlines are reaped first; candidates are ordered by *aged*
+        effective priority within each class (decode before prefill —
+        TPOT never queues behind prompt work); each prefill takes at
+        most ``prefill_chunk`` tokens per step so a long prompt
+        interleaves instead of head-of-line-blocking; and when the pool
+        or slot table starves a candidate, a strictly-lower-priority
+        running victim is preempted-by-eviction (``_preempt``) to make
+        room.  With the default config every knob is inert and this is
+        exactly the legacy FIFO SplitFuse packer."""
         budget = self.icfg.token_budget
         bs = self.icfg.kv_block_size
+        ocfg = self.ocfg
+        now = time.perf_counter()
+        self._reap_deadlines(now)
         # blocks/slots promised to earlier admits this round but only
         # allocated for real in build_batch
         reserved_blocks = 0
         reserved_slots = 0
         prefix_on = self.state.prefix_cache
         sched: List[tuple] = []
+        sched_uids: set = set()
+        preempts_left = (ocfg.max_preemptions_per_step
+                         if ocfg.preemption else 0)
 
-        def admit(uid, toks):
+        def admit(uid, toks) -> str:
+            """"ok" (tokens or a cache match landed), "starved" (the
+            block pool or slot table blocked it — a preemption could
+            help), or "skip" (nothing a preemption can fix)."""
             nonlocal budget, reserved_blocks, reserved_slots
             seq = self.state.seqs.get(uid)
             ctx_rem = self.state.context_remaining(uid)
             if ctx_rem <= 0:
                 self._ctx_exhausted.add(uid)
-                return
+                return "skip"
             needs_slot = uid not in self.state._slots
             if needs_slot and \
                     len(self.state._free_slots) - reserved_slots <= 0:
-                return
+                return "starved"
             new_prompt = seq is None
             prompt_len = len(toks) if new_prompt else 0
             cached = 0
@@ -904,6 +1088,11 @@ class InferenceEngine:
                     needs_slot = False     # match_prefix claimed the slot
                     ctx_rem = self.state.context_remaining(uid)
             n = min(len(toks), budget, ctx_rem)
+            if len(toks) > 1 and ocfg.prefill_chunk is not None:
+                # chunked prefill: a prompt takes at most one chunk of
+                # this step's budget; the remainder waits its turn while
+                # other prefills (and every decode) share the step
+                n = min(n, ocfg.prefill_chunk)
             avail = self.state.allocator.free_blocks - reserved_blocks
             need = 0
             while n > 0:
@@ -914,7 +1103,7 @@ class InferenceEngine:
                     break
                 n //= 2
             if n <= 0 and not cached:
-                return
+                return "starved"
             tm = self.timings
             tm["prompt_tokens"] += prompt_len
             if cached:
@@ -929,13 +1118,15 @@ class InferenceEngine:
             if n <= 0:
                 # matched but the pool can't take the uncached remainder
                 # yet: the sequence keeps its aliased blocks and waits
-                return
+                return "ok"
             sched.append((uid, toks[:n]))
+            sched_uids.add(uid)
             del toks[:n]
             budget -= n
             reserved_blocks += need
             if needs_slot:
                 reserved_slots += 1
+            return "ok"
 
         # decode requests (continuing sequences, single token) first,
         # then prompt chunks — one O(n) pass keyed on the entry itself
@@ -943,6 +1134,7 @@ class InferenceEngine:
         # every pending request: O(n^2) tuple compares under load)
         decodes: List[tuple] = []
         prefills: List[tuple] = []
+        effs: Dict[int, float] = {}
         for uid, t in self._pending.items():
             if not t:
                 continue
@@ -954,13 +1146,133 @@ class InferenceEngine:
                 # only sees the last dispatch's sample array, so hold the
                 # request until its owner's collect patches it concrete
                 continue
+            m = self._meta.get(uid)
+            # aged priority: waiting promotes a tier per aging_ms, so a
+            # low tier is delayed under load but never starved.  Equal
+            # tiers keep FIFO order (aging is monotonic in arrival; the
+            # sort is stable for putless direct-API entries)
+            effs[uid] = effective_priority(
+                m.priority if m else 0, m.t_arrival if m else now,
+                now, ocfg.aging_ms) if m is not None else 0.0
             (decodes if len(t) == 1 and uid in self.state.seqs
              else prefills).append((uid, t))
+        decodes.sort(key=lambda e: effs[e[0]])
+        prefills.sort(key=lambda e: effs[e[0]])
         for uid, toks in decodes + prefills:
             if budget <= 0:
                 break
-            admit(uid, toks)
+            if self._pending.get(uid) is not toks:
+                # a mid-round preemption rebound this uid's pending list
+                # (the requeued chain replaced it): the stale entry here
+                # holds mid-stream tokens that must NOT be admitted as a
+                # fresh prompt at position 0 — the requeue waits its turn
+                # next round
+                continue
+            verdict = admit(uid, toks)
+            while verdict == "starved" and preempts_left > 0:
+                # preemption compares RAW tiers (not aged): two equal
+                # requests must never evict each other back and forth,
+                # so at one shared tier preemption is provably inert
+                m = self._meta.get(uid)
+                victim = select_victim(
+                    self._victim_candidates(sched_uids | {uid}),
+                    better_than=m.priority if m else 0)
+                if victim is None:
+                    break
+                self._preempt(victim)
+                preempts_left -= 1
+                verdict = admit(uid, toks)
         return sched
+
+    def _victim_candidates(self, exclude: set) -> List[tuple]:
+        """``(uid, raw_priority, n_blocks)`` for every live sequence
+        preemption may legally evict: nothing scheduled this round or
+        still in flight (its KV rows are being written), nothing whose
+        KV contents the host cannot reconstruct (broken chain — decode
+        bursts, or a deferred on-device token), nothing already at the
+        context limit (re-queueing it would re-prefill to exhaustion)."""
+        out = []
+        for uid, seq in self.state.seqs.items():
+            if uid in exclude or uid in self._ctx_exhausted:
+                continue
+            if self._inflight_sched.get(uid, 0):
+                continue
+            if seq.chain_broken or len(seq.chain) != seq.seen_tokens:
+                continue
+            p = self._pending.get(uid)
+            if p and p[0] == FEEDBACK_TOKEN:
+                continue
+            m = self._meta.get(uid)
+            out.append((uid, float(m.priority if m else 0),
+                        len(seq.blocks)))
+        return out
+
+    def _preempt(self, uid: int) -> None:
+        """Preemption-by-eviction: release the victim's KV back through
+        the refcounted allocator (content-hashed full blocks retire to
+        the cached-free LRU pool, so with the prefix cache on the
+        re-prefill is one aliasing pass, not a recompute) and re-queue
+        its full host-known token stream — KV chain + still-pending
+        concrete tokens — as a prompt.  NOT terminal: the lifecycle
+        record stays open across the eviction (``preemptions`` counts
+        it), and the (uid, position)-folded sampling keys make the
+        resumed output token-identical to an undisturbed run
+        (tests/test_scheduler_fuzz.py parity test)."""
+        seq = self.state.seqs[uid]
+        requeue = [int(t) for t in seq.chain]
+        tail = [int(t) for t in self._pending.get(uid, [])]
+        if seq.tokens:
+            # stash generated-so-far: they become prompt tokens on the
+            # re-prefill, but query() keeps reporting the full output
+            self._preempt_gen[uid] = (self._preempt_gen.get(uid, [])
+                                      + [int(t) for t in seq.tokens])
+        self._preempting.add(uid)
+        try:
+            self.state.release(uid)
+        finally:
+            self._preempting.discard(uid)
+        self._fb_step.pop(uid, None)
+        self._pending[uid] = requeue + tail
+        self.requests.on_preempted(uid)
+
+    def _reap_deadlines(self, now: float) -> None:
+        """Terminally close every request whose ``deadline_ms`` elapsed
+        — queued entries just drop; running sequences release their KV.
+        A sequence with an uncollected in-flight step is deferred one
+        round (its KV rows are still being written)."""
+        if not self._deadline_uids:
+            return
+        for uid in list(self._deadline_uids):
+            m = self._meta.get(uid)
+            if m is None:
+                self._deadline_uids.discard(uid)
+                continue
+            if not m.expired(now):
+                continue
+            if self._inflight_sched.get(uid, 0):
+                continue
+            self._finish(uid, "deadline_exceeded")
+            self._reaped.add(uid)
+
+    def _close_ctx_exhausted(self) -> None:
+        """Terminally close context-exhausted sequences once nothing is
+        in flight for them (status ``context_exhausted``) — without this
+        the direct step() API leaks their open lifecycle records
+        forever.  Closure reaps the uid (``_drain_reaped`` tells the
+        sync generate() driver) and ``_forget`` drops it from
+        ``_ctx_exhausted``, so the set never grows without bound under
+        long direct-API traffic and a later reused uid is not
+        permanently unschedulable.  (The pipelined driver never calls
+        this: it drains the set itself and finishes those requests
+        through its own flush.)"""
+        for uid in list(self._ctx_exhausted):
+            if uid not in self.state.seqs:
+                # closed through another exit path (flush/cancel/...)
+                # before this round got to it
+                self._ctx_exhausted.discard(uid)
+            elif not self._inflight_sched.get(uid, 0):
+                self._finish(uid, "context_exhausted")
+                self._reaped.add(uid)
 
     def step(self, rng: Optional[jax.Array] = None,
              sampling: SamplingParams = SamplingParams()
@@ -998,6 +1310,7 @@ class InferenceEngine:
         when the sampler needs one)."""
         t0 = time.perf_counter()
         sched = self._schedule()
+        self._close_ctx_exhausted()
         if not sched:
             return None
         # context bucket: the compiled block bound covers every scheduled
@@ -1076,8 +1389,12 @@ class InferenceEngine:
                       n_tokens=sum(len(t) for _, t in sched))
         emit = tuple((uid, self.state.slot(uid)) for uid, _ in sched
                      if not self._pending.get(uid))
+        uids = tuple(uid for uid, _ in sched)
+        for uid in uids:
+            self._inflight_sched[uid] = self._inflight_sched.get(uid, 0) + 1
         self._dispatch_seq += 1
-        return _InFlight(toks=toks, emit=emit, sid=self._dispatch_seq)
+        return _InFlight(toks=toks, emit=emit, sid=self._dispatch_seq,
+                         uids=uids)
 
     def _drain_cow(self) -> None:  # tpulint: serving-loop
         """Execute queued copy-on-write block copies (a prefix-cache
@@ -1124,6 +1441,12 @@ class InferenceEngine:
         stale device sample array).  Markers owned by a newer in-flight
         step — the same sequence sampled again before this read — are
         left for that step's collect."""
+        for uid in st.uids:
+            n = self._inflight_sched.get(uid, 0) - 1
+            if n > 0:
+                self._inflight_sched[uid] = n
+            else:
+                self._inflight_sched.pop(uid, None)
         t0 = time.perf_counter()
         jax.block_until_ready(st.toks)
         t1 = time.perf_counter()
@@ -1326,10 +1649,14 @@ class InferenceEngine:
         default) keeps one step in flight — host scheduling/staging and
         token readback overlap device compute, and the sampled-token
         array feeds the next step on device."""
+        done: Dict[int, List[int]] = {}
+        active = set()
         for uid, p in prompts.items():
-            self.put(uid, p)
-        done: Dict[int, List[int]] = {uid: [] for uid in prompts}
-        active = set(prompts)
+            done[uid] = []
+            if self.put(uid, p):
+                # under a bounded admission queue a prompt may be shed
+                # at put() time — its row stays empty (query() says why)
+                active.add(uid)
         if self.icfg.decode_burst <= 1 and self.icfg.pipeline_depth >= 2:
             return self._generate_pipelined(done, active, sampling, rng)
         return self._generate_sync(done, active, sampling, rng)
@@ -1343,6 +1670,11 @@ class InferenceEngine:
         i = 0
         draw = self._rng_drawer(rng)
         while active:
+            # engine-side terminal closures (deadline expiry, cancel,
+            # shed-by-eviction) end those requests' generation here
+            active -= self._drain_reaped()
+            if not active:
+                break
             pending = {u: t for u, t in self._pending.items() if t}
             decode_only = pending and all(
                 len(t) == 1 and u in self.state.seqs
@@ -1419,6 +1751,12 @@ class InferenceEngine:
         draw = self._rng_drawer(rng)
         stall = 0
         while active or inflight:
+            # engine-side terminal closures (deadline expiry, cancel,
+            # shed-by-eviction) end those requests' generation here
+            reaped = self._drain_reaped()
+            if reaped:
+                active -= reaped
+                finishing -= reaped
             # fill the pipeline while there is schedulable work
             while len(inflight) < depth and any(self._pending.values()):
                 st = self._dispatch(sampling, draw)

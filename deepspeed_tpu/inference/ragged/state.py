@@ -214,6 +214,11 @@ class StateManager:
         # next step dispatch (the scheduler itself never touches the
         # device); release() drops a flushed sequence's entries
         self.cow_pending: List[Tuple[int, int, int]] = []
+        # fired with the uid AFTER a sequence's blocks/slot are
+        # released — the engine closes the request's lifecycle record
+        # here so no exit path (flush, preemption, deadline, direct
+        # release) can leak an open record
+        self.on_release: Optional[callable] = None
         # paged KV: [L, blocks+1, block_size, 2, Hkv, D] — the extra row is
         # the trash block that padding tokens' KV writes are routed to
         # (plus per-vector scales when cfg.quant != "none")
@@ -251,6 +256,8 @@ class StateManager:
             # chains leaf-first — a surviving cached prefix stays useful
             self.allocator.free(list(reversed(seq.blocks)))
         self._free_slots.append(self._slots.pop(uid))
+        if self.on_release is not None:
+            self.on_release(uid)
 
     # ---- prefix cache ----------------------------------------------------
     def _on_evict(self, block: int) -> None:
@@ -487,7 +494,11 @@ class StateManager:
                 seq.chain_broken = True
             else:
                 token_ids[cursor:cursor + n] = new_tokens
-                if self.prefix_cache and not seq.chain_broken:
+                if not seq.chain_broken:
+                    # the chain is kept even with the prefix cache off:
+                    # it is the host-known "KV contents in order" record
+                    # that preemption-by-eviction re-queues (the index
+                    # registration below stays cache-gated)
                     seq.chain.extend(int(t) for t in new_tokens)
             positions[cursor:cursor + n] = np.arange(
                 seq.seen_tokens, seq.seen_tokens + n)

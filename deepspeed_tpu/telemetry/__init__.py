@@ -16,10 +16,11 @@ Three pieces, composable and JAX-free:
 from .metrics import (Counter, CounterDictView, Gauge, Histogram,
                       MetricsRegistry, parse_prometheus_text)
 from .lifecycle import (QUEUE_WAIT_BUCKETS_MS, RequestRecord,
-                        RequestTracker, TPOT_BUCKETS_MS, TTFT_BUCKETS_MS)
+                        RequestTracker, TERMINAL_STATUSES,
+                        TPOT_BUCKETS_MS, TTFT_BUCKETS_MS)
 from .tracer import SpanTracer
 
 __all__ = ["SpanTracer", "MetricsRegistry", "Counter", "Gauge",
            "Histogram", "CounterDictView", "parse_prometheus_text",
-           "RequestTracker", "RequestRecord", "TTFT_BUCKETS_MS",
-           "TPOT_BUCKETS_MS", "QUEUE_WAIT_BUCKETS_MS"]
+           "RequestTracker", "RequestRecord", "TERMINAL_STATUSES",
+           "TTFT_BUCKETS_MS", "TPOT_BUCKETS_MS", "QUEUE_WAIT_BUCKETS_MS"]

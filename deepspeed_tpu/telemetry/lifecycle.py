@@ -46,11 +46,32 @@ TPOT_BUCKETS_MS = (0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0,
 QUEUE_WAIT_BUCKETS_MS = TTFT_BUCKETS_MS
 
 
+# terminal statuses a record may close with (docs/OBSERVABILITY.md):
+#   finished          — ran to completion (stop token / max_new / flush)
+#   shed              — rejected or evicted by backpressure before ever
+#                       holding KV (overload.OverloadConfig.shed_policy)
+#   deadline_exceeded — its deadline_ms elapsed before completion
+#   context_exhausted — hit the engine's max context; nothing more can
+#                       be scheduled for it
+#   cancelled         — engine.cancel() (client abort)
+#   released          — its KV was released out-of-band (direct
+#                       StateManager.release while the record was open)
+TERMINAL_STATUSES = ("finished", "shed", "deadline_exceeded",
+                     "context_exhausted", "cancelled", "released")
+
+
 @dataclasses.dataclass
 class RequestRecord:
     """One request's lifecycle timestamps + token accounting."""
     uid: int
     t_arrival: float
+    # "open" until a terminal event closes the record; then one of
+    # TERMINAL_STATUSES.  Preemption is NOT terminal: a preempted
+    # request is re-queued (its KV re-prefills, from the prefix cache
+    # when possible) and the record stays open with ``preemptions``
+    # counting the evictions it survived.
+    status: str = "open"
+    preemptions: int = 0
     t_admitted: Optional[float] = None
     t_prefill_start: Optional[float] = None
     t_first_token: Optional[float] = None
@@ -107,6 +128,8 @@ class RequestRecord:
                 "cached_tokens": self.cached_tokens,
                 "generated_tokens": self.generated_tokens,
                 "finished": self.t_finish is not None,
+                "status": self.status,
+                "preemptions": self.preemptions,
                 **ms}
 
 
@@ -132,12 +155,26 @@ class RequestTracker:
             "serving_requests_total", "requests ever opened",
             int_valued=True)
         self._c_finished = registry.counter(
-            "serving_requests_finished_total", "requests flushed",
-            int_valued=True)
+            "serving_requests_finished_total",
+            "requests closed with any terminal status", int_valued=True)
+        self._c_terminal = registry.counter(
+            "serving_requests_terminal_total",
+            "terminal lifecycle closures by status", int_valued=True)
+        self._c_preempted = registry.counter(
+            "serving_preemptions_total",
+            "preemption-by-eviction events (non-terminal: the request "
+            "is re-queued)", int_valued=True)
+        # uid -> last terminal status, bounded alongside the finished
+        # ring (``_status_refs`` counts ring records per uid so the
+        # entry dies with its last evicted record)
+        self._last_status: Dict[int, str] = {}
+        self._status_refs: Dict[int, int] = {}
 
     def clear(self) -> None:
         self.open.clear()
         self.finished.clear()
+        self._last_status.clear()
+        self._status_refs.clear()
 
     # ------------------------------------------------------------------
     # lifecycle events (all O(1) dict/float work)
@@ -187,16 +224,49 @@ class RequestTracker:
         rec.t_last_token = now
         rec.generated_tokens += n
 
-    def on_finish(self, uid: int, now: Optional[float] = None) -> None:
+    def on_preempted(self, uid: int, now: Optional[float] = None) -> None:
+        """A running request was evicted and re-queued — NOT terminal:
+        the record stays open accumulating tokens/latency across the
+        re-prefill; only the eviction count and counter move."""
+        rec = self.open.get(uid)
+        if rec is None:
+            return
+        rec.preemptions += 1
+        self._c_preempted.inc()
+
+    def on_finish(self, uid: int, now: Optional[float] = None,
+                  status: str = "finished") -> None:
+        """Close the record with a terminal ``status`` (idempotent: a
+        second terminal event for the same uid is a no-op, so e.g. a
+        context-exhausted close followed by the driver's flush never
+        double-counts)."""
         rec = self.open.pop(uid, None)
         if rec is None:
             return
         rec.t_finish = now if now is not None else time.perf_counter()
+        rec.status = status
         tpot = rec.tpot_ms
         if tpot is not None:
             self._h_tpot.observe(tpot)
         self._c_finished.inc()
+        self._c_terminal.inc(status=status)
+        if len(self.finished) == self.finished.maxlen:
+            old = self.finished[0]          # about to be ring-evicted
+            self._status_refs[old.uid] -= 1
+            if not self._status_refs[old.uid]:
+                del self._status_refs[old.uid]
+                self._last_status.pop(old.uid, None)
         self.finished.append(rec)
+        self._last_status[uid] = status
+        self._status_refs[uid] = self._status_refs.get(uid, 0) + 1
+
+    def status_of(self, uid: int) -> Optional[str]:
+        """``"open"`` while the request is live, its terminal status
+        after closure (as far back as the finished ring remembers), or
+        None for a uid this tracker never saw."""
+        if uid in self.open:
+            return "open"
+        return self._last_status.get(uid)
 
     # ------------------------------------------------------------------
     def records(self) -> List[RequestRecord]:
@@ -209,6 +279,10 @@ class RequestTracker:
             "requests": int(self._c_arrived.value()),
             "finished": int(self._c_finished.value()),
             "open": len(self.open),
+            "preemptions": int(self._c_preempted.value()),
+            # terminal closures by status (only statuses that occurred)
+            "statuses": {k[0][1]: int(v)
+                         for k, v in self._c_terminal.series() if k},
             "ttft_ms": self._h_ttft.summary(),
             "tpot_ms": self._h_tpot.summary(),
             "queue_wait_ms": self._h_queue.summary(),

@@ -1,0 +1,105 @@
+"""Tier-1 leg for the load harness (tools/loadgen.py): the smoke
+replay — deterministic bursty over-capacity trace, policy engine vs
+pure-FIFO baseline, every fault kind injected — plus trace-generation
+determinism and the SLO-sweep JSON schema.
+
+The smoke doubles as the overload acceptance check (see the loadgen
+module docstring): sheds/preemptions instead of stalls, every injected
+fault resolves to a terminal lifecycle state, token accounting exact,
+allocator partition intact, and high-priority step-counted TTFT beats
+the FIFO baseline's head-of-line delay.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from tools.loadgen import (Fault, Request, build_engine, default_faults,
+                           make_trace, replay, run_sweep, smoke, summarize)
+
+
+def test_make_trace_deterministic():
+    a = make_trace(seed=3, n_requests=16, qps=4.0, arrival="bursty")
+    b = make_trace(seed=3, n_requests=16, qps=4.0, arrival="bursty")
+    assert a == b
+    c = make_trace(seed=4, n_requests=16, qps=4.0, arrival="bursty")
+    assert a != c
+    # bursty arrivals actually cluster: some step gets >= 3 arrivals
+    steps = [q.step for q in a]
+    assert max(steps.count(s) for s in set(steps)) >= 3
+    # priorities cycle through the tier pattern; prompt lengths vary
+    assert {q.priority for q in a} == {0, 1, 2}
+    assert len({len(q.prompt) for q in a}) > 1
+
+
+def test_make_trace_rejects_unknown_arrival():
+    with pytest.raises(ValueError):
+        make_trace(arrival="adversarial")
+
+
+def test_default_faults_cover_all_kinds():
+    trace = make_trace(seed=0, n_requests=8, qps=4.0)
+    kinds = {f.kind for f in default_faults(trace)}
+    assert kinds == {"pool_exhaust", "latency_spike", "cancel"}
+
+
+@pytest.fixture(scope="module")
+def smoke_out():
+    """One smoke run shared by the assertions below (the replay itself
+    is the expensive part — compile + ~70 engine steps)."""
+    return smoke(seed=0)
+
+
+def test_smoke_is_the_acceptance_check(smoke_out):
+    """The tier-1 deterministic leg — identical to
+    ``python -m tools.loadgen --smoke`` (in-process to share the jit
+    cache with the rest of the suite)."""
+    out = smoke_out
+    assert out["ok"] and all(out["checks"].values())
+    # the trace genuinely overloaded the policy engine
+    assert out["policy"]["statuses"].get("shed", 0) > 0 \
+        or out["policy"]["preemptions"] > 0
+    # both engines drained every request to a terminal state
+    assert out["policy"]["open_records"] == 0
+    assert out["fifo"]["open_records"] == 0
+    json.dumps(out)                          # BENCH-JSON serializable
+
+
+def test_replay_single_leg_schema(tmp_path):
+    """One tiny sweep leg: replay drains, summary carries the SLO
+    fields, and the JSON round-trips to disk (what ``--out`` writes)."""
+    res = run_sweep([4.0], n_requests=8, arrival="poisson", seed=1,
+                    with_faults=False)
+    leg = res["legs"]["4.0"]
+    for key in ("statuses", "preemptions", "parity", "ttft_steps_p95",
+                "tpot_ms_p50", "open_records"):
+        assert key in leg
+    assert leg["requests"] == 8
+    assert all(leg["parity"].values())
+    p = tmp_path / "slo.json"
+    p.write_text(json.dumps(res))
+    assert json.loads(p.read_text())["qps"] == [4.0]
+
+
+def test_replay_wedge_guard():
+    """A replay that cannot drain raises instead of hanging (the
+    serving-wait discipline, applied to the harness itself)."""
+    eng, _ = build_engine()
+    trace = [Request(uid=0, step=0, prompt=[1, 2, 3], max_new=4)]
+    # a fault that permanently eats the whole pool can never drain
+    faults = [Fault("pool_exhaust", step=0, duration=10**9, frac=1.0)]
+    with pytest.raises(RuntimeError, match="did not drain"):
+        replay(eng, trace, faults, max_steps=30)
+
+
+def test_fifo_baseline_sees_head_of_line_blowup(smoke_out):
+    """The accept-criteria comparison in isolation: same bursty trace,
+    FIFO baseline's TTFT p95 (steps) bounds the policy engine's
+    high-priority p95 from above — chunked prefill + priorities +
+    preemption demonstrably protect the high tier."""
+    out = smoke_out
+    hi = out["policy"]["ttft_steps_hi_p95"]
+    fifo_p95 = out["fifo"]["ttft_steps_p95"]
+    assert hi is not None and fifo_p95 is not None
+    assert hi <= fifo_p95

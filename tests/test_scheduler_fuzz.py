@@ -200,3 +200,128 @@ def test_schedule_feedback_markers_admit_like_decodes(model):
     eng._pending[0] = [FEEDBACK_TOKEN]
     eng._fb_step[0] = eng._dispatch_seq - 1
     assert eng._schedule() == []
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_overload_fuzz_invariants(model, seed):
+    """Overload-policy ops in the mix (docs/SERVING.md "Surviving
+    overload"): mixed-priority puts against a bounded admission queue
+    (all three shed policies), deadline puts that expire mid-fuzz,
+    client cancels, and scheduler rounds whose starvation handling may
+    preempt-by-eviction — after EVERY op the allocator partition
+    ``referenced + cached_free + free == total`` holds, refcounts equal
+    holder counts, and no lifecycle record leaks open once its request
+    left the engine."""
+    from deepspeed_tpu.inference.overload import (SHED_POLICIES,
+                                                  OverloadConfig)
+    r = np.random.RandomState(500 + seed)
+    eng = InferenceEngine(model, InferenceConfig(
+        token_budget=16, max_seqs=3, kv_block_size=8, num_kv_blocks=6,
+        max_seq_len=48, prefix_cache="on",
+        overload=OverloadConfig(
+            max_queued_requests=4,
+            shed_policy=SHED_POLICIES[seed % len(SHED_POLICIES)],
+            prefill_chunk=6, preemption=True,
+            max_preemptions_per_step=2, aging_ms=50.0)))
+    prefixes = [list(r.randint(1, 128, n)) for n in (8, 16, 24)]
+    next_uid = 0
+    for _ in range(300):
+        op = r.randint(7)
+        live = list(eng.state.seqs)
+        if op == 0:                          # mixed-tier prompt
+            p = prefixes[r.randint(len(prefixes))] if r.randint(2) \
+                else list(r.randint(1, 128, r.randint(1, 40)))
+            eng.put(next_uid, list(p), priority=int(r.randint(0, 4)))
+            next_uid += 1
+        elif op == 1:                        # doomed: deadline expires
+            eng.put(next_uid, list(r.randint(1, 128, r.randint(1, 20))),
+                    priority=int(r.randint(0, 4)),
+                    deadline_ms=0.0 if r.randint(2) else 10_000.0)
+            next_uid += 1
+        elif op == 2 and live:               # decode continuation
+            uid = live[r.randint(len(live))]
+            if not eng._pending.get(uid):
+                eng.put(uid, [int(r.randint(1, 128))])
+        elif op == 3 and live:               # flush a random live seq
+            eng.flush(live[r.randint(len(live))])
+        elif op == 4 and next_uid:           # client cancel, any state
+            eng.cancel(int(r.randint(next_uid)))
+        else:                                # scheduler round
+            sched = eng._schedule()
+            _check_invariants(eng, sched)
+            if sched:
+                eng.state.build_batch(sched, eng.icfg.token_budget,
+                                      stager=eng._stager)
+        _check_pool_accounting(eng)
+        # no record leaks: every open lifecycle record belongs to a
+        # request that is still queued or live in the engine
+        for uid in eng.requests.open:
+            assert uid in eng.state.seqs or eng._pending.get(uid) \
+                or uid in eng._meta, f"leaked open record for uid {uid}"
+    # drain: close every remaining request through its exit path
+    eng._drain_reaped()
+    for uid in list(eng.requests.open):
+        eng.flush(uid)
+    al = eng.state.allocator
+    al.assert_invariants()
+    assert al.referenced_blocks == 0
+    assert al.free_blocks == al.total_blocks
+    assert not eng.requests.open, "open records after full drain"
+    assert eng.state.cow_pending == []
+    # the fuzz actually walked the paths under test (every seed does)
+    agg = eng.request_metrics()["aggregate"]
+    assert agg["preemptions"] > 0, "fuzz never triggered preemption"
+    assert agg["statuses"].get("deadline_exceeded", 0) > 0
+    assert agg["statuses"].get("cancelled", 0) > 0
+
+
+def test_preempt_resume_prefix_cache_parity(model):
+    """Seeded-sampling parity across preemption-by-eviction WITH the
+    prefix cache doing the resume: the victim's evicted blocks retire
+    to the cached-free pool, the re-prefill aliases them back, and the
+    (uid, position)-folded sampling keys make the resumed stream
+    token-identical to an undisturbed run — eviction is invisible in
+    the output."""
+    import jax
+
+    r = np.random.RandomState(41)
+    prompts = {0: list(r.randint(1, 128, 13)),
+               1: list(r.randint(1, 128, 10))}
+
+    def drive(preempt_at=None):
+        eng = InferenceEngine(model, InferenceConfig(
+            token_budget=16, max_seqs=3, kv_block_size=8,
+            num_kv_blocks=16, max_seq_len=96, prefix_cache="on"))
+        for uid, p in prompts.items():
+            eng.put(uid, list(p))
+        done = {u: [] for u in prompts}
+        active = set(prompts)
+        rng = jax.random.PRNGKey(23)
+        sp = SamplingParams(temperature=0.8, top_k=40)
+        n = 0
+        while active:
+            outs = eng.step(rng=rng, sampling=sp)
+            for uid, tok in (outs or {}).items():
+                if uid not in active:
+                    continue
+                done[uid].append(tok)
+                if len(done[uid]) >= 6:
+                    active.discard(uid)
+                    eng.flush(uid)
+                else:
+                    eng.put(uid, [tok])
+            n += 1
+            if preempt_at is not None and n == preempt_at \
+                    and 0 in eng.state.seqs:
+                eng._preempt(0)
+            assert n < 200, "parity drive did not terminate"
+        return done, eng
+
+    ref, _ = drive()
+    got, eng = drive(preempt_at=3)
+    assert got == ref, "preempt-then-resume diverged from undisturbed run"
+    assert eng.request_metrics()["aggregate"]["preemptions"] == 1
+    # the resume really came from the cache, not a cold re-prefill
+    rec = {x["uid"]: x for x in eng.request_metrics()["requests"]}
+    assert rec[0]["cached_tokens"] > 0
+    _check_pool_accounting(eng)

@@ -1,0 +1,25 @@
+"""Known-bad: unbounded blocking waits inside serving-loop methods
+(tpulint: serving-wait)."""
+import time
+
+
+class Engine:
+    def _collect(self, st):  # tpulint: serving-loop
+        while not st.ready:                 # BAD: polling loop, no bound
+            time.sleep(0.001)
+        return st.result
+
+    def _drain(self, q):  # tpulint: serving-loop
+        item = q.get()                      # BAD: no-timeout queue get
+        return item
+
+    def _sync(self, ev, worker):  # tpulint: serving-loop
+        ev.wait()                           # BAD: no-timeout event wait
+        worker.join()                       # BAD: no-timeout join
+        return True
+
+    def _spin(self, peer):  # tpulint: serving-loop
+        while peer.pending():               # BAD: poll forever on a peer
+            if peer.dead():
+                continue
+            time.sleep(0.01)

@@ -1,0 +1,38 @@
+"""Known-good twin of bad_serving_wait (no serving-wait findings)."""
+import time
+
+
+class Engine:
+    def _collect(self, st):  # tpulint: serving-loop
+        # bounded poll: a monotonic deadline in the loop condition
+        deadline = time.perf_counter() + 5.0
+        while not st.ready and time.perf_counter() < deadline:
+            time.sleep(0.001)
+        if not st.ready:
+            raise TimeoutError("step did not complete in 5s")
+        return st.result
+
+    def _drain(self, q):  # tpulint: serving-loop
+        # a timeout kwarg bounds the blocking get
+        return q.get(timeout=0.5)
+
+    def _sync(self, ev, worker):  # tpulint: serving-loop
+        # positional timeout on Event.wait; join with timeout kwarg
+        ok = ev.wait(1.0)
+        worker.join(timeout=1.0)
+        return ok
+
+    def _spin(self, peer):  # tpulint: serving-loop
+        # a step budget guarding a raise bounds the poll
+        attempts = 0
+        while peer.pending():
+            attempts += 1
+            if attempts > 100:
+                raise RuntimeError("peer wedged")
+            time.sleep(0.01)
+
+    def unmarked_helper(self, ev):
+        # not part of the serving loop: blocking is the caller's business
+        ev.wait()
+        while not ev.is_set():
+            time.sleep(0.1)

@@ -323,6 +323,109 @@ def check_serving_sync(ctx: FileContext) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
+# rule: serving-wait — unbounded blocking waits in serving-loop methods
+# --------------------------------------------------------------------------
+
+# kwargs whose presence bounds a blocking primitive
+_WAIT_TIMEOUT_KWARGS = {"timeout", "timeout_s", "timeout_ms", "deadline"}
+# name fragments that signal the loop carries its own bound (a deadline
+# comparison, a step budget, a remaining-time check, a monotonic clock)
+_WAIT_BOUND_HINTS = ("deadline", "timeout", "budget", "remaining",
+                     "expire", "max_steps", "max_iter", "retries",
+                     "attempts", "perf_counter", "monotonic")
+# zero-arg attribute calls that block the caller until an external event
+# (dict.get(key) / str.join(xs) / Event.wait(t) all take args, so the
+# bare no-arg form is the unbounded one)
+_WAIT_BLOCKING_ATTRS = {"wait", "get", "join", "acquire", "recv"}
+
+
+def _mentions_wait_bound(node: ast.AST) -> bool:
+    """Any identifier/attribute whose name smells like a deadline or
+    budget, or a monotonic-clock call — evidence the code bounds its
+    own waiting."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) \
+                and any(h in n.id.lower() for h in _WAIT_BOUND_HINTS):
+            return True
+        if isinstance(n, ast.Attribute) \
+                and any(h in n.attr.lower() for h in _WAIT_BOUND_HINTS):
+            return True
+    return False
+
+
+def _blocking_wait_call(node: ast.AST) -> Optional[Tuple[str, bool]]:
+    """``(description, unbounded_alone)`` when ``node`` is a call that
+    can block the caller on an external event.  ``time.sleep`` is
+    bounded by itself (the enclosing polling LOOP is the hazard);
+    a no-arg ``.wait()`` / ``.get()`` / ``.join()`` / ``.acquire()`` /
+    ``.recv()`` blocks indefinitely on its own."""
+    if not isinstance(node, ast.Call):
+        return None
+    if dotted(node.func) == "time.sleep":
+        return "time.sleep()", False
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _WAIT_BLOCKING_ATTRS \
+            and not node.args \
+            and not any(kw.arg in _WAIT_TIMEOUT_KWARGS or kw.arg is None
+                        for kw in node.keywords):
+        return f".{node.func.attr}()", True
+    return None
+
+
+@rule("serving-wait",
+      "unbounded blocking wait inside a '# tpulint: serving-loop' marked "
+      "method: a no-timeout .wait()/.get()/.join()/.acquire()/.recv(), "
+      "or a polling while-loop (sleep/wait in the body) with no "
+      "deadline, step budget, or timeout evidence — a stalled device or "
+      "a wedged peer must surface as an error, never a silent hang")
+def check_serving_wait(ctx: FileContext) -> Iterator[Finding]:
+    marked = _serving_marked_lines(ctx)
+    if not marked:
+        return
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        header = range(fn.lineno, fn.body[0].lineno + 1)
+        if not any(ln in marked for ln in header):
+            continue
+        # 1) bare unbounded blocking primitives, loop or not
+        for node in ast.walk(fn):
+            bw = _blocking_wait_call(node)
+            if bw is not None and bw[1]:
+                yield Finding(
+                    "serving-wait", ctx.path, node.lineno,
+                    node.col_offset,
+                    f"{bw[0]} with no timeout in a serving-loop method "
+                    "blocks the loop indefinitely — pass a timeout and "
+                    "handle expiry")
+        # 2) polling loops with no bound: a while whose body (or test)
+        #    blocks, and neither the test nor any break/return/raise
+        #    guard references a deadline/budget/clock
+        for loop in ast.walk(fn):
+            if not isinstance(loop, ast.While):
+                continue
+            if not any(_blocking_wait_call(n) is not None
+                       for n in ast.walk(loop)):
+                continue
+            if _mentions_wait_bound(loop.test):
+                continue
+            guarded = any(
+                isinstance(n, ast.If) and _mentions_wait_bound(n.test)
+                and any(isinstance(x, (ast.Break, ast.Return, ast.Raise))
+                        for s in n.body + n.orelse
+                        for x in ast.walk(s))
+                for n in ast.walk(loop))
+            if guarded:
+                continue
+            yield Finding(
+                "serving-wait", ctx.path, loop.lineno, loop.col_offset,
+                "polling loop with no deadline in a serving-loop method "
+                "— bound it by a perf_counter deadline or a step budget "
+                "so a wedged condition raises instead of hanging the "
+                "serving loop")
+
+
+# --------------------------------------------------------------------------
 # rule: static-args — recompilation / hashability hazards on jit params
 # --------------------------------------------------------------------------
 
