@@ -232,6 +232,7 @@ def main(trace_path=None):
     serve = leg(serving_bench, on_tpu)
     pipe = leg(pipeline_serving_bench, on_tpu, trace_path)
     prefix = leg(shared_prefix_serving_bench, on_tpu)
+    spec = leg(spec_decode_serving_bench, on_tpu)
     overload = leg(overload_serving_bench, on_tpu)
     llama_train = leg(llama_train_bench, on_tpu, peak)
     llama_serve = leg(llama8b_serving_bench, on_tpu)
@@ -247,7 +248,7 @@ def main(trace_path=None):
         "train_metrics": train_metrics,
     }
     out.update(serve)
-    print(json.dumps({**out, **pipe, **prefix, **overload,  # tpulint: disable=print — the bench's one JSON output line
+    print(json.dumps({**out, **pipe, **prefix, **spec, **overload,  # tpulint: disable=print — the bench's one JSON output line
                       **llama_train, **llama_serve, **moe}))
 
 
@@ -832,6 +833,81 @@ def shared_prefix_serving_bench(on_tpu: bool):
     out["shared_prefix_speedup"] = round(
         out["shared_prefix_prefill_tok_s_on"]
         / max(out["shared_prefix_prefill_tok_s_off"], 1e-9), 2)
+    return out
+
+
+def spec_decode_serving_bench(on_tpu: bool):
+    """Model-free speculative decoding leg (docs/SERVING.md
+    "Speculative decoding"): decode throughput with ``spec_decode`` on
+    vs off at identical shapes on the repetitive/code-like traffic
+    prompt-lookup targets — each prompt is a short token motif repeated
+    (the shape of templated code, quoted RAG context, or structured
+    logs), and the decoded stream itself falls into cycles the n-gram
+    proposer locks onto.  Outputs are token-identical by construction
+    (the verify step is exact); the win is steps: an accepted window
+    emits up to 1 + spec_max_draft tokens per dispatch.  Both modes run
+    the strict-sync driver (pipeline_depth=1): a verify window's next
+    fed token depends on host-side acceptance, so drafting rows cannot
+    ride the depth-2 feedback marker anyway — speculation's natural
+    home is the sync loop, where every saved step is pure wall-clock
+    (measured here: depth-1 spec beats depth-2 spec, which trades each
+    window for a pipeline bubble).  Reports decode tok/s both ways, the
+    speedup, the acceptance_rate, and the mean accepted draft length —
+    the measured signals ROADMAP item 4's autotuner needs to drive
+    ``spec_decode="auto"`` from data."""
+    import numpy as np
+
+    from deepspeed_tpu.inference import (InferenceConfig, InferenceEngine,
+                                         SamplingParams)
+    from deepspeed_tpu.models import build_model
+
+    n_seqs = 8 if on_tpu else 4
+    prompt_len = 64 if on_tpu else 24
+    gen_tokens = 96
+    model = build_model(
+        "gpt2",
+        **(dict(max_seq_len=1024) if on_tpu else
+           dict(num_layers=2, d_model=128, num_heads=4, vocab_size=1024,
+                max_seq_len=256)))
+    r = np.random.RandomState(0)
+    vocab = model.config.vocab_size
+    prompts = {}
+    for uid in range(n_seqs):
+        motif = list(r.randint(0, vocab, 4 + uid % 3))
+        reps = -(-prompt_len // len(motif))
+        prompts[uid] = (motif * reps)[:prompt_len]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=gen_tokens)
+    out = {}
+    for mode in ("off", "on"):
+        eng = InferenceEngine(model, InferenceConfig(
+            token_budget=256 if on_tpu else 64, max_seqs=n_seqs,
+            kv_block_size=64 if on_tpu else 16,
+            num_kv_blocks=256 if on_tpu else 96,
+            pipeline_depth=1,
+            spec_decode=mode, spec_max_draft=4))
+        # warm the compile caches; generate() flushes everything, so the
+        # proposer history starts cold again for the timed run
+        eng.generate({u: list(p) for u, p in prompts.items()}, sp)
+        eng.reset_metrics()
+        t0 = time.perf_counter()
+        toks = eng.generate({u: list(p) for u, p in prompts.items()}, sp)
+        dt = time.perf_counter() - t0
+        produced = sum(len(v) for v in toks.values())
+        out[f"spec_decode_tok_s_{mode}"] = round(produced / dt, 1)
+        out[f"spec_decode_steps_{mode}"] = eng.timings["steps"]
+        if mode == "on":
+            tm = eng.timings
+            out["spec_acceptance_rate"] = round(
+                tm["spec_accepted_tokens"]
+                / max(tm["spec_drafted_tokens"], 1), 3)
+            out["spec_mean_accepted_draft_len"] = round(
+                tm["spec_accepted_tokens"] / max(tm["spec_windows"], 1),
+                3)
+            out["spec_request_metrics"] = \
+                eng.request_metrics()["aggregate"]
+    out["spec_decode_speedup"] = round(
+        out["spec_decode_tok_s_on"]
+        / max(out["spec_decode_tok_s_off"], 1e-9), 2)
     return out
 
 

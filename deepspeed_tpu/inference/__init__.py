@@ -1,12 +1,13 @@
 from .engine import InferenceConfig, InferenceEngine
 from .overload import AdmissionVerdict, OverloadConfig
 from .sampler import SamplingParams, sample
+from .spec_decode import NgramProposer
 from .ragged.state import (BatchStager, FEEDBACK_TOKEN, KVCacheConfig,
                            StateManager, RaggedBatch)
 from .ragged.allocator import BlockedAllocator
 from .weight_stream import NVMeWeightStore
 
 __all__ = ["InferenceConfig", "InferenceEngine", "SamplingParams", "sample",
-           "OverloadConfig", "AdmissionVerdict",
+           "OverloadConfig", "AdmissionVerdict", "NgramProposer",
            "KVCacheConfig", "StateManager", "RaggedBatch", "BatchStager",
            "FEEDBACK_TOKEN", "BlockedAllocator", "NVMeWeightStore"]

@@ -137,6 +137,31 @@ class InferenceConfig:
     # host-side counter bumps that never touch device arrays.
     trace: bool = False
     trace_capacity: int = 1 << 16   # spans retained (ring wraps beyond)
+    # model-free speculative decoding (inference/spec_decode.py,
+    # docs/SERVING.md "Speculative decoding"): an n-gram prompt-lookup
+    # proposer drafts up to ``spec_max_draft`` continuation tokens per
+    # decoding sequence from the request's OWN prompt + emitted tokens
+    # (zero extra weights), a ragged verify step scores the window of
+    # 1 + k positions in ONE dispatch, and the longest draft prefix
+    # matching what the model samples anyway is accepted (rejected
+    # tokens roll the paged-KV write cursor back — host bookkeeping
+    # only).  Output streams are EXACTLY the non-speculative ones,
+    # greedy and seeded (the verify step samples each window position
+    # with the same (uid, position)-folded key the stepwise path uses).
+    # "on" enables; "off" disables (n_verify=1 — the compiled step is
+    # byte-identical to a pre-spec engine); "auto" defers to the
+    # engine: today it resolves OFF — acceptance is workload-dependent
+    # and the autotuner (ROADMAP item 4) is meant to flip it from the
+    # measured acceptance_rate/draft-length profiles this engine
+    # records.  Forced off (one shared needs-resident-weights gate with
+    # decode_burst) under weight_stream, and incompatible with
+    # decode_burst > 1 ("on" raises; "auto" quietly defers to bursts —
+    # both paths multi-token the decode, bursts device-side).
+    spec_decode: str = "auto"
+    # widest draft window per sequence per verify step; the proposer
+    # may draft fewer (budget/context capped), and an empty draft
+    # degrades the row to a plain 1-token decode
+    spec_max_draft: int = 4
     # overload policy (inference/overload.py, docs/SERVING.md "Surviving
     # overload"): bounded admission queue + shed policy, priority /
     # deadline-aware scheduling with anti-starvation aging,
@@ -165,6 +190,16 @@ class _InFlight(NamedTuple):
     # sequence with an uncollected scheduled step is never a preemption
     # victim — its KV blocks are still being written
     uids: Tuple[int, ...] = ()
+    # speculative verify windows this step carries: uid -> the drafted
+    # token tuple (the window is [fed token, *drafts]).  Acceptance is
+    # decided at collect by prefix-comparing the drafts against the
+    # [S, W] sample array; frozen here because the proposer's state
+    # moves on while the step is in flight
+    drafts: Tuple[Tuple[int, Tuple[int, ...]], ...] = ()
+    # the sampling stop token at dispatch time: a stop landing INSIDE
+    # an accepted draft truncates the emission at collect exactly where
+    # the stepwise engine would have stopped feeding
+    stop: Optional[int] = None
 
 
 class InferenceEngine:
@@ -247,15 +282,21 @@ class InferenceEngine:
         self._pstep_fns: Dict[tuple, object] = {}  # (bucket, sampler_key)
         self._burst_fns: Dict[tuple, object] = {}
         self._steps_done = 0
+        # --- model-free speculative decoding (spec_decode.py) ----------
+        self._setup_spec_decode()
         # pipelined-serving state: alternating host staging buffers, the
         # last dispatched step's on-device sample array (the feedback
         # source for the next step), and a zero fallback for step 0
         self._stager = BatchStager(self.icfg.token_budget,
                                    self.icfg.max_seqs,
                                    self.icfg.num_kv_blocks,
-                                   depth=max(2, self.icfg.pipeline_depth))
-        self._zero_toks = self._stage(
-            jnp.zeros(self.icfg.max_seqs, jnp.int32))
+                                   depth=max(2, self.icfg.pipeline_depth),
+                                   n_verify=self._n_verify)
+        # spec engines' steps return [S, W] windows, so the feedback
+        # operand (and its step-0 zero fallback) is window-shaped too
+        self._zero_toks = self._stage(jnp.zeros(
+            (self.icfg.max_seqs,) if self._n_verify == 1
+            else (self.icfg.max_seqs, self._n_verify), jnp.int32))
         self._last_toks = None
         self._dispatch_seq = 0
         self._fb_step: Dict[int, int] = {}   # uid -> sid its marker defers to
@@ -309,6 +350,27 @@ class InferenceEngine:
             "generated_tokens": reg.counter(
                 "serving_generated_tokens_total",
                 "tokens emitted to live sequences", int_valued=True),
+            # speculative decoding (docs/SERVING.md "Speculative
+            # decoding"): drafted = proposer tokens a verify window
+            # scored; accepted = drafts committed (they match the
+            # model's own stream and were emitted); rejected = drafts
+            # rolled back.  drafted == accepted + rejected, and the
+            # per-request records bump at the SAME statements, so
+            # sum(per-request) reconciles with these by construction
+            # (tests/test_spec_decode.py holds the invariant)
+            "spec_drafted_tokens": reg.counter(
+                "serving_spec_drafted_tokens_total",
+                "draft tokens scored by verify steps", int_valued=True),
+            "spec_accepted_tokens": reg.counter(
+                "serving_spec_accepted_tokens_total",
+                "draft tokens accepted and emitted", int_valued=True),
+            "spec_rejected_tokens": reg.counter(
+                "serving_spec_rejected_tokens_total",
+                "draft tokens rolled back", int_valued=True),
+            "spec_windows": reg.counter(
+                "serving_spec_windows_total",
+                "verify windows resolved (mean accepted draft length = "
+                "accepted / windows)", int_valued=True),
         }
         self.timings = CounterDictView({**ms, **ints})
 
@@ -513,10 +575,62 @@ class InferenceEngine:
                 for qt in grp.values())
         store.spill(record)
         self._stream = store
+        self._force_resident_weight_modes()
+
+    def _force_resident_weight_modes(self) -> None:
+        """THE needs-resident-weights gate: every decode mode that runs
+        multiple model invocations per host round trip — device-side
+        bursts (the scan feeds weights per iteration) and speculative
+        verify windows (worthless when each layer streams from NVMe at
+        step latency anyway) — is forced off in ONE place when
+        ``weight_stream`` keeps block weights non-resident.  New modes
+        with the same requirement belong here, not in a copy-pasted
+        warning branch."""
+        forced = {}
         if self.icfg.decode_burst > 1:
-            logger.warning("weight_stream: decode bursts need resident "
-                           "weights; forcing decode_burst=1")
-            self.icfg = dataclasses.replace(self.icfg, decode_burst=1)
+            forced["decode_burst"] = 1
+        if self.icfg.spec_decode == "on":
+            # "auto" stays untouched: it resolves off today, silently —
+            # an auto that learns to turn itself on (ROADMAP item 4)
+            # must consult this gate in _setup_spec_decode
+            forced["spec_decode"] = "off"
+        if forced:
+            logger.warning(
+                "weight_stream: "
+                + " and ".join(f"{k}={getattr(self.icfg, k)!r}"
+                               for k in forced)
+                + (" need" if len(forced) > 1 else " needs")
+                + " resident weights; forcing "
+                + ", ".join(f"{k}={v!r}" for k, v in forced.items()))
+            self.icfg = dataclasses.replace(self.icfg, **forced)
+
+    def _setup_spec_decode(self) -> None:
+        """Resolve the ``spec_decode`` config to a proposer (or None)
+        and the engine's fixed verify-window width ``_n_verify``
+        (``spec_max_draft + 1`` when on, else 1 — which keeps every
+        compiled program byte-identical to a pre-spec engine)."""
+        mode = self.icfg.spec_decode
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(f"spec_decode={mode!r}: expected 'auto', "
+                             "'on', or 'off'")
+        if mode == "on" and self.icfg.decode_burst > 1:
+            raise ValueError(
+                "spec_decode='on' with decode_burst > 1: both multi-token"
+                " the decode path (bursts device-side); pick one")
+        # "auto" currently resolves OFF: draft acceptance is workload-
+        # dependent, and the per-request acceptance_rate / draft-length
+        # profiles recorded below are exactly the measured signal the
+        # autotuner (ROADMAP item 4) needs to flip this from data
+        on = mode == "on"
+        self._spec = None
+        self._n_verify = 1
+        if on:
+            if self.icfg.spec_max_draft < 1:
+                raise ValueError("spec_max_draft must be >= 1")
+            from .spec_decode import NgramProposer
+            self._spec = NgramProposer(self.icfg.spec_max_draft)
+            self._n_verify = self.icfg.spec_max_draft + 1
+        self._sched_drafts: Dict[int, List[int]] = {}
 
     def _place_default_device(self) -> None:
         """Ship weights to the serving device if they were built on
@@ -915,6 +1029,11 @@ class InferenceEngine:
             self._deadline_uids.add(uid)
         self.requests.on_arrival(uid, now)
         self._pending.setdefault(uid, []).extend(toks)
+        if self._spec is not None:
+            # seed the prompt-lookup history with the prompt (emitted
+            # tokens are observed at collect; continuation puts carry
+            # tokens the history already holds)
+            self._spec.observe(uid, toks)
         return AdmissionVerdict(
             True, "degraded" if action == "degrade" else "queued",
             evicted_uids=victims)
@@ -959,6 +1078,8 @@ class InferenceEngine:
         self._deadline_uids.discard(uid)
         self._preempt_gen.pop(uid, None)
         self._ctx_exhausted.discard(uid)
+        if self._spec is not None:
+            self._spec.forget(uid)
         self.requests.on_finish(uid, status=status)
 
     def _on_state_release(self, uid: int) -> None:
@@ -1044,6 +1165,7 @@ class InferenceEngine:
         bs = self.icfg.kv_block_size
         ocfg = self.ocfg
         now = time.perf_counter()
+        self._sched_drafts = {}
         self._reap_deadlines(now)
         # blocks/slots promised to earlier admits this round but only
         # allocated for real in build_batch
@@ -1087,23 +1209,44 @@ class InferenceEngine:
                     seq = self.state.seqs[uid]
                     needs_slot = False     # match_prefix claimed the slot
                     ctx_rem = self.state.context_remaining(uid)
+            draft: List[int] = []
+            if (self._spec is not None and seq is not None
+                    and len(toks) == 1 and toks[0] >= 0
+                    and not seq.draft_len):
+                # decoding row with a concrete fed token: mine a draft
+                # window from the request's own history.  Drafted tokens
+                # are REAL budget/block consumers (the window writes KV
+                # like a chunked prefill), so it is capped by the step's
+                # leftover budget and context headroom alongside
+                # spec_max_draft — drafts compete with prefill chunks
+                # for the same fixed SplitFuse budget
+                limit = min(self._n_verify - 1, budget - 1, ctx_rem - 1)
+                if limit > 0:
+                    draft = self._spec.propose(uid, toks[0], limit)
             n = min(len(toks), budget, ctx_rem)
             if len(toks) > 1 and ocfg.prefill_chunk is not None:
                 # chunked prefill: a prompt takes at most one chunk of
                 # this step's budget; the remainder waits its turn while
                 # other prefills (and every decode) share the step
                 n = min(n, ocfg.prefill_chunk)
+            nw = n + len(draft)       # scheduled window incl. drafts
             avail = self.state.allocator.free_blocks - reserved_blocks
             need = 0
-            while n > 0:
+            while nw > 0:
                 seen = seq.seen_tokens if seq else 0
                 have = len(seq.blocks) if seq else 0
-                need = max(0, -(-(seen + n) // bs) - have)
+                need = max(0, -(-(seen + nw) // bs) - have)
                 if need <= avail:
                     break
-                n //= 2
-            if n <= 0 and not cached:
-                return "starved"
+                nw //= 2
+            if nw <= 0:
+                if not cached:
+                    return "starved"
+                draft, n = [], 0
+            elif nw <= n:
+                draft, n = [], nw     # pool pressure ate the window
+            else:
+                del draft[nw - n:]
             tm = self.timings
             tm["prompt_tokens"] += prompt_len
             if cached:
@@ -1119,10 +1262,12 @@ class InferenceEngine:
                 # matched but the pool can't take the uncached remainder
                 # yet: the sequence keeps its aliased blocks and waits
                 return "ok"
-            sched.append((uid, toks[:n]))
+            sched.append((uid, toks[:n] + draft))
             sched_uids.add(uid)
+            if draft:
+                self._sched_drafts[uid] = draft
             del toks[:n]
-            budget -= n
+            budget -= n + len(draft)
             reserved_blocks += need
             if needs_slot:
                 reserved_slots += 1
@@ -1280,11 +1425,18 @@ class InferenceEngine:
         """Run one engine step; returns {uid: next_token} for sequences
         whose last pending token was consumed (i.e. ready to sample).
         Strict-sync form of the pipeline: dispatch, then read straight
-        back (generate() at ``pipeline_depth>=2`` interleaves these)."""
+        back (generate() at ``pipeline_depth>=2`` interleaves these).
+
+        With ``spec_decode`` on, a step may emit SEVERAL tokens for a
+        sequence (an accepted verify window); the returned token is the
+        LAST one — exactly the right continuation to feed back via
+        ``put`` — and the full stream accumulates on the sequence
+        (``query()["generated"]``).  The generate() drivers consume the
+        full per-step lists internally."""
         st = self._dispatch(sampling, rng)
         if st is None:
             return {}
-        return self._collect(st)
+        return {u: ts[-1] for u, ts in self._collect(st).items()}
 
     @staticmethod
     def _rng_drawer(rng: Optional[jax.Array]):
@@ -1335,8 +1487,11 @@ class InferenceEngine:
         self._pstep_fns[key] = step_fn    # reinsert: LRU, not FIFO
         t1 = time.perf_counter()
         batch = self._stage(
-            self.state.build_batch(sched, self.icfg.token_budget,
-                                   stager=self._stager))
+            self.state.build_batch(
+                sched, self.icfg.token_budget, stager=self._stager,
+                draft_lens={u: len(d)
+                            for u, d in self._sched_drafts.items()},
+                n_verify=self._n_verify))
         self._drain_cow()       # COW copies land before the step's write
         t2 = time.perf_counter()
         if callable(rng):
@@ -1394,7 +1549,10 @@ class InferenceEngine:
             self._inflight_sched[uid] = self._inflight_sched.get(uid, 0) + 1
         self._dispatch_seq += 1
         return _InFlight(toks=toks, emit=emit, sid=self._dispatch_seq,
-                         uids=uids)
+                         uids=uids,
+                         drafts=tuple((u, tuple(d)) for u, d in
+                                      self._sched_drafts.items()),
+                         stop=sampling.stop_token)
 
     def _drain_cow(self) -> None:  # tpulint: serving-loop
         """Execute queued copy-on-write block copies (a prefix-cache
@@ -1434,13 +1592,27 @@ class InferenceEngine:
         return np.asarray(arr)  # tpulint: disable=serving-sync
 
     def _collect(self, st: _InFlight
-                 ) -> Dict[int, int]:  # tpulint: serving-loop
-        """Read one in-flight step's tokens back and emit them; patches
+                 ) -> Dict[int, List[int]]:  # tpulint: serving-loop
+        """Read one in-flight step's tokens back and emit them (a LIST
+        per uid: one token for a plain decode/prefill row, up to
+        ``1 + spec_max_draft`` for a resolved verify window); patches
         any still-deferred feedback marker THIS step owns to the concrete
         value (a later batch built after this read must never reference a
         stale device sample array).  Markers owned by a newer in-flight
         step — the same sequence sampled again before this read — are
-        left for that step's collect."""
+        left for that step's collect.
+
+        Speculative acceptance happens HERE (accept-longest-matching-
+        prefix): a drafting row's [W] sample column ``j`` is the model's
+        token after window position ``j``, so the drafts ``d_1..d_k``
+        are compared against samples ``0..k-1`` — ``a`` leading matches
+        emit ``a + 1`` tokens (the accepted drafts ARE samples
+        ``0..a-1``, plus sample ``a``, the model's "bonus" token
+        computed with every accepted draft already in context) and
+        ``resolve_draft`` rewinds the KV write cursor over the rejected
+        tail.  A stop token landing inside the window truncates the
+        emission exactly where the stepwise engine would have stopped
+        feeding, and the commit rolls back to it."""
         for uid in st.uids:
             n = self._inflight_sched.get(uid, 0) - 1
             if n > 0:
@@ -1459,23 +1631,56 @@ class InferenceEngine:
         if tr.enabled:
             tr.record("wait", t0, t1, track="wait", sid=st.sid)
             tr.record("readback", t1, t2, track="readback", sid=st.sid)
-        out: Dict[int, int] = {}
+        spec = self._n_verify > 1
+        drafts = dict(st.drafts)
+        out: Dict[int, List[int]] = {}
         for uid, slot in st.emit:
-            tok = int(toks_np[slot])
+            row = toks_np[slot]        # [W] on a spec engine, else 0-d
             seq = self.state.seqs.get(uid)
-            if seq is not None and self.state._slots.get(uid) == slot:
-                seq.tokens.append(tok)
+            live = seq is not None and self.state._slots.get(uid) == slot
+            d = drafts.get(uid)
+            if d:
+                a = 0
+                while a < len(d) and int(row[a]) == d[a]:
+                    a += 1
+                emitted = [int(row[j]) for j in range(a + 1)]
+                if st.stop is not None and st.stop in emitted:
+                    # stop inside the window: everything past it was
+                    # never fed by a stepwise engine — roll it back too
+                    emitted = emitted[:emitted.index(st.stop) + 1]
+                if live:
+                    # commit fed token + the emitted tokens already in
+                    # KV (all but the bonus sample); rewind the rest
+                    self.state.resolve_draft(uid, len(emitted) - 1)
+                    # spec accounting — engine counters and the request
+                    # record move at the same statements so
+                    # sum(per-request) reconciles by construction
+                    tm["spec_windows"] += 1
+                    tm["spec_drafted_tokens"] += len(d)
+                    tm["spec_accepted_tokens"] += len(emitted) - 1
+                    tm["spec_rejected_tokens"] += len(d) - (len(emitted)
+                                                            - 1)
+                    self.requests.on_draft(uid, len(d), len(emitted) - 1)
+            else:
+                emitted = [int(row[0] if spec else row)]
+            if live:
+                seq.tokens.extend(emitted)
                 # emitted to a live sequence: the engine generated-token
                 # counter and the request record move together (parity
                 # invariant, tests/test_telemetry.py)
-                tm["generated_tokens"] += 1
-                self.requests.on_tokens(uid, 1, t2)
-            out[uid] = tok
+                tm["generated_tokens"] += len(emitted)
+                self.requests.on_tokens(uid, len(emitted), t2)
+                if self._spec is not None:
+                    self._spec.observe(uid, emitted)
+            out[uid] = emitted
             if self._fb_step.get(uid) == st.sid:
                 self._fb_step.pop(uid)
                 p = self._pending.get(uid)
                 if p and p[0] == FEEDBACK_TOKEN:
-                    p[0] = tok
+                    # the marker's value is the NEXT fed token = the
+                    # last emitted one (markers are never speculated
+                    # for drafting rows, so this is column 0's sample)
+                    p[0] = emitted[-1]
         return out
 
     # ------------------------------------------------------------------
@@ -1693,8 +1898,10 @@ class InferenceEngine:
                 outs = self.decode_burst(burst, sampling=sampling,
                                          rng=draw() if draw else None)
             else:
-                outs = {u: [t] for u, t in
-                        self.step(rng=draw, sampling=sampling).items()}
+                # dispatch + collect directly: a verify window's step
+                # emits a LIST per uid and every token must reach done
+                st = self._dispatch(sampling, draw)
+                outs = self._collect(st) if st is not None else {}
             # sequences that hit the context limit end their generation
             for uid in list(self._ctx_exhausted):
                 if uid in active:
@@ -1770,9 +1977,22 @@ class InferenceEngine:
                 if st is None:
                     break
                 # speculate continuations for this step's sampled seqs
+                draft_uids = {u for u, _ in st.drafts}
                 for uid, _slot in st.emit:
                     if uid not in active:
                         continue               # put() outside generate()
+                    if uid in draft_uids:
+                        # a verify window's next fed token depends on
+                        # host-side acceptance — its collect puts the
+                        # concrete continuation instead of a marker
+                        continue
+                    if self._spec is not None \
+                            and self._spec.lookahead(uid):
+                        # predictable stream: trade the dispatch-ahead
+                        # marker for one pipeline bubble so the collect
+                        # can anchor a draft window on the concrete
+                        # token (up to spec_max_draft tokens next step)
+                        continue
                     counts[uid] += 1
                     if counts[uid] >= sampling.max_new_tokens:
                         continue               # finishes by count at emit
@@ -1781,16 +2001,34 @@ class InferenceEngine:
             if inflight:
                 stall = 0
                 out = self._collect(inflight.popleft())
-                for uid, tok in out.items():
+                for uid, toks in out.items():
                     if uid not in active:
                         continue               # stopped earlier: discard
-                    done[uid].append(tok)
-                    stop = (sampling.stop_token is not None
-                            and tok == sampling.stop_token)
-                    if stop or len(done[uid]) >= sampling.max_new_tokens:
+                    finished = False
+                    for tok in toks:
+                        done[uid].append(tok)
+                        stop = (sampling.stop_token is not None
+                                and tok == sampling.stop_token)
+                        if stop or len(done[uid]) \
+                                >= sampling.max_new_tokens:
+                            finished = True
+                            break
+                    if finished:
                         active.discard(uid)
                         finishing.discard(uid)
                         self.flush(uid)
+                    elif not self._pending.get(uid) \
+                            and uid not in finishing \
+                            and not self._inflight_sched.get(uid, 0):
+                        # no marker was speculated (drafting or
+                        # lookahead-positive row) and no NEWER step is
+                        # in flight for this sequence (an older step's
+                        # collect must never restart a stream a later
+                        # dispatch already continues): feed the concrete
+                        # tail token; the next schedule may anchor a
+                        # draft window on it
+                        self.put(uid, [toks[-1]])
+                        counts[uid] = len(done[uid])
             # ctx-exhausted seqs end once no in-flight step still holds
             # their final token
             for uid in list(finishing):

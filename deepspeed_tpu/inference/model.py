@@ -29,7 +29,7 @@ from ..compat import shard_map
 from ..models import layers as L
 from ..models.transformer import TransformerConfig, _norm
 from .ragged.state import RaggedBatch
-from .sampler import row_keys
+from .sampler import row_keys, window_keys
 
 
 _KV_QMAX = {jnp.dtype(jnp.int8): 127.0,
@@ -443,9 +443,15 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
         x, new_kv = jax.lax.scan(block, x, (kv, layer_ids))
 
     # logits only at each sequence's last scheduled token
-    # (reference kernel: gather_for_logits / logits_gather)
-    idx = jnp.maximum(batch.logits_idx, 0)
-    last = x[idx]                                                  # [S, dm]
+    # (reference kernel: gather_for_logits / logits_gather) — or, on a
+    # speculative verify batch, at every position of each sequence's
+    # draft window ([S, W] gather; -1 pads read token 0 and produce
+    # garbage rows the caller masks, exactly like logits_idx == -1)
+    if batch.verify_idx is not None:
+        idx = jnp.maximum(batch.verify_idx, 0)                 # [S, W]
+    else:
+        idx = jnp.maximum(batch.logits_idx, 0)
+    last = x[idx]                                            # [S(,W), dm]
     last = norm(params["ln_f"], last)
     if cfg.tie_embeddings:
         logits = last @ embed_tab["table"].astype(dt).T
@@ -476,15 +482,39 @@ def pipelined_ragged_step(cfg: TransformerConfig, params, quant, kv,
     (greedy ignores them and XLA drops the fold).  Returns (sampled
     tokens [max_seqs] i32, new_kv); rows of the token output whose
     ``batch.logits_idx`` is -1 are garbage (callers mask by the
-    schedule, exactly like the logits of :func:`ragged_forward`)."""
+    schedule, exactly like the logits of :func:`ragged_forward`).
+
+    On a speculative verify batch (``batch.verify_idx`` [S, W] present)
+    the step samples EVERY window position and returns [S, W] tokens:
+    column ``j`` is the model's choice for the token AFTER window
+    position ``j``, keyed by ``fold_in(fold_in(rng, uid), pos_j + 1)``
+    — the identical fold the single-sample path applies, so column 0 of
+    a non-drafting row is bit-for-bit the legacy sample and a drafting
+    row's columns reproduce the exact non-speculative stream
+    (acceptance is a host-side prefix compare at collect).
+    ``prev_toks`` may then be the previous verify step's [S, W] output;
+    feedback reads its column 0 (markers are only ever speculated for
+    non-drafting rows, whose sample lives there)."""
     fb = batch.feedback_src
     if fb is not None:
-        tok = jnp.where(fb >= 0, prev_toks[jnp.maximum(fb, 0)],
+        prev = prev_toks if prev_toks.ndim == 1 else prev_toks[:, 0]
+        tok = jnp.where(fb >= 0, prev[jnp.maximum(fb, 0)],
                         batch.token_ids)
         batch = batch._replace(token_ids=tok)
     logits, new_kv = ragged_forward(cfg, params, kv, batch, block_size,
                                     max_blocks_per_seq, quant=quant,
                                     **fw_kwargs)
+    if batch.verify_idx is not None:
+        S, W = batch.verify_idx.shape
+        vidx = jnp.maximum(batch.verify_idx, 0)
+        # window column j holds the token AT sequence position
+        # positions[vidx]; its sample therefore lands at position + 1 —
+        # the same "context length after the token" index row_keys folds
+        wpos = batch.positions[vidx] + 1                       # [S, W]
+        keys = window_keys(rng, batch.seq_uids, wpos)
+        flat = sample_fn(logits.reshape(S * W, -1),
+                         keys.reshape((S * W,) + keys.shape[2:]))
+        return flat.reshape(S, W), new_kv
     keys = row_keys(rng, batch.seq_uids, batch.context_lens)
     return sample_fn(logits, keys), new_kv
 

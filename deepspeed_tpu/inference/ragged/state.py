@@ -103,6 +103,14 @@ class SequenceDescriptor:
     # per-full-block rolling hashes (parallel to ``blocks``' prefix);
     # pre-seeded by a prefix match, extended as chain blocks fill
     hashes: List[bytes] = dataclasses.field(default_factory=list)
+    # speculative-decode state: number of DRAFTED tokens in the most
+    # recent scheduled step whose acceptance has not resolved yet.
+    # While nonzero, the last ``draft_len`` chain tokens / KV rows are
+    # provisional: prefix-cache registration is deferred (a shared
+    # block must never contain tokens that may roll back) and
+    # :meth:`StateManager.resolve_draft` either commits them or rewinds
+    # the write cursor.
+    draft_len: int = 0
 
     def blocks_needed(self, new_tokens: int, block_size: int) -> int:
         total = self.seen_tokens + new_tokens
@@ -134,6 +142,16 @@ class RaggedBatch(NamedTuple):
                                  # empty).  Feeds the schedule-invariant
                                  # per-(uid, position) sampling keys —
                                  # see sampler.sample_rows
+    verify_idx: Optional[jnp.ndarray] = None
+                                 # [max_seqs, n_verify] i32: flat token
+                                 # indices of each slot's speculative
+                                 # verify window (-1 pad).  Column j of
+                                 # a drafting row is the fed token
+                                 # (j=0) / j-th draft; column 0 of a
+                                 # non-drafting row is its logits_idx.
+                                 # Present only on verify-step batches
+                                 # (None keeps the legacy single-sample
+                                 # program byte-identical)
 
 
 class BatchStager:
@@ -147,14 +165,18 @@ class BatchStager:
     get ``depth`` sets."""
 
     def __init__(self, token_budget: int, max_seqs: int, max_blocks: int,
-                 depth: int = 2):
+                 depth: int = 2, n_verify: int = 1):
         self.shape_key = (token_budget, max_seqs, max_blocks)
-        self._bufs = [self._alloc(token_budget, max_seqs, max_blocks)
+        # widest speculative verify window this engine may stage
+        # (spec_max_draft + 1); batches slice the columns they use
+        self.n_verify = max(1, n_verify)
+        self._bufs = [self._alloc(token_budget, max_seqs, max_blocks,
+                                  self.n_verify)
                       for _ in range(max(2, depth))]
         self._i = 0
 
     @staticmethod
-    def _alloc(T: int, S: int, nb: int) -> Dict[str, np.ndarray]:
+    def _alloc(T: int, S: int, nb: int, nv: int) -> Dict[str, np.ndarray]:
         return {
             "token_ids": np.zeros(T, np.int32),
             "positions": np.zeros(T, np.int32),
@@ -164,6 +186,7 @@ class BatchStager:
             "logits_idx": np.full(S, -1, np.int32),
             "feedback_src": np.full(T, -1, np.int32),
             "seq_uids": np.zeros(S, np.uint32),
+            "verify_idx": np.full((S, nv), -1, np.int32),
         }
 
     def next_buffers(self) -> Dict[str, np.ndarray]:
@@ -178,6 +201,7 @@ class BatchStager:
         b["logits_idx"].fill(-1)
         b["feedback_src"].fill(-1)
         b["seq_uids"].fill(0)
+        b["verify_idx"].fill(-1)
         return b
 
 
@@ -412,6 +436,43 @@ class StateManager:
             seq.blocks.extend(self.allocator.allocate(need))
         return True
 
+    def resolve_draft(self, uid: int, accepted: int) -> int:
+        """Resolve a speculative verify step for ``uid``: commit the
+        ``accepted`` leading draft tokens and REWIND the write cursor
+        over the rejected tail (the engine's accept-longest-matching-
+        prefix check decides ``accepted``; docs/SERVING.md "Speculative
+        decoding").
+
+        The rejected rows' KV stays physically in place but becomes
+        dead weight the very next scheduled token overwrites: rollback
+        is just ``seen_tokens``/chain truncation, no device work.  The
+        trailing blocks allocated for the rejected rows are kept — they
+        are private by construction (registration was deferred while
+        the draft was unresolved, so no other sequence can alias them)
+        and the growing sequence refills them.  Prefix-cache
+        registration of chain blocks completed by the window happens
+        HERE, post-rollback, so the index only ever maps hashes to
+        committed content.
+
+        Returns the number of rejected tokens rolled back (0 when the
+        sequence died mid-flight or carried no unresolved draft —
+        idempotent by construction)."""
+        seq = self.seqs.get(uid)
+        if seq is None or not seq.draft_len:
+            return 0
+        k = seq.draft_len
+        seq.draft_len = 0
+        if not 0 <= accepted <= k:
+            raise ValueError(f"accepted={accepted} outside 0..{k}")
+        rejected = k - accepted
+        if rejected:
+            seq.seen_tokens -= rejected
+            if not seq.chain_broken:
+                del seq.chain[-rejected:]
+        if self.prefix_cache and not seq.chain_broken:
+            self._register_chain_blocks(seq)
+        return rejected
+
     def advance(self, uid: int, n_tokens: int) -> None:
         """Account tokens written device-side (burst iterations past the
         first host-fed token).  Burst-written KV bypasses build_batch, so
@@ -423,7 +484,9 @@ class StateManager:
 
     # ---- batch building --------------------------------------------------
     def build_batch(self, requests: List[tuple], token_budget: int,
-                    stager: Optional[BatchStager] = None) -> RaggedBatch:
+                    stager: Optional[BatchStager] = None,
+                    draft_lens: Optional[Dict[int, int]] = None,
+                    n_verify: int = 1) -> RaggedBatch:
         """requests: [(uid, list_of_new_token_ids)]; allocates KV blocks and
         produces the padded device metadata.  A token id of
         :data:`FEEDBACK_TOKEN` (single-token decode continuations only)
@@ -431,11 +494,22 @@ class StateManager:
         records the sequence's slot in ``feedback_src`` so the jitted
         step substitutes the previous step's sample.  With ``stager``,
         metadata is written into its alternating pre-allocated buffers
-        instead of fresh arrays."""
+        instead of fresh arrays.
+
+        ``draft_lens``: per-uid count of trailing SPECULATIVE tokens in
+        that request's token list (a decode verify window ``[fed token,
+        draft_1..draft_k]``).  The window's KV rows are written like any
+        chunked prefill, but the sequence is marked draft-pending:
+        prefix-cache registration defers and the engine's collect calls
+        :meth:`resolve_draft` to commit or rewind.  ``n_verify > 1``
+        emits ``verify_idx`` ([max_seqs, n_verify]) so the compiled step
+        samples every window position (-1 pads; non-drafting rows use
+        column 0 = their last token)."""
         max_blocks = self.cfg.num_blocks
         T = token_budget
         if stager is not None \
-                and stager.shape_key == (T, self.max_seqs, max_blocks):
+                and stager.shape_key == (T, self.max_seqs, max_blocks) \
+                and stager.n_verify >= n_verify:
             bufs = stager.next_buffers()
             token_ids = bufs["token_ids"]
             positions = bufs["positions"]
@@ -445,6 +519,7 @@ class StateManager:
             logits_idx = bufs["logits_idx"]
             feedback_src = bufs["feedback_src"]
             seq_uids = bufs["seq_uids"]
+            verify_idx = bufs["verify_idx"]
         else:
             token_ids = np.zeros(T, np.int32)
             positions = np.zeros(T, np.int32)
@@ -457,6 +532,8 @@ class StateManager:
             logits_idx = np.full(self.max_seqs, -1, np.int32)
             feedback_src = np.full(T, -1, np.int32)
             seq_uids = np.zeros(self.max_seqs, np.uint32)
+            verify_idx = np.full((self.max_seqs, max(1, n_verify)), -1,
+                                 np.int32)
 
         # keep existing sequences' tables valid even if not in this batch
         for uid, seq in self.seqs.items():
@@ -471,9 +548,19 @@ class StateManager:
             n = len(new_tokens)
             if n == 0:
                 continue
+            k_draft = draft_lens.get(uid, 0) if draft_lens else 0
+            if k_draft and (k_draft >= n or n_verify <= k_draft):
+                raise ValueError(
+                    f"uid {uid}: {k_draft} drafts need a {k_draft + 1}-"
+                    f"token window and n_verify > {k_draft}")
             if cursor + n > T:
                 raise ValueError(f"token budget {T} exceeded")
             seq = self.get_or_create(uid)
+            if seq.draft_len:
+                raise ValueError(
+                    f"uid {uid}: unresolved draft window "
+                    f"({seq.draft_len} tokens) — resolve_draft must run "
+                    "before more tokens are scheduled")
             if n > self.context_remaining(uid):
                 raise ValueError(
                     f"uid {uid}: {n} new tokens exceed remaining context "
@@ -507,9 +594,22 @@ class StateManager:
             context_lens[s] = seq.seen_tokens
             seq_uids[s] = np.uint32(uid & 0xFFFFFFFF)
             logits_idx[s] = cursor + n - 1
+            if n_verify > 1:
+                # column 0 is always the row's last token (the legacy
+                # sample); a drafting row's window spans its trailing
+                # k_draft + 1 tokens
+                verify_idx[s, 0] = cursor + n - 1
+                if k_draft:
+                    verify_idx[s, :k_draft + 1] = np.arange(
+                        cursor + n - 1 - k_draft, cursor + n)
+                    seq.draft_len = k_draft
             cursor += n
             n_seqs += 1
-            if self.prefix_cache and not seq.chain_broken:
+            if self.prefix_cache and not seq.chain_broken \
+                    and not seq.draft_len:
+                # draft-pending sequences defer registration to
+                # resolve_draft: a shared block must never hold tokens
+                # that may roll back
                 self._register_chain_blocks(seq)
 
         return RaggedBatch(
@@ -522,4 +622,6 @@ class StateManager:
             logits_idx=jnp.asarray(logits_idx),
             n_tokens=cursor, n_seqs=n_seqs,
             feedback_src=jnp.asarray(feedback_src),
-            seq_uids=jnp.asarray(seq_uids))
+            seq_uids=jnp.asarray(seq_uids),
+            verify_idx=(jnp.asarray(verify_idx[:, :n_verify])
+                        if n_verify > 1 else None))

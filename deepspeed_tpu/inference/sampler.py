@@ -75,6 +75,26 @@ def row_keys(rng: jax.Array, uids: jnp.ndarray,
     return jax.vmap(one)(uids, context_lens)
 
 
+def window_keys(rng: jax.Array, uids: jnp.ndarray,
+                positions: jnp.ndarray) -> jnp.ndarray:
+    """[S, W] per-(row, position) sampling keys for a speculative
+    verify window: ``fold_in(fold_in(rng, uid), position)`` where
+    ``positions[s, j]`` is the post-token position of window column
+    ``j`` (the sampled token's index in its sequence).
+
+    EXACTLY the fold :func:`row_keys` applies to a single sampled
+    token, evaluated at every drafted position — so the token a verify
+    column samples is bit-identical to what the non-speculative path
+    would have sampled at the same (uid, position).  That identity is
+    the whole parity argument for speculative decoding: acceptance
+    compares drafts against the very stream a draft-less engine would
+    emit (docs/SERVING.md "Speculative decoding")."""
+    def one_row(u, ps):
+        row_key = jax.random.fold_in(rng, u)
+        return jax.vmap(lambda p: jax.random.fold_in(row_key, p))(ps)
+    return jax.vmap(one_row)(uids, positions)
+
+
 def sample_rows(logits: jnp.ndarray, params: SamplingParams,
                 keys: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """logits [S, V] + per-row keys [S, key] → token ids [S].

@@ -86,6 +86,14 @@ class RequestRecord:
     prompt_tokens: int = 0
     cached_tokens: int = 0
     generated_tokens: int = 0
+    # --- speculative decoding (docs/SERVING.md "Speculative decoding"):
+    # drafts this request's verify windows scored / committed.  Bumped
+    # at the same engine statements as the serving_spec_* counters, so
+    # sum(per-request) == engine counter by construction — and the
+    # per-request acceptance_rate is the measured signal the autotuner
+    # (ROADMAP item 4) needs to drive spec_decode="auto" from data.
+    drafted_tokens: int = 0
+    accepted_tokens: int = 0
 
     @property
     def queue_wait_ms(self) -> Optional[float]:
@@ -117,16 +125,29 @@ class RequestRecord:
             return None
         return (self.t_finish - self.t_arrival) * 1e3
 
+    @property
+    def acceptance_rate(self) -> Optional[float]:
+        """Accepted / drafted over this request's verify windows; None
+        when no window was ever scored (spec off, or the proposer never
+        matched)."""
+        if not self.drafted_tokens:
+            return None
+        return self.accepted_tokens / self.drafted_tokens
+
     def as_dict(self) -> Dict[str, Any]:
         ms = {k: (None if v is None else round(v, 4))
               for k, v in (("queue_wait_ms", self.queue_wait_ms),
                            ("ttft_ms", self.ttft_ms),
                            ("tpot_ms", self.tpot_ms),
                            ("e2e_ms", self.e2e_ms))}
+        ar = self.acceptance_rate
         return {"uid": self.uid,
                 "prompt_tokens": self.prompt_tokens,
                 "cached_tokens": self.cached_tokens,
                 "generated_tokens": self.generated_tokens,
+                "drafted_tokens": self.drafted_tokens,
+                "accepted_tokens": self.accepted_tokens,
+                "acceptance_rate": None if ar is None else round(ar, 4),
                 "finished": self.t_finish is not None,
                 "status": self.status,
                 "preemptions": self.preemptions,
@@ -169,12 +190,20 @@ class RequestTracker:
         # entry dies with its last evicted record)
         self._last_status: Dict[int, str] = {}
         self._status_refs: Dict[int, int] = {}
+        # cumulative speculative-decode tallies (plain ints, NOT registry
+        # counters — the engine's serving_spec_* counters are the
+        # exported metric; these survive finished-ring eviction so the
+        # aggregate acceptance_rate stays exact over long traffic)
+        self._drafted = 0
+        self._accepted = 0
 
     def clear(self) -> None:
         self.open.clear()
         self.finished.clear()
         self._last_status.clear()
         self._status_refs.clear()
+        self._drafted = 0
+        self._accepted = 0
 
     # ------------------------------------------------------------------
     # lifecycle events (all O(1) dict/float work)
@@ -223,6 +252,19 @@ class RequestTracker:
             self._h_ttft.observe((now - rec.t_arrival) * 1e3)
         rec.t_last_token = now
         rec.generated_tokens += n
+
+    def on_draft(self, uid: int, drafted: int, accepted: int) -> None:
+        """One resolved verify window: ``drafted`` tokens scored,
+        ``accepted`` of them committed (emission also flows through
+        :meth:`on_tokens` — these counters are the speculative overlay,
+        not a second token count)."""
+        rec = self.open.get(uid)
+        if rec is None:
+            return
+        rec.drafted_tokens += drafted
+        rec.accepted_tokens += accepted
+        self._drafted += drafted
+        self._accepted += accepted
 
     def on_preempted(self, uid: int, now: Optional[float] = None) -> None:
         """A running request was evicted and re-queued — NOT terminal:
@@ -286,4 +328,11 @@ class RequestTracker:
             "ttft_ms": self._h_ttft.summary(),
             "tpot_ms": self._h_tpot.summary(),
             "queue_wait_ms": self._h_queue.summary(),
+            # speculative decoding (docs/SERVING.md "Speculative
+            # decoding"): fleet-wide draft tallies + acceptance_rate —
+            # the measured signal ROADMAP item 4's autotuner reads
+            "drafted_tokens": self._drafted,
+            "accepted_tokens": self._accepted,
+            "acceptance_rate": (round(self._accepted / self._drafted, 4)
+                                if self._drafted else None),
         }

@@ -275,6 +275,81 @@ def test_overload_fuzz_invariants(model, seed):
     assert agg["statuses"].get("cancelled", 0) > 0
 
 
+@pytest.mark.parametrize("seed,spec", [(0, "on"), (1, "on"), (2, "on"),
+                                       (3, "off")])
+def test_spec_decode_fuzz_invariants(model, seed, spec):
+    """Speculative decoding in the op mix (docs/SERVING.md "Speculative
+    decoding"): scheduler rounds mine draft windows that consume REAL
+    budget/blocks, and every window is then resolved with a RANDOM
+    accepted count — exercising the write-cursor rollback against the
+    refcounted/COW allocator after every op.  The partition
+    ``referenced + cached_free + free == total`` and the
+    refcount==holders invariant must survive arbitrary accept/reject
+    splits interleaved with prefix-cache hits, flushes, and cancels
+    (``spec="off"`` runs the same trace draft-free as the control)."""
+    r = np.random.RandomState(900 + seed)
+    eng = InferenceEngine(model, InferenceConfig(
+        token_budget=16, max_seqs=3, kv_block_size=8, num_kv_blocks=10,
+        max_seq_len=48, prefix_cache="on",
+        spec_decode=spec, spec_max_draft=3))
+    prefixes = [list(r.randint(1, 128, n)) for n in (8, 16, 24)]
+    next_uid = 0
+    drafted = rolled = 0
+    for _ in range(300):
+        op = r.randint(6)
+        live = list(eng.state.seqs)
+        if op == 0:                          # repetitive prompt (the
+            p = prefixes[r.randint(len(prefixes))]   # proposer's food)
+            eng.put(next_uid, list(p) + list(p[:r.randint(1, 6)]))
+            next_uid += 1
+        elif op == 1 and live:               # decode continuation
+            uid = live[r.randint(len(live))]
+            if not eng._pending.get(uid):
+                # half the feeds repeat the request's own prefix tokens
+                # so the n-gram index actually matches
+                seq = eng.state.seqs[uid]
+                tok = int(seq.chain[r.randint(len(seq.chain))]) \
+                    if seq.chain and r.randint(2) \
+                    else int(r.randint(1, 128))
+                eng.put(uid, [tok])
+        elif op == 2 and live:               # flush a random live seq
+            eng.flush(live[r.randint(len(live))])
+        elif op == 3 and next_uid:           # client cancel, any state
+            eng.cancel(int(r.randint(next_uid)))
+        else:                                # scheduler round
+            sched = eng._schedule()
+            _check_invariants(eng, sched)
+            if sched:
+                eng.state.build_batch(
+                    sched, eng.icfg.token_budget, stager=eng._stager,
+                    draft_lens={u: len(d) for u, d
+                                in eng._sched_drafts.items()},
+                    n_verify=eng._n_verify)
+                # host-only fuzz: no step is dispatched, so play the
+                # engine collect's role — resolve every draft window
+                # with a random accepted prefix length (rollback path)
+                for uid, d in eng._sched_drafts.items():
+                    if uid in eng.state.seqs:
+                        drafted += len(d)
+                        rolled += eng.state.resolve_draft(
+                            uid, int(r.randint(0, len(d) + 1)))
+        _check_pool_accounting(eng)
+        for uid, seq in eng.state.seqs.items():
+            assert seq.draft_len == 0, \
+                f"uid {uid}: unresolved draft window leaked"
+    for uid in list(eng.state.seqs):
+        eng.flush(uid)
+    al = eng.state.allocator
+    al.assert_invariants()
+    assert al.referenced_blocks == 0
+    assert al.free_blocks == al.total_blocks
+    if spec == "on":                # the fuzz walked the new path
+        assert drafted > 0, "fuzz never scheduled a draft window"
+        assert rolled > 0, "fuzz never rolled back a rejected draft"
+    else:
+        assert drafted == 0
+
+
 def test_preempt_resume_prefix_cache_parity(model):
     """Seeded-sampling parity across preemption-by-eviction WITH the
     prefix cache doing the resume: the victim's evicted blocks retire

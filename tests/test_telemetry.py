@@ -494,7 +494,9 @@ class TestEngineTelemetry:
         assert set(tm) == {"schedule_ms", "stage_ms", "device_ms",
                            "wait_ms", "readback_ms", "steps",
                            "prompt_tokens", "cached_tokens",
-                           "prefix_hits", "generated_tokens"}
+                           "prefix_hits", "generated_tokens",
+                           "spec_drafted_tokens", "spec_accepted_tokens",
+                           "spec_rejected_tokens", "spec_windows"}
         assert tm["steps"] > 0 and isinstance(tm["steps"], int)
         assert dict(tm)["steps"] == tm["steps"]
         # the registry sees the same number

@@ -75,6 +75,25 @@ def test_rule_quiet_on_known_good(rule):
         f"false positives on {good.name}: {[f.human() for f in findings]}"
 
 
+def test_draft_window_key_fixtures():
+    """Speculative-decode draft windows sample up to ``1 + k`` positions
+    per sequence per step; the rng rules must catch a verify step that
+    re-consumes one row key across window columns (the bug class
+    ``sampler.window_keys``' per-(uid, position) fold exists to prevent)
+    while staying quiet on the real derivation.  Named off-rule
+    (``*_rng_draft_window``) so the per-rule parametrized fixtures keep
+    their one-bad-one-good pairing; this pair is scenario coverage for
+    rng-discipline."""
+    bad = FIXTURES / "bad_rng_draft_window.py"
+    findings = _lint(bad)
+    assert findings, "rng rules missed the draft-window key reuse"
+    assert {f.rule for f in findings} == {"rng-discipline"}
+    n_bad = sum("# BAD" in line for line in bad.read_text().splitlines())
+    assert len(findings) >= n_bad
+    good = _lint(FIXTURES / "good_rng_draft_window.py")
+    assert good == [], [f.human() for f in good]
+
+
 def test_whole_tree_is_clean_fast_and_jax_free():
     """The enforced gate, all three invariants in ONE whole-tree run
     (the two-pass analyzer costs ~9 s — running it once keeps the gate

@@ -1,0 +1,24 @@
+"""Known-bad: speculative draft-window key derivation that reuses a
+consumed key (tpulint: rng-discipline).  The verify step must key every
+window column with ``fold_in(fold_in(rng, uid), position)`` — one fresh
+fold per sampled position (sampler.window_keys); re-consuming one row
+key across columns replays the same randomness at every draft position.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def window_row_key_reused(rng, uid, logits):
+    """logits [W, V]: every column sampled with the SAME row key."""
+    row_key = jax.random.fold_in(rng, uid)
+    out = []
+    for w in range(logits.shape[0]):
+        out.append(jax.random.categorical(row_key, logits[w]))  # BAD: loop-invariant key
+    return jnp.stack(out)
+
+
+def window_base_key_double_consume(rng, logits0, logits1):
+    """Bonus column sampled off the already-consumed base key."""
+    first = jax.random.categorical(rng, logits0)
+    bonus = jax.random.categorical(rng, logits1)   # BAD: rng already consumed
+    return first + bonus

@@ -1,0 +1,34 @@
+"""Known-good twin of bad_rng_draft_window (no findings): the
+draft-window key derivation the speculative verify step actually uses —
+per-(uid, position) ``fold_in`` chains, one fresh key per sampled
+window column (mirrors sampler.window_keys / model.pipelined_ragged_step).
+"""
+import jax
+import jax.numpy as jnp
+
+
+def window_keys(rng, uids, positions):
+    """[S, W] keys: fold_in(fold_in(rng, uid), position) per column."""
+    def one_row(u, ps):
+        row_key = jax.random.fold_in(rng, u)
+        return jax.vmap(lambda p: jax.random.fold_in(row_key, p))(ps)
+    return jax.vmap(one_row)(uids, positions)
+
+
+def sample_window(rng, uids, positions, logits):
+    """logits [S, W, V] -> tokens [S, W], each column its own key."""
+    S, W, V = logits.shape
+    keys = window_keys(rng, uids, positions)
+    flat = jax.vmap(jax.random.categorical)(
+        keys.reshape((S * W,) + keys.shape[2:]), logits.reshape(S * W, V))
+    return flat.reshape(S, W)
+
+
+def fold_per_column(rng, uid, logits):
+    """Python-loop variant: fold_in of the loop index is the fix."""
+    row_key = jax.random.fold_in(rng, uid)
+    out = []
+    for w in range(logits.shape[0]):
+        k = jax.random.fold_in(row_key, w)
+        out.append(jax.random.categorical(k, logits[w]))
+    return jnp.stack(out)
