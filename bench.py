@@ -234,6 +234,7 @@ def main(trace_path=None):
     prefix = leg(shared_prefix_serving_bench, on_tpu)
     spec = leg(spec_decode_serving_bench, on_tpu)
     overload = leg(overload_serving_bench, on_tpu)
+    chaos = leg(chaos_serving_bench, on_tpu)
     llama_train = leg(llama_train_bench, on_tpu, peak)
     llama_serve = leg(llama8b_serving_bench, on_tpu)
     moe = leg(moe_train_bench, on_tpu, peak)
@@ -245,11 +246,62 @@ def main(trace_path=None):
         "platform": dev.platform,
         "vs_baseline": round(vs_baseline, 4),
         "mfu": round(mfu, 4) if on_tpu else 0.0,
+        # engine version + a digest of the benchmark-relevant config
+        # DEFAULTS: successive BENCH_r* files are only comparable when
+        # these match — a PR that changes a default shifts every leg,
+        # and the hash makes that visible instead of silently skewing
+        # the trajectory (bench_fingerprint())
+        **bench_fingerprint(),
         "train_metrics": train_metrics,
     }
     out.update(serve)
     print(json.dumps({**out, **pipe, **prefix, **spec, **overload,  # tpulint: disable=print — the bench's one JSON output line
-                      **llama_train, **llama_serve, **moe}))
+                      **chaos, **llama_train, **llama_serve, **moe}))
+
+
+def bench_fingerprint():
+    """Version + config-default fingerprint recorded in every BENCH
+    JSON capture: ``engine_version`` and a short digest over the
+    serving/overload/failure config defaults (the knobs whose defaults
+    PRs keep evolving — pipeline depth, donation, prefix cache, spec
+    decode, shed policy, watchdog...).  Two BENCH files with different
+    hashes measured different default engines; compare legs only
+    within a hash."""
+    import dataclasses
+    import hashlib
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.inference import (FailureConfig, InferenceConfig,
+                                         OverloadConfig)
+
+    blob = json.dumps(
+        {cls.__name__: {f.name: repr(getattr(cls(), f.name))
+                        for f in dataclasses.fields(cls)
+                        if f.name not in ("overload", "failure")}
+         for cls in (InferenceConfig, OverloadConfig, FailureConfig)},
+        sort_keys=True)
+    return {"engine_version": ds.__version__,
+            "config_hash": hashlib.blake2b(
+                blob.encode(), digest_size=8).hexdigest()}
+
+
+def chaos_serving_bench(on_tpu: bool):
+    """Fault-tolerance leg (docs/SERVING.md "Failure domains &
+    recovery"): the loadgen chaos smoke — injected crash + watchdog
+    expiry + a uid-targeted poison request + a mid-traffic
+    snapshot/restore warm restart, across greedy/seeded sampling and
+    prefix cache on/off — run as a bench capture.  The acceptance
+    asserts run inside (never deadlocks, never leaks, exactly one
+    terminal status each, unaffected requests token-identical to a
+    fault-free run); the JSON records the per-variant recovery
+    telemetry (retries, failed, restarts, steps)."""
+    from tools.loadgen import chaos_smoke
+
+    out = chaos_smoke(seed=0)
+    return {"chaos_serving": {
+        "ok": out["ok"],
+        "variants": out["variants"],
+    }}
 
 
 def moe_train_bench(on_tpu: bool, peak: float):

@@ -1,4 +1,6 @@
 from .engine import InferenceConfig, InferenceEngine
+from .failures import (DispatchTimeoutError, EngineDeadError,
+                       FailureConfig, InjectedFault, classify_failure)
 from .overload import AdmissionVerdict, OverloadConfig
 from .sampler import SamplingParams, sample
 from .spec_decode import NgramProposer
@@ -9,5 +11,7 @@ from .weight_stream import NVMeWeightStore
 
 __all__ = ["InferenceConfig", "InferenceEngine", "SamplingParams", "sample",
            "OverloadConfig", "AdmissionVerdict", "NgramProposer",
+           "FailureConfig", "EngineDeadError", "DispatchTimeoutError",
+           "InjectedFault", "classify_failure",
            "KVCacheConfig", "StateManager", "RaggedBatch", "BatchStager",
            "FEEDBACK_TOKEN", "BlockedAllocator", "NVMeWeightStore"]

@@ -36,6 +36,10 @@ from ..models.transformer import Model, TransformerConfig
 from ..telemetry import (CounterDictView, MetricsRegistry, RequestTracker,
                          SpanTracer)
 from ..utils.logging import logger
+from .failures import (FATAL_ENGINE, POISON_STEP,
+                       DispatchTimeoutError, EngineDeadError,
+                       FailureConfig, FailurePolicy, InjectedFault,
+                       InjectedTimeout, bisect_groups, classify_failure)
 from .model import pipelined_ragged_step, ragged_forward
 from .overload import (AdmissionVerdict, OverloadConfig, RequestMeta,
                        admission_decision, effective_priority,
@@ -171,6 +175,21 @@ class InferenceConfig:
     # behavior exactly (unbounded queue, no chunk cap, preemption inert
     # while every request shares one priority tier).
     overload: Optional[OverloadConfig] = None
+    # failure-domain policy (inference/failures.py, docs/SERVING.md
+    # "Failure domains & recovery"): every device dispatch/readback
+    # runs under a watchdog deadline (``FailureConfig.
+    # dispatch_timeout_ms`` — "auto" scales it from the observed step
+    # latency in the metrics registry), every raised XLA error or
+    # expiry routes through ONE classifier seam, and the verdict
+    # degrades the failure to a request-level terminal status instead
+    # of a wedged or dead process: transient errors re-queue the batch
+    # with backoff, deterministic step failures bisect the batch until
+    # the poison request is quarantined (terminal status ``failed``),
+    # and a dead backend raises EngineDeadError — from which
+    # ``snapshot()`` + ``InferenceEngine.restore()`` warm-restart the
+    # open work token-identically.  None uses FailureConfig()
+    # defaults (auto watchdog, engaged after a calibration warmup).
+    failure: Optional[FailureConfig] = None
 
 
 # attn-impl probe results, memoized per (backend, shape signature)
@@ -200,6 +219,14 @@ class _InFlight(NamedTuple):
     # an accepted draft truncates the emission at collect exactly where
     # the stepwise engine would have stopped feeding
     stop: Optional[int] = None
+    # prefix-cache (digest, block) entries THIS step's build registered:
+    # their content promise is honored by this step's KV writes, so a
+    # failure at collect must withdraw exactly these (the dispatch-
+    # failure path uses the state manager's live round ledger instead)
+    registered: Tuple[Tuple[bytes, int], ...] = ()
+    # the dispatch rode a first-call program (compile may still be in
+    # flight on async backends): its readback runs unguarded too
+    cold: bool = False
 
 
 class InferenceEngine:
@@ -281,6 +308,10 @@ class InferenceEngine:
         self._cow_fn = None           # lazy jitted prefix-cache block copy
         self._pstep_fns: Dict[tuple, object] = {}  # (bucket, sampler_key)
         self._burst_fns: Dict[tuple, object] = {}
+        # serving programs that have COMPLETED at least one call: only
+        # these run under the dispatch watchdog — a first call may
+        # carry an unboundedly-slow (and legitimate) compile
+        self._warm_keys: set = set()
         self._steps_done = 0
         # --- model-free speculative decoding (spec_decode.py) ----------
         self._setup_spec_decode()
@@ -311,6 +342,17 @@ class InferenceEngine:
         self._closing: Dict[int, str] = {}   # uid -> staged terminal status
         self._reaped: set = set()   # engine-closed uids drivers must drop
         self._setup_telemetry()
+        # --- failure-domain state (inference/failures.py) --------------
+        self.fcfg = self.icfg.failure or FailureConfig()
+        self.failures = FailurePolicy(self.fcfg, self.timings)
+        self._strikes: Dict[int, int] = {}   # uid -> failing-batch count
+        self._probe_groups: List[List[int]] = []  # bisection quarantine
+        self._backoff_rounds = 0             # rounds admitting nothing
+        self._consec_failures = 0
+        self._consec_timeouts = 0
+        self._last_failure_step = -(1 << 30)
+        self._health = "healthy"             # healthy|degraded computed;
+        self._draining = False               # draining|dead are sticky
         # every KV release — flush, preemption, deadline expiry, or a
         # direct StateManager.release — flows through one close-out hook
         # so request_metrics() can never leak an open record
@@ -325,8 +367,15 @@ class InferenceEngine:
         self.metrics = MetricsRegistry()
         self.tracer = SpanTracer(capacity=self.icfg.trace_capacity,
                                  enabled=self.icfg.trace)
-        self.requests = RequestTracker(self.metrics)
+        self.requests = RequestTracker(
+            self.metrics, max_finished=self.ocfg.status_retention)
         reg = self.metrics
+        # health-state gauge (docs/OBSERVABILITY.md): 0 healthy,
+        # 1 degraded, 2 draining, 3 dead — what the multi-replica
+        # router's liveness probe scrapes
+        self._health_gauge = reg.gauge(
+            "serving_health_state",
+            "engine health: 0=healthy 1=degraded 2=draining 3=dead")
         ms = {k: reg.counter(f"serving_{k}_total",
                              f"cumulative serving-loop {k.split('_')[0]} "
                              "phase milliseconds")
@@ -371,6 +420,18 @@ class InferenceEngine:
                 "serving_spec_windows_total",
                 "verify windows resolved (mean accepted draft length = "
                 "accepted / windows)", int_valued=True),
+            # failure domains (docs/SERVING.md "Failure domains &
+            # recovery"): steps the classifier recovered (re-queue /
+            # bisect) and requests quarantined terminally as poison
+            "step_retries": reg.counter(
+                "serving_step_retries_total",
+                "serving steps that failed and were recovered by "
+                "re-queue (retry or bisect probe)", int_valued=True),
+            "requests_failed": reg.counter(
+                "serving_requests_failed_total",
+                "requests terminally closed with status 'failed' "
+                "(poison quarantine / unreplayable after a failure)",
+                int_valued=True),
         }
         self.timings = CounterDictView({**ms, **ints})
 
@@ -991,6 +1052,17 @@ class InferenceEngine:
             self.requests.on_arrival(uid, now)
             self._pending.setdefault(uid, []).extend(toks)
             return AdmissionVerdict(True, "continued")
+        if self._draining or self._health == "dead":
+            # the drain/death contract: admission is stopped for NEW
+            # requests (the continuation branch above still lands —
+            # in-flight work must be able to finish); the record exists
+            # so the router sees shed-at-drain, not silence
+            self.requests.on_arrival(uid, now)
+            self.requests.on_finish(uid, now, status="shed")
+            return AdmissionVerdict(False, "shed",
+                                    reason="engine is "
+                                    + ("dead" if self._health == "dead"
+                                       else "draining"))
         ocfg = self.ocfg
         queued: List[tuple] = []
         if ocfg.max_queued_requests is not None \
@@ -1078,6 +1150,7 @@ class InferenceEngine:
         self._deadline_uids.discard(uid)
         self._preempt_gen.pop(uid, None)
         self._ctx_exhausted.discard(uid)
+        self._strikes.pop(uid, None)
         if self._spec is not None:
             self._spec.forget(uid)
         self.requests.on_finish(uid, status=status)
@@ -1110,10 +1183,12 @@ class InferenceEngine:
         (admitted, waiting for KV — including preempted-and-requeued),
         ``running`` (holds KV), a terminal status (``finished`` /
         ``shed`` / ``cancelled`` / ``deadline_exceeded`` /
-        ``context_exhausted`` / ``released``), or ``unknown`` for a uid
-        the engine never saw (or one whose record aged out of the
-        finished ring) — so load-harness clients can tell shed from
-        done instead of reading silent zeros."""
+        ``context_exhausted`` / ``released`` / ``failed``),
+        ``forgotten`` for a uid whose terminal record aged out of the
+        finished ring (sized by ``OverloadConfig.status_retention``),
+        or ``unknown`` for a uid the engine never saw — so load-harness
+        clients can tell shed from done from a retention miss instead
+        of reading silent zeros."""
         seq = self.state.seqs.get(uid)
         if seq is not None:
             status = "running"
@@ -1167,6 +1242,25 @@ class InferenceEngine:
         now = time.perf_counter()
         self._sched_drafts = {}
         self._reap_deadlines(now)
+        if self._backoff_rounds > 0:
+            # retry backoff after a transient step failure: admit
+            # nothing for a bounded, step-counted number of rounds
+            self._backoff_rounds -= 1
+            return []
+        # bisection quarantine: while probe groups are queued, ONLY the
+        # head group's requests are schedulable — each probe step either
+        # clears its group (success) or bisects it further (failure),
+        # so the poison request is isolated in O(log batch) steps.
+        # Groups whose requests all left the engine (cancel/fail/flush)
+        # are pruned or the quarantine would wedge the scheduler.
+        probe_allowed = None
+        while self._probe_groups:
+            head = [u for u in self._probe_groups[0]
+                    if self._pending.get(u) or u in self.state.seqs]
+            if head:
+                probe_allowed = set(head)
+                break
+            self._probe_groups.pop(0)
         # blocks/slots promised to earlier admits this round but only
         # allocated for real in build_batch
         reserved_blocks = 0
@@ -1283,6 +1377,8 @@ class InferenceEngine:
         for uid, t in self._pending.items():
             if not t:
                 continue
+            if probe_allowed is not None and uid not in probe_allowed:
+                continue
             if t[0] == FEEDBACK_TOKEN \
                     and self._fb_step.get(uid) != self._dispatch_seq:
                 # deferred sample owned by an OLDER still-uncollected
@@ -1342,7 +1438,7 @@ class InferenceEngine:
                 continue
             if self._inflight_sched.get(uid, 0):
                 continue
-            if seq.chain_broken or len(seq.chain) != seq.seen_tokens:
+            if not seq.resumable:
                 continue
             p = self._pending.get(uid)
             if p and p[0] == FEEDBACK_TOKEN:
@@ -1352,20 +1448,22 @@ class InferenceEngine:
                         len(seq.blocks)))
         return out
 
-    def _preempt(self, uid: int) -> None:
-        """Preemption-by-eviction: release the victim's KV back through
-        the refcounted allocator (content-hashed full blocks retire to
-        the cached-free LRU pool, so with the prefix cache on the
-        re-prefill is one aliasing pass, not a recompute) and re-queue
-        its full host-known token stream — KV chain + still-pending
-        concrete tokens — as a prompt.  NOT terminal: the lifecycle
-        record stays open across the eviction (``preemptions`` counts
-        it), and the (uid, position)-folded sampling keys make the
-        resumed output token-identical to an undisturbed run
-        (tests/test_scheduler_fuzz.py parity test)."""
+    def _evict_to_queue(self, uid: int) -> None:
+        """Release ``uid``'s KV back through the refcounted allocator
+        (content-hashed full blocks retire to the cached-free LRU pool,
+        so with the prefix cache on the re-prefill is one aliasing
+        pass, not a recompute) and re-queue its full host-known token
+        stream — KV chain + still-pending concrete tokens — as a
+        prompt.  NOT terminal: the lifecycle record stays open across
+        the eviction, and the (uid, position)-folded sampling keys make
+        the resumed output token-identical to an undisturbed run.  The
+        shared mechanics of preemption-by-eviction AND failure-recovery
+        re-queueing; callers count the event on the lifecycle record
+        themselves (``on_preempted`` vs ``on_retried``)."""
         seq = self.state.seqs[uid]
         requeue = [int(t) for t in seq.chain]
-        tail = [int(t) for t in self._pending.get(uid, [])]
+        tail = [int(t) for t in self._pending.get(uid, [])
+                if t != FEEDBACK_TOKEN]
         if seq.tokens:
             # stash generated-so-far: they become prompt tokens on the
             # re-prefill, but query() keeps reporting the full output
@@ -1378,6 +1476,12 @@ class InferenceEngine:
             self._preempting.discard(uid)
         self._fb_step.pop(uid, None)
         self._pending[uid] = requeue + tail
+
+    def _preempt(self, uid: int) -> None:
+        """Preemption-by-eviction (docs/SERVING.md "Surviving
+        overload"): evict-and-requeue, counted on the record
+        (tests/test_scheduler_fuzz.py parity test)."""
+        self._evict_to_queue(uid)
         self.requests.on_preempted(uid)
 
     def _reap_deadlines(self, now: float) -> None:
@@ -1418,6 +1522,394 @@ class InferenceEngine:
             elif not self._inflight_sched.get(uid, 0):
                 self._finish(uid, "context_exhausted")
                 self._reaped.add(uid)
+
+    # ------------------------------------------------------------------
+    # failure domains (inference/failures.py, docs/SERVING.md "Failure
+    # domains & recovery")
+    # ------------------------------------------------------------------
+    def _ensure_alive(self) -> None:
+        """Refuse device work on a dead engine — ``snapshot()`` still
+        works; ``restore()`` the truth onto a fresh one."""
+        if self._health == "dead":
+            raise EngineDeadError(
+                "serving engine is dead — snapshot() holds the host-side "
+                "truth; InferenceEngine.restore() it onto a fresh engine")
+
+    def _note_step_success(self, uids) -> None:
+        """One completed device step: reset the failure-escalation
+        counters, clear suspicion from every sequence it carried, and
+        exonerate exactly the COVERED part of the head bisection probe
+        group — a clean step carrying only half the group (budget /
+        chunking split it) must not acquit the unprobed other half."""
+        self._consec_failures = 0
+        self._consec_timeouts = 0
+        for uid in uids:
+            self._strikes.pop(uid, None)
+        if self._probe_groups:
+            covered = set(self._probe_groups[0]) & set(uids)
+            if covered:
+                rest = [u for u in self._probe_groups[0]
+                        if u not in covered]
+                if rest:
+                    self._probe_groups[0] = rest
+                else:
+                    self._probe_groups.pop(0)
+
+    def _handle_step_failure(self, exc: BaseException, uids,
+                             phase: str, registered=()) -> None:
+        """Recover from one failed device dispatch/readback: classify
+        the exception at the ONE seam (`classify_failure`) and act on
+        the verdict so the failure degrades to request-level outcomes:
+
+        * ``retry`` — transient: every affected sequence is released
+          and re-queued (the chain re-prefills token-identically, an
+          aliasing pass when the prefix cache holds its blocks) and the
+          scheduler backs off a bounded, step-counted number of rounds.
+        * ``poison`` — deterministic for this batch: same re-queue,
+          plus the batch bisects into probe groups the scheduler runs
+          in isolation; a singleton failing batch is proof and closes
+          that request terminally with status ``failed``.
+        * ``fatal`` — the backend is gone: the engine is marked dead
+          and :class:`EngineDeadError` raised; ``snapshot()`` +
+          ``restore()`` warm-restart the open work elsewhere.
+
+        Exceptions the classifier does not recognize (host programming
+        errors) re-raise untouched.  A sequence whose stream the host
+        cannot replay (broken chain — device-side tokens lost with the
+        failed step) closes as ``failed`` regardless of verdict."""
+        if isinstance(exc, DispatchTimeoutError):
+            self._consec_timeouts += 1
+            if self.failures.watchdog.abandoned \
+                    >= self.fcfg.max_abandoned_workers:
+                # consecutive-expiry escalation resets on every clean
+                # step, so an INTERMITTENTLY hanging device could
+                # strand workers forever — the lifetime cap declares
+                # it dead first
+                self._consec_timeouts = max(self._consec_timeouts,
+                                            self.fcfg.fatal_timeouts)
+        verdict = classify_failure(
+            exc, attempt=self._consec_failures,
+            consecutive_timeouts=self._consec_timeouts, cfg=self.fcfg)
+        if verdict is None:
+            raise exc
+        self._consec_failures += 1
+        self._last_failure_step = self._steps_done
+        logger.warning(
+            f"serving step failure at {phase} "
+            f"({type(exc).__name__}: "
+            f"{(str(exc).splitlines() or [''])[0][:120]}) -> {verdict}")
+        if verdict == FATAL_ENGINE:
+            self._health = "dead"
+            self._health_gauge.set(3)
+            raise EngineDeadError(
+                f"serving backend dead after {type(exc).__name__} at "
+                f"{phase}; snapshot() holds the host-side truth — "
+                "restore onto a fresh engine") from exc
+        tm = self.timings
+        tm["step_retries"] += 1
+        affected = [int(u) for u in uids]
+        # an INJECTED fault (crash or synthetic timeout) raises before
+        # the guarded call runs, so the cache buffer is untouched.  A
+        # real device error — and a REAL watchdog expiry, whose
+        # abandoned call already consumed the donated cache operand —
+        # may have invalidated it: conservatively re-queue EVERY live
+        # sequence and rebuild a zero pool (chains re-prefill the
+        # truth; the prefix index must drop with the content it hashed)
+        kv_lost = not isinstance(exc, (InjectedFault,
+                                       InjectedTimeout)) \
+            and self._donate_kv()
+        if kv_lost:
+            affected = list(dict.fromkeys(list(self.state.seqs)
+                                          + affected))
+        singleton = verdict == POISON_STEP and len(affected) == 1
+        requeued: List[int] = []
+        # recovery below may register post-rollback blocks into the
+        # LIVE ledger (resolve_draft); those writes rode the failed
+        # step, so they are withdrawn alongside ``registered`` — but a
+        # NEWER in-flight step's ledger entries (depth-2 collect
+        # failure) are its own and must survive
+        pre_recovery = len(self.state.round_registered)
+        for uid in affected:
+            self._strikes[uid] = self._strikes.get(uid, 0) + 1
+            seq = self.state.seqs.get(uid)
+            if seq is not None and seq.draft_len:
+                # drafts in the failed window were never verified:
+                # reject them all before judging the chain
+                self.state.resolve_draft(uid, 0)
+                seq = self.state.seqs.get(uid)
+            poison = singleton \
+                or self._strikes[uid] >= self.fcfg.poison_strikes
+            # a sequence with ANOTHER dispatched-but-uncollected step
+            # (depth>=2 chunked prefill spanning two in-flight steps)
+            # cannot be re-queued: the surviving step would emit from a
+            # context the re-queue is about to regenerate (duplicate /
+            # garbage tokens).  Terminal is the one honest outcome —
+            # same conservatism as a broken chain.  (The failed step
+            # itself is not counted: dispatch failures never
+            # incremented it, collect failures already decremented.)
+            inflight_elsewhere = self._inflight_sched.get(uid, 0) > 0
+            if poison or inflight_elsewhere \
+                    or (seq is not None and not seq.resumable):
+                tm["requests_failed"] += 1
+                self._finish(uid, "failed")
+                self._reaped.add(uid)
+            else:
+                if seq is not None:
+                    self._evict_to_queue(uid)
+                self.requests.on_retried(uid)
+                requeued.append(uid)
+        # the failed step's KV writes never (reliably) happened: every
+        # prefix-index registration that step made promises content the
+        # pool does not hold — withdraw exactly those entries (plus any
+        # the recovery itself just appended), or a later match would
+        # alias never-written blocks
+        self.state.unregister_blocks(
+            list(registered)
+            + list(self.state.round_registered[pre_recovery:]))
+        if kv_lost:
+            kv = self._kv_zeros()
+            if getattr(self, "_kv_on_host", False):
+                kv = jax.device_put(kv, jax.memory.Space.Host)
+            self.state.kv = kv
+            self.state.reset_prefix_cache()
+            self._last_toks = None
+        # a failed probe step retires its group — but NEVER loses it:
+        # its bisected split (poison) or the group itself (transient
+        # failure mid-quarantine) takes its place, so isolation always
+        # completes and the poison cannot slip back into the pool
+        hit_probe = bool(self._probe_groups) \
+            and bool(set(self._probe_groups[0]) & set(affected))
+        if hit_probe:
+            self._probe_groups.pop(0)
+        if verdict == POISON_STEP and len(requeued) > 1:
+            self._probe_groups = bisect_groups(requeued) \
+                + self._probe_groups
+        else:
+            if requeued and (hit_probe or verdict == POISON_STEP):
+                # a transient keeps the same probe group for retry; a
+                # poison remnant (siblings already failed) probes alone
+                # so its next failure is singleton proof
+                self._probe_groups = [list(requeued)] \
+                    + self._probe_groups
+            # transient: step-counted exponential backoff (determinis-
+            # tic — the chaos replay's op sequence stays machine-
+            # independent), bounded so the loop always makes progress
+            self._backoff_rounds = min(
+                self.fcfg.max_backoff_rounds,
+                1 << min(self._consec_failures - 1, 6))
+
+    def health(self) -> Dict:
+        """Engine health for the router's liveness probe
+        (docs/OBSERVABILITY.md): ``state`` walks
+        ``healthy -> degraded -> (draining | dead)`` — ``degraded``
+        while the most recent step failure is within
+        ``FailureConfig.health_window_steps`` dispatched steps
+        (failure *rates* from the metrics registry drive it, not a
+        latched flag), ``draining``/``dead`` sticky.  Also exported as
+        the ``serving_health_state`` gauge (0/1/2/3) through the
+        Prometheus exposition."""
+        state = self._health
+        if state == "healthy" and self._steps_done \
+                - self._last_failure_step <= self.fcfg.health_window_steps:
+            state = "degraded"
+        self._health_gauge.set(
+            {"healthy": 0, "degraded": 1, "draining": 2,
+             "dead": 3}[state])
+        tm = self.timings
+        return {
+            "state": state,
+            "steps": int(tm["steps"]),
+            "step_retries": int(tm["step_retries"]),
+            "requests_failed": int(tm["requests_failed"]),
+            "consecutive_failures": self._consec_failures,
+            "consecutive_timeouts": self._consec_timeouts,
+            "dispatch_deadline_ms": self.failures.deadline_ms(),
+            "probing": bool(self._probe_groups),
+            "backoff_rounds": self._backoff_rounds,
+            "live": len(self.state.seqs),
+            "queued": sum(1 for t in self._pending.values() if t),
+        }
+
+    def snapshot(self) -> Dict:
+        """Serialize the engine's host-side truth — every open
+        request's replayable token stream (KV chain + still-pending
+        tokens), its generated output so far, and its admission
+        metadata — plus the counters and the prefix-cache index keys
+        (the content hashes: a router's cache-affinity signal, NOT
+        revivable KV).  Device state is deliberately absent: KV blocks
+        re-prefill from the chains on :meth:`restore` (an aliasing pass
+        for streams whose prefixes re-register in the new engine's
+        cache, plain prefill otherwise), and the (uid, position)-folded
+        sampling keys make the resumed outputs token-identical to an
+        uninterrupted run — greedy and seeded (reuse the same explicit
+        base key), prefix cache on or off.
+
+        Valid on a DEAD engine (host truth survives the backend) —
+        that is the warm-restart story: catch
+        :class:`EngineDeadError`, ``snapshot()``, ``restore()``.  Take
+        it at a step boundary (no dispatched-but-uncollected step); a
+        sequence whose stream the host cannot replay (broken chain —
+        decode bursts, an in-flight feedback marker) is recorded
+        ``exact: False`` and closed ``failed`` at restore."""
+        from .. import __version__
+        reqs = []
+        order = dict.fromkeys(list(self._meta) + list(self._pending)
+                              + list(self.state.seqs))
+        now = time.perf_counter()
+        for uid in order:
+            seq = self.state.seqs.get(uid)
+            pend = [int(t) for t in self._pending.get(uid, [])]
+            gen = list(self._preempt_gen.get(uid, []))
+            exact = FEEDBACK_TOKEN not in pend
+            stream = pend
+            if seq is not None:
+                exact = exact and seq.resumable
+                stream = [int(t) for t in seq.chain] \
+                    + [t for t in pend if t != FEEDBACK_TOKEN]
+                gen += [int(t) for t in seq.tokens]
+            m = self._meta.get(uid)
+            remaining = None
+            if m is not None and m.deadline_ms is not None:
+                remaining = max(
+                    0.0, m.deadline_ms - (now - m.t_arrival) * 1e3)
+            rec = self.requests.open.get(uid)
+            reqs.append({
+                "uid": int(uid),
+                "tokens": stream if exact else None,
+                "generated": gen,
+                "priority": int(m.priority) if m else 0,
+                "deadline_ms": remaining,
+                "preemptions": rec.preemptions if rec else 0,
+                "retries": rec.retries if rec else 0,
+                "exact": exact,
+            })
+        return {
+            "version": 1,
+            "engine_version": __version__,
+            "health": self.health()["state"],
+            "counters": {k: self.timings[k]
+                         for k in ("steps", "prompt_tokens",
+                                   "cached_tokens", "generated_tokens",
+                                   "step_retries", "requests_failed")},
+            "requests": reqs,
+            # content digests of the resident prefix-cache index: the
+            # cache-affinity routing key (ROADMAP item 5), not KV
+            "prefix_index": sorted(
+                h.hex() for h in self.state._hash_index),
+        }
+
+    def load_snapshot(self, snap: Dict) -> None:
+        """Re-open a snapshot's requests on THIS engine (the restore
+        half of the warm restart — :meth:`restore` wraps construction +
+        this).  Admission bounds are bypassed: restored work was
+        already admitted once; shedding it again would double-charge
+        the client.  Streams re-enter as prompts (the scheduler
+        re-prefills them, through the prefix cache when their blocks
+        re-register), prior generated tokens keep ``query()`` output
+        complete, and inexact records (device-side tokens lost with
+        the old engine) close terminally as ``failed``."""
+        if snap.get("version") != 1:
+            raise ValueError(
+                f"snapshot version {snap.get('version')!r}: this engine "
+                "restores version 1")
+        now = time.perf_counter()
+        tm = self.timings
+        for rec in snap["requests"]:
+            uid = int(rec["uid"])
+            self.requests.on_arrival(uid, now)
+            if not rec.get("exact", True) or not rec.get("tokens"):
+                # device-side tokens died with the old engine: the one
+                # honest outcome is terminal (and reaped, so drivers
+                # drop the uid instead of waiting on it forever)
+                tm["requests_failed"] += 1
+                self.requests.on_finish(uid, status="failed")
+                self._reaped.add(uid)
+                continue
+            self._meta[uid] = RequestMeta(
+                priority=int(rec.get("priority", 0)),
+                deadline_ms=rec.get("deadline_ms"),
+                t_arrival=now)
+            if rec.get("deadline_ms") is not None:
+                self._deadline_uids.add(uid)
+            toks = [int(t) for t in rec["tokens"]]
+            self._pending[uid] = toks
+            if rec.get("generated"):
+                self._preempt_gen[uid] = [int(t)
+                                          for t in rec["generated"]]
+            open_rec = self.requests.open.get(uid)
+            if open_rec is not None:
+                open_rec.preemptions = int(rec.get("preemptions", 0))
+                open_rec.retries = int(rec.get("retries", 0))
+            if self._spec is not None:
+                self._spec.observe(uid, toks)
+
+    @classmethod
+    def restore(cls, model: Model, snap: Dict,
+                config: InferenceConfig = None,
+                topology: Optional[MeshTopology] = None,
+                quant_tree=None) -> "InferenceEngine":
+        """Warm restart: build a fresh engine from weights + a
+        :meth:`snapshot` and re-open every captured request on it.
+        The chaos harness's elastic-restart loop
+        (tools/loadgen.py) is the canonical caller::
+
+            try:
+                out = eng.step(...)
+            except EngineDeadError:
+                eng = InferenceEngine.restore(model, eng.snapshot(),
+                                              eng.icfg)
+        """
+        eng = cls(model, config, topology, quant_tree)
+        eng.load_snapshot(snap)
+        return eng
+
+    def drain(self, deadline_ms: Optional[float] = None,
+              sampling: SamplingParams = SamplingParams(),
+              rng: Optional[jax.Array] = None) -> Dict:
+        """Graceful drain — the router's replica-restart contract
+        (ROADMAP item 5): stop admitting NEW requests (``put`` sheds
+        them; continuations still land), run the backlog down until no
+        pending work remains or ``deadline_ms`` elapses (always
+        step-bounded: a wedged pool cannot hang the drain), then emit
+        the final :meth:`snapshot` and terminally close everything
+        still open as ``shed`` — exactly-one-terminal-status holds
+        through a drain like every other exit path.  The snapshot is
+        the hand-off: restore it onto the replacement replica and the
+        undone work resumes token-identically."""
+        self._draining = True
+        if self._health != "dead":
+            self._health = "draining"
+            self._health_gauge.set(2)
+        t0 = time.perf_counter()
+        pending_tokens = sum(len(t) for t in self._pending.values())
+        # generous progress bound: every pending token plus headroom
+        # for chunking/backoff rounds — the drain NEVER spins forever
+        step_budget = 4 * (pending_tokens // max(self.icfg.token_budget,
+                                                 1) + len(self._pending)) \
+            + 4 * self.fcfg.max_backoff_rounds + 16
+        empty_rounds = 0
+        while any(self._pending.values()) and step_budget > 0:
+            if deadline_ms is not None \
+                    and (time.perf_counter() - t0) * 1e3 >= deadline_ms:
+                break
+            step_budget -= 1
+            try:
+                out = self.step(rng=rng, sampling=sampling)
+            except EngineDeadError:
+                break
+            # backoff rounds return {} with work still pending; more
+            # than the backoff cap of consecutive empties means the
+            # remaining work is unschedulable — shed it via the close
+            empty_rounds = 0 if out else empty_rounds + 1
+            if empty_rounds > self.fcfg.max_backoff_rounds + 2:
+                break
+        snap = self.snapshot()
+        for uid in list(dict.fromkeys(list(self._pending)
+                                      + list(self.state.seqs)
+                                      + list(self._meta))):
+            self._finish(uid, "shed")
+            self._reaped.add(uid)
+        return snap
 
     def step(self, rng: Optional[jax.Array] = None,
              sampling: SamplingParams = SamplingParams()
@@ -1460,6 +1952,7 @@ class InferenceEngine:
         an explicit PRNG key, a zero-arg callable invoked only once a
         step is known to launch, or None (engine-internal key stream
         when the sampler needs one)."""
+        self._ensure_alive()
         t0 = time.perf_counter()
         sched = self._schedule()
         self._close_ctx_exhausted()
@@ -1482,9 +1975,14 @@ class InferenceEngine:
         step_fn = self._pstep_fns.pop(key, None)
         if step_fn is None:
             if len(self._pstep_fns) >= 16:    # bound retained executables
-                self._pstep_fns.pop(next(iter(self._pstep_fns)))
+                evicted = next(iter(self._pstep_fns))
+                self._pstep_fns.pop(evicted)
+                # a rebuilt executable recompiles: its next call is
+                # cold again or the watchdog would time the compile
+                self._warm_keys.discard(("p", evicted))
             step_fn = self._build_pstep(mbs, sampling)
         self._pstep_fns[key] = step_fn    # reinsert: LRU, not FIFO
+        cold = ("p", key) not in self._warm_keys
         t1 = time.perf_counter()
         batch = self._stage(
             self.state.build_batch(
@@ -1502,28 +2000,47 @@ class InferenceEngine:
             rng = self._zero_key          # greedy: the sampler ignores it
         prev = self._last_toks if self._last_toks is not None \
             else self._zero_toks
+        uids = tuple(uid for uid, _ in sched)
         try:
-            toks, self.state.kv = step_fn(
-                self.params, self._quant, self.state.kv, batch, prev, rng)
-        except jax.errors.JaxRuntimeError:
-            # degrade to an HBM cache ONLY on the first-ever step (the
-            # backend compiled but cannot execute in-program host
-            # transfers); a later-step error must propagate — zeroing a
-            # live cache would silently corrupt every open sequence
-            if not getattr(self, "_kv_on_host", False) \
-                    or self._steps_done > 0:
-                raise
-            logger.warning("kv_offload: backend cannot execute host "
-                           "transfers; falling back to HBM KV")
-            self._kv_on_host = False
-            # the failed call donated the cache; at step 0 it is all
-            # zeros — recreate it
-            self.state.kv = self.state.cfg.kv_zeros()
-            self._pstep_fns.clear()
-            step_fn = self._pstep_fns[key] = self._build_pstep(mbs, sampling)
-            toks, self.state.kv = step_fn(
-                self.params, self._quant, self.state.kv, batch, prev, rng)
+            try:
+                # the one deadline-guarded dispatch seam: the watchdog
+                # (and the chaos harness's fault injector) wrap exactly
+                # this call — see inference/failures.py
+                toks, self.state.kv = self.failures.run(
+                    lambda: step_fn(self.params, self._quant,
+                                    self.state.kv, batch, prev, rng),
+                    uids=uids, cold=cold)
+            except jax.errors.JaxRuntimeError:
+                # degrade to an HBM cache ONLY on the first-ever step
+                # (the backend compiled but cannot execute in-program
+                # host transfers); a later-step error must propagate to
+                # the failure classifier below — zeroing a live cache
+                # here would silently corrupt every open sequence
+                if not getattr(self, "_kv_on_host", False) \
+                        or self._steps_done > 0:
+                    raise
+                logger.warning("kv_offload: backend cannot execute host "
+                               "transfers; falling back to HBM KV")
+                self._kv_on_host = False
+                # the failed call donated the cache; at step 0 it is all
+                # zeros — recreate it
+                self.state.kv = self.state.cfg.kv_zeros()
+                self._pstep_fns.clear()
+                step_fn = self._pstep_fns[key] = self._build_pstep(
+                    mbs, sampling)
+                toks, self.state.kv = step_fn(
+                    self.params, self._quant, self.state.kv, batch, prev,
+                    rng)
+        except Exception as e:
+            # every failure on the dispatch path funnels through the
+            # classifier seam (tpulint's serving-except rule holds the
+            # loop to this); the live ledger IS this step's build
+            self._handle_step_failure(
+                e, uids, "dispatch",
+                registered=tuple(self.state.round_registered))
+            return None
         t3 = time.perf_counter()
+        self._warm_keys.add(("p", key))
         self._steps_done += 1
         self._last_toks = toks
         tm = self.timings
@@ -1544,7 +2061,6 @@ class InferenceEngine:
                       n_tokens=sum(len(t) for _, t in sched))
         emit = tuple((uid, self.state.slot(uid)) for uid, _ in sched
                      if not self._pending.get(uid))
-        uids = tuple(uid for uid, _ in sched)
         for uid in uids:
             self._inflight_sched[uid] = self._inflight_sched.get(uid, 0) + 1
         self._dispatch_seq += 1
@@ -1552,7 +2068,9 @@ class InferenceEngine:
                          uids=uids,
                          drafts=tuple((u, tuple(d)) for u, d in
                                       self._sched_drafts.items()),
-                         stop=sampling.stop_token)
+                         stop=sampling.stop_token,
+                         registered=tuple(self.state.round_registered),
+                         cold=cold)
 
     def _drain_cow(self) -> None:  # tpulint: serving-loop
         """Execute queued copy-on-write block copies (a prefix-cache
@@ -1620,10 +2138,27 @@ class InferenceEngine:
             else:
                 self._inflight_sched.pop(uid, None)
         t0 = time.perf_counter()
-        jax.block_until_ready(st.toks)
-        t1 = time.perf_counter()
-        toks_np = self._fetch_tokens(st.toks)
+        try:
+            # readbacks surface deferred async-execution errors and can
+            # hang with the device: same deadline guard + classifier
+            # seam as the dispatch.  The host transfer itself rides the
+            # same try — a device dying between the wait and the copy
+            # must degrade like any other failure, not crash the loop
+            self.failures.run(lambda: jax.block_until_ready(st.toks),
+                              uids=st.uids, cold=st.cold)
+            t1 = time.perf_counter()
+            toks_np = self._fetch_tokens(st.toks)
+        except Exception as e:
+            if st.sid == self._dispatch_seq:
+                # this WAS the latest dispatch: its sample array must
+                # never feed a later step (markers deferring to it are
+                # cleaned by the re-queue below; zero fallback is safe)
+                self._last_toks = None
+            self._handle_step_failure(e, st.uids, "collect",
+                                      registered=st.registered)
+            return {}
         t2 = time.perf_counter()
+        self._note_step_success(st.uids)
         tm = self.timings
         tm["wait_ms"] += (t1 - t0) * 1e3
         tm["readback_ms"] += (t2 - t1) * 1e3
@@ -1731,6 +2266,7 @@ class InferenceEngine:
         token.  All pending requests must be single-token continuations
         of live sequences (pure decode); KV blocks for the whole burst
         are pre-reserved host-side.  Returns {uid: [token, ...]}."""
+        self._ensure_alive()
         steps = steps or max(1, self.icfg.decode_burst)
         pending = {u: t for u, t in self._pending.items() if t}
         if not pending:
@@ -1796,19 +2332,39 @@ class InferenceEngine:
         key = (steps, sampling, P)
         if key not in self._burst_fns:
             if len(self._burst_fns) >= 8:     # bound retained executables
-                self._burst_fns.pop(next(iter(self._burst_fns)))
+                evicted = next(iter(self._burst_fns))
+                self._burst_fns.pop(evicted)
+                self._warm_keys.discard(("b", evicted))
             self._burst_fns[key] = self._build_burst(steps, sampling, P)
+        burst_cold = ("b", key) not in self._warm_keys
         if rng is None:
             self._rng, rng = jax.random.split(self._rng)
         t0 = time.perf_counter()
-        toks, self.state.kv = self._burst_fns[key](
-            self.params, self._quant, self.state.kv,
-            self._stage(jnp.asarray(tables)), self._stage(jnp.asarray(base)),
-            self._stage(jnp.asarray(tok0)),
-            self._stage(jnp.asarray(uids_arr)), self._stage(rng))
-        t1 = time.perf_counter()
+        burst_fn = self._burst_fns[key]
+        try:
+            toks, self.state.kv = self.failures.run(
+                lambda: burst_fn(
+                    self.params, self._quant, self.state.kv,
+                    self._stage(jnp.asarray(tables)),
+                    self._stage(jnp.asarray(base)),
+                    self._stage(jnp.asarray(tok0)),
+                    self._stage(jnp.asarray(uids_arr)), self._stage(rng)),
+                uids=tuple(pending), cold=burst_cold)
+            t1 = time.perf_counter()
+            toks_np = self._fetch_tokens(toks)         # ONE fetch
+        except Exception as e:
+            # blocks reserved ahead for the burst release with the
+            # re-queue; seen_tokens was not advanced yet, so a
+            # resumable chain re-prefills token-identically (the fetch
+            # rides the same seam: a transfer failure degrades too)
+            self._handle_step_failure(e, tuple(pending), "burst")
+            return {}
+        self._warm_keys.add(("b", key))
         self._steps_done += steps
-        toks_np = self._fetch_tokens(toks)             # ONE fetch
+        # burst success resets escalation/strikes like a collected
+        # step — without this a burst-heavy workload would count
+        # expiries thousands of clean bursts apart as "consecutive"
+        self._note_step_success(tuple(pending))
         t2 = time.perf_counter()
         tr = self.tracer
         if tr.enabled:

@@ -81,6 +81,13 @@ class OverloadConfig:
     # the tier "degrade" demotes to — below any sane client priority,
     # so degraded requests only consume otherwise-idle capacity
     degrade_priority: int = 1_000_000
+    # finished-record retention: how many terminally-closed requests
+    # the lifecycle tracker remembers (ring-bounded).  query() answers
+    # a terminal status as far back as this ring reaches; a uid that
+    # aged out answers "forgotten" (distinct from the never-seen
+    # "unknown"), so long-lived load-harness clients can tell a
+    # retention miss from a request the engine never had
+    status_retention: int = 4096
 
     def __post_init__(self):
         if self.shed_policy not in SHED_POLICIES:
@@ -91,6 +98,8 @@ class OverloadConfig:
             raise ValueError("max_preemptions_per_step must be >= 0")
         if self.prefill_chunk is not None and self.prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1 (or None)")
+        if self.status_retention < 1:
+            raise ValueError("status_retention must be >= 1")
 
 
 @dataclasses.dataclass

@@ -117,6 +117,19 @@ class SequenceDescriptor:
         needed = -(-total // block_size)       # ceil
         return max(0, needed - len(self.blocks))
 
+    @property
+    def resumable(self) -> bool:
+        """The host knows every KV row's token id in order: the chain
+        is intact and no unresolved draft window holds provisional
+        rows.  This is THE eligibility predicate shared by
+        preemption-by-eviction, failure-recovery re-queueing, and
+        ``engine.snapshot()`` — a resumable sequence can be released
+        and re-prefilled token-identically; a non-resumable one holds
+        device-side tokens the host never saw (a deferred feedback
+        marker or a decode burst) and can only be closed."""
+        return (not self.chain_broken and self.draft_len == 0
+                and len(self.chain) == self.seen_tokens)
+
 
 class RaggedBatch(NamedTuple):
     """Fixed-shape device view of one engine step (the RaggedBatchWrapper
@@ -243,6 +256,14 @@ class StateManager:
         # here so no exit path (flush, preemption, deadline, direct
         # release) can leak an open record
         self.on_release: Optional[callable] = None
+        # (digest, block) index entries registered since the last
+        # build_batch began: a registration promises the block HOLDS
+        # the hashed content, but the device write that honors it rides
+        # the same step — if that step FAILS, the engine must
+        # unregister exactly these entries (docs/SERVING.md "Failure
+        # domains & recovery") or a later prefix match would alias
+        # never-written KV
+        self.round_registered: List[Tuple[bytes, int]] = []
         # paged KV: [L, blocks+1, block_size, 2, Hkv, D] — the extra row is
         # the trash block that padding tokens' KV writes are routed to
         # (plus per-vector scales when cfg.quant != "none")
@@ -384,6 +405,21 @@ class StateManager:
                 self._hash_index[h] = b
                 self._block_hash[b] = h
                 self.allocator.mark_cached(b)
+                self.round_registered.append((h, b))
+
+    def unregister_blocks(self, entries: List[Tuple[bytes, int]]) -> None:
+        """Withdraw specific ``(digest, block)`` index registrations —
+        the failure-recovery path for registrations whose backing KV
+        write died with a failed step.  Unregistering is always SAFE
+        (worst case a future match misses); only entries still mapping
+        the same block are touched, so stale lists from older rounds
+        are harmless."""
+        for h, b in entries:
+            if self._hash_index.get(h) != b:
+                continue
+            del self._hash_index[h]
+            self._block_hash.pop(b, None)
+            self.allocator.unmark_cached(b)
 
     def reset_prefix_cache(self) -> None:
         """Drop every index entry; cached-free blocks become plain free.
@@ -507,6 +543,8 @@ class StateManager:
         column 0 = their last token)."""
         max_blocks = self.cfg.num_blocks
         T = token_budget
+        # fresh registration ledger for this round (see round_registered)
+        self.round_registered = []
         if stager is not None \
                 and stager.shape_key == (T, self.max_seqs, max_blocks) \
                 and stager.n_verify >= n_verify:

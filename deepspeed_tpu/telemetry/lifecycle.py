@@ -49,15 +49,22 @@ QUEUE_WAIT_BUCKETS_MS = TTFT_BUCKETS_MS
 # terminal statuses a record may close with (docs/OBSERVABILITY.md):
 #   finished          — ran to completion (stop token / max_new / flush)
 #   shed              — rejected or evicted by backpressure before ever
-#                       holding KV (overload.OverloadConfig.shed_policy)
+#                       holding KV (overload.OverloadConfig.shed_policy),
+#                       or left unfinished by engine.drain()
 #   deadline_exceeded — its deadline_ms elapsed before completion
 #   context_exhausted — hit the engine's max context; nothing more can
 #                       be scheduled for it
 #   cancelled         — engine.cancel() (client abort)
 #   released          — its KV was released out-of-band (direct
 #                       StateManager.release while the record was open)
+#   failed            — quarantined by the failure classifier: the
+#                       request repeatedly sat in failing step batches
+#                       (poison — docs/SERVING.md "Failure domains &
+#                       recovery"), or its device-side tokens were lost
+#                       to a failure the host could not replay
 TERMINAL_STATUSES = ("finished", "shed", "deadline_exceeded",
-                     "context_exhausted", "cancelled", "released")
+                     "context_exhausted", "cancelled", "released",
+                     "failed")
 
 
 @dataclasses.dataclass
@@ -72,6 +79,10 @@ class RequestRecord:
     # counting the evictions it survived.
     status: str = "open"
     preemptions: int = 0
+    # step-failure recoveries this request rode through (non-terminal:
+    # the failed batch was re-queued and the request resumed — the
+    # failure-domain sibling of ``preemptions``)
+    retries: int = 0
     t_admitted: Optional[float] = None
     t_prefill_start: Optional[float] = None
     t_first_token: Optional[float] = None
@@ -151,6 +162,7 @@ class RequestRecord:
                 "finished": self.t_finish is not None,
                 "status": self.status,
                 "preemptions": self.preemptions,
+                "retries": self.retries,
                 **ms}
 
 
@@ -185,11 +197,22 @@ class RequestTracker:
             "serving_preemptions_total",
             "preemption-by-eviction events (non-terminal: the request "
             "is re-queued)", int_valued=True)
+        self._c_retried = registry.counter(
+            "serving_request_retries_total",
+            "step-failure recoveries ridden through (non-terminal: the "
+            "failed batch was re-queued)", int_valued=True)
         # uid -> last terminal status, bounded alongside the finished
         # ring (``_status_refs`` counts ring records per uid so the
         # entry dies with its last evicted record)
         self._last_status: Dict[int, str] = {}
         self._status_refs: Dict[int, int] = {}
+        # uids whose terminal status aged OUT of the ring — so
+        # ``status_of`` can answer "forgotten" (the uid existed; its
+        # story is gone) instead of the never-seen "unknown".  Bounded
+        # at 8x the ring: beyond that, truly ancient uids fall back to
+        # "unknown" (insertion-ordered dict = O(1) FIFO eviction)
+        self._forgotten: Dict[int, None] = {}
+        self._forgotten_cap = 8 * max_finished
         # cumulative speculative-decode tallies (plain ints, NOT registry
         # counters — the engine's serving_spec_* counters are the
         # exported metric; these survive finished-ring eviction so the
@@ -202,6 +225,7 @@ class RequestTracker:
         self.finished.clear()
         self._last_status.clear()
         self._status_refs.clear()
+        self._forgotten.clear()
         self._drafted = 0
         self._accepted = 0
 
@@ -216,6 +240,7 @@ class RequestTracker:
         rec = RequestRecord(uid, now if now is not None
                             else time.perf_counter())
         self.open[uid] = rec
+        self._forgotten.pop(uid, None)       # the uid lives again
         self._c_arrived.inc()
         return rec
 
@@ -276,6 +301,16 @@ class RequestTracker:
         rec.preemptions += 1
         self._c_preempted.inc()
 
+    def on_retried(self, uid: int) -> None:
+        """The request sat in a step batch the failure classifier
+        recovered (re-queue + re-prefill) — NOT terminal; the
+        failure-domain sibling of :meth:`on_preempted`."""
+        rec = self.open.get(uid)
+        if rec is None:
+            return
+        rec.retries += 1
+        self._c_retried.inc()
+
     def on_finish(self, uid: int, now: Optional[float] = None,
                   status: str = "finished") -> None:
         """Close the record with a terminal ``status`` (idempotent: a
@@ -297,18 +332,30 @@ class RequestTracker:
             self._status_refs[old.uid] -= 1
             if not self._status_refs[old.uid]:
                 del self._status_refs[old.uid]
-                self._last_status.pop(old.uid, None)
+                if self._last_status.pop(old.uid, None) is not None:
+                    # the uid's whole story just aged out: remember
+                    # THAT it existed (bounded), so status_of answers
+                    # "forgotten" instead of the never-seen "unknown"
+                    self._forgotten[old.uid] = None
+                    while len(self._forgotten) > self._forgotten_cap:
+                        self._forgotten.pop(next(iter(self._forgotten)))
         self.finished.append(rec)
         self._last_status[uid] = status
+        self._forgotten.pop(uid, None)
         self._status_refs[uid] = self._status_refs.get(uid, 0) + 1
 
     def status_of(self, uid: int) -> Optional[str]:
         """``"open"`` while the request is live, its terminal status
-        after closure (as far back as the finished ring remembers), or
-        None for a uid this tracker never saw."""
+        after closure (as far back as the finished ring remembers),
+        ``"forgotten"`` for a uid whose terminal record aged out of the
+        ring (sized by ``OverloadConfig.status_retention``), or None
+        for a uid this tracker never saw."""
         if uid in self.open:
             return "open"
-        return self._last_status.get(uid)
+        s = self._last_status.get(uid)
+        if s is None and uid in self._forgotten:
+            return "forgotten"
+        return s
 
     # ------------------------------------------------------------------
     def records(self) -> List[RequestRecord]:
@@ -322,6 +369,7 @@ class RequestTracker:
             "finished": int(self._c_finished.value()),
             "open": len(self.open),
             "preemptions": int(self._c_preempted.value()),
+            "retries": int(self._c_retried.value()),
             # terminal closures by status (only statuses that occurred)
             "statuses": {k[0][1]: int(v)
                          for k, v in self._c_terminal.series() if k},

@@ -15,8 +15,9 @@ import json
 
 import pytest
 
-from tools.loadgen import (Fault, Request, build_engine, default_faults,
-                           make_trace, replay, run_sweep, smoke, summarize)
+from tools.loadgen import (Fault, Request, build_engine, chaos_smoke,
+                           default_faults, make_trace, replay, run_sweep,
+                           smoke, summarize)
 
 
 def test_make_trace_deterministic():
@@ -91,6 +92,59 @@ def test_replay_wedge_guard():
     faults = [Fault("pool_exhaust", step=0, duration=10**9, frac=1.0)]
     with pytest.raises(RuntimeError, match="did not drain"):
         replay(eng, trace, faults, max_steps=30)
+
+
+def test_smoke_exercises_draft_rollback_under_load(smoke_out):
+    """The spec_decode="on" smoke leg (PR 7 shipped speculation after
+    the original smoke): repetitive-motif prompts through the same
+    overload policy + fault set, with draft windows resolved AND
+    rolled back while preemption/chunking/sheds interleave — token
+    accounting stays exact and nothing leaks."""
+    out = smoke_out
+    assert out["checks"]["spec_rollback_exercised"]
+    assert out["checks"]["spec_all_terminal"]
+    assert out["spec"]["drafted"] > 0
+    assert out["spec"]["rejected"] > 0
+    assert out["spec"]["open_records"] == 0
+    json.dumps(out)
+
+
+@pytest.fixture(scope="module")
+def chaos_out():
+    """One chaos run shared by the assertions below (4 variants x 2
+    engines of compile is the expensive part)."""
+    return chaos_smoke(seed=0)
+
+
+def test_chaos_smoke_is_the_failure_acceptance_check(chaos_out):
+    """The chaos acceptance bar (docs/SERVING.md "Failure domains &
+    recovery"), identical to ``python -m tools.loadgen --chaos``:
+    injected crash + watchdog expiry + uid-targeted poison + a
+    mid-traffic snapshot/restore warm restart, across greedy/seeded x
+    prefix cache on/off — the engine never deadlocks, never leaks,
+    every request reaches exactly one terminal status (the poison
+    request's being ``failed``), and every unaffected request keeps
+    exact token parity with a fault-free run."""
+    out = chaos_out
+    assert out["ok"] and all(out["checks"].values())
+    for name, var in out["variants"].items():
+        assert var["restarts"] >= 1, name
+        assert var["requests_failed"] == 1, name
+        assert var["step_retries"] > 0, name
+    json.dumps(out)
+
+
+def test_chaos_covers_all_variants(chaos_out):
+    assert set(chaos_out["variants"]) == {
+        "greedy_cache_on", "greedy_cache_off",
+        "seeded_cache_on", "seeded_cache_off"}
+
+
+def test_replay_restart_needs_factory():
+    eng, _ = build_engine()
+    trace = [Request(uid=0, step=0, prompt=[1, 2, 3], max_new=2)]
+    with pytest.raises(ValueError, match="engine_factory"):
+        replay(eng, trace, [Fault("restart", step=0)])
 
 
 def test_fifo_baseline_sees_head_of_line_blowup(smoke_out):

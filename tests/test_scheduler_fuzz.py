@@ -350,6 +350,124 @@ def test_spec_decode_fuzz_invariants(model, seed, spec):
         assert drafted == 0
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_failure_fuzz_invariants(model, seed):
+    """Crash/hang ops in the mix (docs/SERVING.md "Failure domains &
+    recovery"), injected at the failure classifier seam: scheduler
+    rounds build their batch and then FAIL — a synthetic crash
+    (poison-for-step: re-queue + bisection quarantine) or a watchdog
+    expiry (retry, escalating to engine-dead, which the fuzz answers
+    with snapshot() -> restore() and keeps going).  After EVERY op the
+    allocator partition ``referenced + cached_free + free == total``
+    holds, refcounts equal holder counts, failed-step prefix-index
+    registrations are withdrawn (no hash may promise never-written
+    KV), and open lifecycle records ⊆ live + queued — no failure path
+    leaks."""
+    from deepspeed_tpu.inference import (EngineDeadError, FailureConfig,
+                                         InferenceConfig, InjectedFault)
+    from deepspeed_tpu.inference.failures import DispatchTimeoutError
+
+    def build():
+        return InferenceEngine(model, InferenceConfig(
+            token_budget=16, max_seqs=3, kv_block_size=8, num_kv_blocks=8,
+            max_seq_len=48, prefix_cache="on",
+            failure=FailureConfig(dispatch_timeout_ms=None)))
+
+    r = np.random.RandomState(1300 + seed)
+    eng = build()
+    prefixes = [list(r.randint(1, 128, n)) for n in (8, 16, 24)]
+    next_uid = 0
+    failures = deaths = 0
+    for _ in range(300):
+        op = r.randint(7)
+        live = list(eng.state.seqs)
+        if op == 0:                          # prompt (shared or unique)
+            p = prefixes[r.randint(len(prefixes))] if r.randint(2) \
+                else list(r.randint(1, 128, r.randint(1, 30)))
+            eng.put(next_uid, list(p))
+            next_uid += 1
+        elif op == 1 and live:               # decode continuation
+            uid = live[r.randint(len(live))]
+            if not eng._pending.get(uid):
+                eng.put(uid, [int(r.randint(1, 128))])
+        elif op == 2 and live:               # flush a random live seq
+            eng.flush(live[r.randint(len(live))])
+        elif op == 3 and next_uid:           # client cancel, any state
+            eng.cancel(int(r.randint(next_uid)))
+        elif op in (4, 5):                   # FAILING scheduler round
+            sched = eng._schedule()
+            if sched:
+                eng.state.build_batch(sched, eng.icfg.token_budget,
+                                      stager=eng._stager)
+                exc = InjectedFault("crash") if op == 4 \
+                    else DispatchTimeoutError("injected hang")
+                try:
+                    eng._handle_step_failure(
+                        exc, tuple(u for u, _ in sched), "dispatch")
+                    failures += 1
+                except EngineDeadError:
+                    # the warm-restart loop: host truth -> new engine
+                    deaths += 1
+                    eng = InferenceEngine.restore(model, eng.snapshot(),
+                                                  eng.icfg)
+        else:                                # clean scheduler round
+            sched = eng._schedule()
+            _check_invariants(eng, sched)
+            if sched:
+                eng.state.build_batch(sched, eng.icfg.token_budget,
+                                      stager=eng._stager)
+                # the fuzz never dispatches, so play collect's success
+                # role for the escalation counters (a real step resets
+                # them at its readback)
+                eng._consec_failures = 0
+                eng._consec_timeouts = 0
+        _check_pool_accounting(eng)
+        # failed-step registrations must be withdrawn: every index
+        # entry points at a block some live sequence actually holds or
+        # that rests in the cached-free pool
+        for h, b in eng.state._hash_index.items():
+            assert eng.state.allocator.refcount(b) > 0 \
+                or eng.state.allocator.is_cached(b)
+        for uid in eng.requests.open:
+            assert uid in eng.state.seqs or eng._pending.get(uid) \
+                or uid in eng._meta, f"leaked open record for uid {uid}"
+    assert failures > 0, "fuzz never exercised the classifier seam"
+    if deaths == 0:
+        # the random walk produced no two CONSECUTIVE expiries this
+        # seed: drive the escalation deterministically so every seed
+        # covers timeout -> timeout -> dead -> snapshot -> restore
+        eng.put(next_uid, [1, 2, 3])
+        next_uid += 1
+        rounds = (eng.fcfg.fatal_timeouts + 2) \
+            * (eng.fcfg.max_backoff_rounds + 2)
+        for _ in range(rounds):
+            sched = eng._schedule()
+            if not sched:       # backoff rounds admit nothing
+                continue
+            eng.state.build_batch(sched, eng.icfg.token_budget,
+                                  stager=eng._stager)
+            try:
+                eng._handle_step_failure(
+                    DispatchTimeoutError("injected hang"),
+                    tuple(u for u, _ in sched), "dispatch")
+            except EngineDeadError:
+                deaths += 1
+                eng = InferenceEngine.restore(model, eng.snapshot(),
+                                              eng.icfg)
+                break
+        _check_pool_accounting(eng)
+    assert deaths > 0, "fuzz never exercised the warm-restart path"
+    # drain: every remaining request closes through a real exit path
+    eng._drain_reaped()
+    for uid in list(eng.requests.open):
+        eng.flush(uid)
+    al = eng.state.allocator
+    al.assert_invariants()
+    assert al.referenced_blocks == 0
+    assert al.free_blocks == al.total_blocks
+    assert not eng.requests.open, "open records after full drain"
+
+
 def test_preempt_resume_prefix_cache_parity(model):
     """Seeded-sampling parity across preemption-by-eviction WITH the
     prefix cache doing the resume: the victim's evicted blocks retire

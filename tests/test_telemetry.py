@@ -496,7 +496,8 @@ class TestEngineTelemetry:
                            "prompt_tokens", "cached_tokens",
                            "prefix_hits", "generated_tokens",
                            "spec_drafted_tokens", "spec_accepted_tokens",
-                           "spec_rejected_tokens", "spec_windows"}
+                           "spec_rejected_tokens", "spec_windows",
+                           "step_retries", "requests_failed"}
         assert tm["steps"] > 0 and isinstance(tm["steps"], int)
         assert dict(tm)["steps"] == tm["steps"]
         # the registry sees the same number

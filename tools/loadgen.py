@@ -21,6 +21,7 @@ reproducible and are what ``--smoke`` asserts on.
 CLI::
 
     python -m tools.loadgen --smoke              # tier-1 deterministic leg
+    python -m tools.loadgen --chaos              # failure-domain leg
     python -m tools.loadgen --qps 0.5,2,8 --requests 64 --arrival bursty \
         --shed-policy evict-lowest --out slo.json
 
@@ -63,16 +64,28 @@ class Request:
 class Fault:
     """One injected fault at a step index.
 
-    kind = ``pool_exhaust`` (grab ``frac`` of the allocator's free
-    blocks for ``duration`` steps — starves admissions exactly like a
-    burst of long contexts), ``latency_spike`` (sleep ``ms`` before the
-    step — models a host stall / GC pause; deadline expiries fire), or
-    ``cancel`` (client abort of the oldest live request mid-flight)."""
+    Traffic-shaped kinds (PR 6): ``pool_exhaust`` (grab ``frac`` of the
+    allocator's free blocks for ``duration`` steps — starves admissions
+    exactly like a burst of long contexts), ``latency_spike`` (sleep
+    ``ms`` before the step — models a host stall / GC pause; deadline
+    expiries fire), ``cancel`` (client abort of the oldest live request
+    mid-flight).
+
+    Failure-domain kinds (docs/SERVING.md "Failure domains &
+    recovery"), driving the classifier/watchdog/quarantine layer
+    end-to-end: ``crash`` (the next step raises — classified
+    poison-for-step: the batch re-queues bisected), ``hang`` (a
+    deterministic watchdog expiry — classified retryable, escalating
+    to engine-dead when repeated), ``poison`` (EVERY batch containing
+    ``uid`` crashes until the quarantine isolates it to terminal
+    status ``failed``), and ``restart`` (``snapshot()`` the engine and
+    resume the work on a fresh one — the warm-restart drill)."""
     kind: str
     step: int
     duration: int = 4
     frac: float = 0.75
     ms: float = 0.0
+    uid: Optional[int] = None        # poison target (None: oldest live)
 
 
 def make_trace(seed: int = 0, n_requests: int = 32, qps: float = 2.0,
@@ -131,18 +144,33 @@ def default_faults(trace: List[Request], seed: int = 0) -> List[Fault]:
 # --------------------------------------------------------------------------
 
 def replay(eng, trace: List[Request], faults: Optional[List[Fault]] = None,
-           sampling=None, max_steps: int = 5000) -> Dict:
+           sampling=None, max_steps: int = 5000,
+           engine_factory=None, rng=None,
+           check_invariants: bool = False) -> Dict:
     """Drive the engine through ``trace`` with the direct step() API
     (the continuous-batching serving loop a front-end would run):
     inject arrivals by step index, honor admission verdicts, feed
     emitted tokens back as decode continuations, flush at each
     request's output budget, and apply ``faults`` at their steps.
 
+    ``engine_factory`` (a zero-arg engine builder) arms the
+    warm-restart loop: an :class:`EngineDeadError` — and the
+    ``restart`` fault kind — snapshots the host-side truth and resumes
+    it on a fresh engine, exactly the elastic-restart contract a
+    multi-replica router runs.  ``rng``: an explicit base sampling key
+    (the (uid, position)-folded per-token keys make seeded replays
+    schedule- AND restart-invariant).  ``check_invariants`` asserts
+    the allocator partition and record-leak invariants after EVERY
+    step (the chaos acceptance bar).
+
     Returns step-indexed bookkeeping: per-uid admission verdict status,
     ``ttft_steps`` (arrival step -> first emitted token step — the
-    deterministic queue-delay measure), and the final engine-side
-    terminal status of every uid."""
-    from deepspeed_tpu.inference import SamplingParams
+    deterministic queue-delay measure), ``tokens`` (every emitted
+    token per uid, the parity record), ``restarts``, the final
+    engine-side terminal status of every uid, and ``engine`` — the
+    engine holding the final state (the input one unless a restart
+    swapped it; summaries must read THIS one)."""
+    from deepspeed_tpu.inference import EngineDeadError, SamplingParams
 
     sampling = sampling or SamplingParams(max_new_tokens=1 << 30)
     faults = faults or []
@@ -157,8 +185,26 @@ def replay(eng, trace: List[Request], faults: Optional[List[Fault]] = None,
     remaining: Dict[int, int] = {}    # uid -> output tokens still owed
     verdicts: Dict[int, str] = {}
     ttft_steps: Dict[int, int] = {}
+    tokens: Dict[int, List[int]] = {}        # emitted per uid (parity)
     held: List[Tuple[int, List[int]]] = []   # (free_at_step, blocks)
     faults_fired = 0
+    restarts = 0
+
+    def restart():
+        """snapshot -> fresh engine -> resume (the warm-restart drill);
+        blocks held against the OLD allocator die with it.  Armed
+        injections carry over: a poison REQUEST is poison on any
+        engine — the quarantine must finish the isolation after the
+        restart too."""
+        nonlocal eng, restarts
+        snap = eng.snapshot()
+        pending_inject = eng.failures._inject
+        eng = engine_factory()
+        eng.load_snapshot(snap)
+        eng.failures._inject = pending_inject
+        held.clear()
+        restarts += 1
+
     step = 0
     while step <= last_arrival or remaining:
         for q in arrivals.get(step, ()):
@@ -188,12 +234,43 @@ def replay(eng, trace: List[Request], faults: Optional[List[Fault]] = None,
                 if live:
                     eng.cancel(live[0])
                     remaining.pop(live[0], None)
+            elif f.kind == "crash":
+                eng.failures.inject("crash")
+            elif f.kind == "hang":
+                # a deterministic watchdog expiry (no real sleeping —
+                # the op sequence stays machine-independent); the
+                # classifier walks the same retry/fatal ladder a real
+                # outlived deadline would
+                eng.failures.inject("timeout")
+            elif f.kind == "poison":
+                target = f.uid
+                if target is None:
+                    live = sorted(u for u in remaining
+                                  if eng.query(u)["status"] in
+                                  ("running", "queued"))
+                    target = live[0] if live else None
+                if target is not None:
+                    # EVERY batch carrying the target fails until the
+                    # bisection quarantine isolates it terminally
+                    eng.failures.inject("crash", uid=target, n=1 << 20)
+            elif f.kind == "restart":
+                if engine_factory is None:
+                    raise ValueError(
+                        "restart fault needs an engine_factory")
+                restart()
             else:
                 raise ValueError(f"unknown fault kind {f.kind!r}")
-        outs = eng.step(sampling=sampling)
+        try:
+            outs = eng.step(sampling=sampling, rng=rng)
+        except EngineDeadError:
+            if engine_factory is None:
+                raise
+            restart()
+            outs = {}
         for uid in eng._drain_reaped():
             remaining.pop(uid, None)
         for uid, tok in outs.items():
+            tokens.setdefault(uid, []).append(int(tok))
             if uid not in remaining:
                 continue
             ttft_steps.setdefault(uid, step - by_uid[uid].step)
@@ -203,6 +280,14 @@ def replay(eng, trace: List[Request], faults: Optional[List[Fault]] = None,
                 eng.flush(uid)
             else:
                 eng.put(uid, [tok])
+        if check_invariants:
+            # the chaos bar: the partition holds and no lifecycle
+            # record leaks after EVERY op, faulted or not
+            eng.state.allocator.assert_invariants()
+            for uid in eng.requests.open:
+                assert uid in eng.state.seqs or eng._pending.get(uid) \
+                    or uid in eng._meta, \
+                    f"leaked open record for uid {uid}"
         step += 1
         if step > max_steps:
             # wedged replays surface as an error, never a silent hang
@@ -215,8 +300,11 @@ def replay(eng, trace: List[Request], faults: Optional[List[Fault]] = None,
         "steps": step,
         "verdicts": verdicts,
         "ttft_steps": ttft_steps,
+        "tokens": tokens,
         "faults_fired": faults_fired,
+        "restarts": restarts,
         "status": {q.uid: eng.query(q.uid)["status"] for q in trace},
+        "engine": eng,
     }
 
 
@@ -287,10 +375,12 @@ def by_pri(trace: List[Request], uid: int) -> int:
 def build_engine(overload=None, token_budget: int = 32, max_seqs: int = 4,
                  kv_block_size: int = 8, num_kv_blocks: int = 24,
                  max_seq_len: int = 96, prefix_cache: str = "auto",
-                 model=None):
+                 model=None, **icfg_kw):
     """A deliberately tight tiny engine: pools small enough that an
     over-capacity trace actually starves blocks/slots (the behaviors
-    under test), compile small enough for a tier-1 smoke leg."""
+    under test), compile small enough for a tier-1 smoke leg.  Extra
+    keywords land on :class:`InferenceConfig` verbatim (``spec_decode``,
+    ``failure``, ...)."""
     from deepspeed_tpu.inference import InferenceConfig, InferenceEngine
     from deepspeed_tpu.models import build_model
 
@@ -301,7 +391,7 @@ def build_engine(overload=None, token_budget: int = 32, max_seqs: int = 4,
         token_budget=token_budget, max_seqs=max_seqs,
         kv_block_size=kv_block_size, num_kv_blocks=num_kv_blocks,
         max_seq_len=max_seq_len, prefix_cache=prefix_cache,
-        overload=overload)), model
+        overload=overload, **icfg_kw)), model
 
 
 def run_sweep(qps_list: Sequence[float], n_requests: int = 32,
@@ -325,7 +415,7 @@ def run_sweep(qps_list: Sequence[float], n_requests: int = 32,
         uid0 += n_requests
         faults = default_faults(trace, seed) if with_faults else []
         res = replay(eng, trace, faults)
-        legs[str(qps)] = summarize(eng, res, trace)
+        legs[str(qps)] = summarize(res["engine"], res, trace)
     return {"qps": list(qps_list), "arrival": arrival, "seed": seed,
             "legs": legs}
 
@@ -362,6 +452,25 @@ def smoke(seed: int = 0) -> Dict:
                           for q in trace], faults)
     sum_f = summarize(base, res_f, trace)
 
+    # spec_decode="on" variant under the same overload policy: the
+    # policy-vs-FIFO check above never drafts (random prompts), so
+    # this leg feeds the proposer repetitive-motif prompts — the
+    # traffic shape prompt lookup targets — and asserts draft windows
+    # actually resolved AND rolled back under load (preemption,
+    # chunked prefill, and faults all interleaving with rollback)
+    r = np.random.RandomState(seed + 3)
+    spec_trace = []
+    for i in range(10):
+        motif = [int(x) for x in r.randint(1, 120, 3 + i % 3)]
+        spec_trace.append(Request(
+            uid=4000 + i, step=i // 3, prompt=(motif * 8)[:16 + i % 5],
+            priority=i % 2, max_new=int(r.randint(3, 7))))
+    eng_s, _ = build_engine(policy_cfg, model=model, spec_decode="on",
+                            spec_max_draft=3)
+    res_s = replay(eng_s, spec_trace, default_faults(spec_trace, seed))
+    sum_s = summarize(res_s["engine"], res_s, spec_trace)
+    tm_s = eng_s.timings
+
     checks = {
         # every request reached a terminal state — nothing leaks open
         "all_terminal": sum_p["open_records"] == 0
@@ -381,13 +490,129 @@ def smoke(seed: int = 0) -> Dict:
         "pool_clean": eng.state.allocator.free_blocks
         == eng.state.allocator.total_blocks
         and base.state.allocator.free_blocks
-        == base.state.allocator.total_blocks,
+        == base.state.allocator.total_blocks
+        and eng_s.state.allocator.free_blocks
+        == eng_s.state.allocator.total_blocks,
+        # the spec leg drafted, accepted something, AND rolled a
+        # rejected tail back — rollback under load is exercised
+        "spec_rollback_exercised":
+        int(tm_s["spec_drafted_tokens"]) > 0
+        and int(tm_s["spec_rejected_tokens"]) > 0,
+        "spec_all_terminal": sum_s["open_records"] == 0
+        and all(sum_s["parity"].values()),
     }
     out = {"ok": all(checks.values()), "checks": checks,
-           "policy": sum_p, "fifo": sum_f}
+           "policy": sum_p, "fifo": sum_f, "spec": {
+               **sum_s,
+               "drafted": int(tm_s["spec_drafted_tokens"]),
+               "accepted": int(tm_s["spec_accepted_tokens"]),
+               "rejected": int(tm_s["spec_rejected_tokens"])}}
     if not out["ok"]:
         raise AssertionError(f"loadgen smoke failed: "
                              f"{json.dumps(checks)}")
+    return out
+
+
+# --------------------------------------------------------------------------
+# chaos smoke: the failure-domain acceptance check
+# --------------------------------------------------------------------------
+
+def chaos_smoke(seed: int = 0) -> Dict:
+    """Deterministic chaos replay (docs/SERVING.md "Failure domains &
+    recovery"): the same seeded bursty trace runs fault-free and then
+    under injected ``crash`` + ``hang`` + a uid-targeted ``poison`` +
+    a mid-traffic ``restart`` (snapshot -> fresh engine -> resume),
+    across greedy/seeded sampling and prefix cache on/off.  Asserts
+    the acceptance bar:
+
+    * the engine never deadlocks (the replay drains or raises) and
+      never leaks (allocator partition + open-record checks after
+      EVERY step, pool fully reclaimable at the end);
+    * every request reaches exactly ONE terminal status, the poison
+      request's being ``failed``;
+    * every NON-poisoned request's token stream is EXACTLY the
+      fault-free run's — crash re-queues, bisection probes, watchdog
+      retries, and the snapshot/restore each resume token-identically
+      (greedy and seeded, cache on and off)."""
+    import jax
+
+    from deepspeed_tpu.inference import FailureConfig, SamplingParams
+
+    trace = make_trace(seed=seed, n_requests=12, qps=30.0,
+                       arrival="bursty", prompt_lens=(4, 24),
+                       out_lens=(2, 4), tiers=(0, 1))
+    poison_uid = trace[3].uid
+    last = max(q.step for q in trace)
+    faults = [Fault("poison", step=0, uid=poison_uid),
+              Fault("crash", step=2),
+              Fault("hang", step=4),
+              Fault("restart", step=last // 2 + 1)]
+    # the injected faults are deterministic, so the real watchdog
+    # thread is off the replay's path (its own unit tests cover it);
+    # generous strikes let bisection — not the cap — isolate the poison
+    fcfg = FailureConfig(dispatch_timeout_ms=None)
+    model_box = []
+
+    def factory(cache):
+        eng, m = build_engine(None, model=model_box[0] if model_box
+                              else None, prefix_cache=cache,
+                              failure=fcfg)
+        if not model_box:
+            model_box.append(m)
+        return eng
+
+    samplers = {
+        "greedy": (SamplingParams(max_new_tokens=1 << 30), None),
+        "seeded": (SamplingParams(temperature=0.8, top_k=40,
+                                  max_new_tokens=1 << 30),
+                   jax.random.PRNGKey(11)),
+    }
+    # one fault-free reference per sampler; the cache-off chaos run
+    # compares against the same reference — prefix caching is already
+    # guaranteed schedule-invariant, and the chaos runs re-prove it
+    refs = {}
+    for mode, (sp, rng) in samplers.items():
+        refs[mode] = replay(factory("on"), trace, [], sampling=sp,
+                            rng=rng)["tokens"]
+    variants = [("greedy", "on"), ("greedy", "off"), ("seeded", "on"),
+                ("seeded", "off")]
+    out = {"variants": {}}
+    checks: Dict[str, bool] = {}
+    for mode, cache in variants:
+        sp, rng = samplers[mode]
+        res = replay(factory(cache), trace, list(faults), sampling=sp,
+                     engine_factory=lambda: factory(cache), rng=rng,
+                     check_invariants=True)
+        eng = res["engine"]
+        al = eng.state.allocator
+        al.assert_invariants()
+        agg = eng.request_metrics()["aggregate"]
+        name = f"{mode}_cache_{cache}"
+        parity = all(res["tokens"].get(q.uid, []) ==
+                     refs[mode].get(q.uid, [])
+                     for q in trace if q.uid != poison_uid)
+        checks[f"{name}_poison_failed"] = \
+            res["status"][poison_uid] == "failed"
+        checks[f"{name}_all_terminal"] = agg["open"] == 0 and all(
+            s in ("finished", "failed") for s in res["status"].values())
+        checks[f"{name}_unaffected_parity"] = parity
+        checks[f"{name}_restarted"] = res["restarts"] >= 1
+        checks[f"{name}_no_leak"] = \
+            al.free_blocks == al.total_blocks
+        out["variants"][name] = {
+            "steps": res["steps"], "restarts": res["restarts"],
+            "statuses": {s: list(res["status"].values()).count(s)
+                         for s in set(res["status"].values())},
+            "step_retries": int(eng.timings["step_retries"]),
+            "requests_failed": int(eng.timings["requests_failed"]),
+            "health": eng.health()["state"],
+        }
+    out["checks"] = checks
+    out["ok"] = all(checks.values())
+    if not out["ok"]:
+        raise AssertionError(
+            "chaos smoke failed: "
+            f"{json.dumps({k: v for k, v in checks.items() if not v})}")
     return out
 
 
@@ -399,6 +624,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true",
                     help="fast deterministic tier-1 leg (asserts)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="chaos acceptance leg: crash/hang/poison/"
+                    "restart faults, parity vs a fault-free run")
     ap.add_argument("--qps", default="0.5,2,8",
                     help="comma-separated offered rates to sweep")
     ap.add_argument("--requests", type=int, default=32)
@@ -411,7 +639,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--out", default=None, metavar="OUT.json")
     args = ap.parse_args(argv)
 
-    if args.smoke:
+    if args.chaos:
+        result = chaos_smoke(args.seed)
+    elif args.smoke:
         result = smoke(args.seed)
     else:
         result = run_sweep([float(q) for q in args.qps.split(",")],

@@ -426,6 +426,50 @@ def check_serving_wait(ctx: FileContext) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
+# rule: serving-except — broad excepts must route through the failure
+# classifier
+# --------------------------------------------------------------------------
+
+@rule("serving-except",
+      "except Exception / bare except inside a '# tpulint: serving-loop' "
+      "marked method that does not route the exception through the "
+      "failure classifier (inference/failures.py classify_failure / "
+      "_handle_step_failure) or re-raise — an ad-hoc broad catch on the "
+      "serving loop invents a second, unaudited failure policy: the "
+      "request-level terminal statuses, bisection quarantine, and "
+      "engine-dead escalation all live behind the ONE classifier seam")
+def check_serving_except(ctx: FileContext) -> Iterator[Finding]:
+    marked = _serving_marked_lines(ctx)
+    if not marked or "except" not in ctx.source:
+        return
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        header = range(fn.lineno, fn.body[0].lineno + 1)
+        if not any(ln in marked for ln in header):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            names = _exc_names(node.type)
+            bare = node.type is None
+            if not (bare or any(n in _BROAD for n in names)):
+                continue          # narrow catches pick their own policy
+            if _routes_to_classifier(node):
+                continue
+            if any(isinstance(n, ast.Raise) and n.exc is None
+                   for n in ast.walk(node)):
+                continue          # a bare re-raise defers the decision
+            what = "bare except:" if bare else f"except {'/'.join(names)}"
+            yield Finding(
+                "serving-except", ctx.path, node.lineno, node.col_offset,
+                f"{what} in a serving-loop method swallows failures the "
+                "classifier must see — route it through "
+                "classify_failure/_handle_step_failure (or pragma with "
+                "justification)")
+
+
+# --------------------------------------------------------------------------
 # rule: static-args — recompilation / hashability hazards on jit params
 # --------------------------------------------------------------------------
 
@@ -589,6 +633,27 @@ _BROAD = {"Exception", "BaseException"}
 _LOG_ATTRS = {"warning", "error", "exception", "critical", "info",
               "debug", "log", "warn"}
 
+# calls that route the exception through the serving failure
+# classifier (inference/failures.py): the EXACT seam names, or any
+# method on a receiver chain containing a ``failures`` segment (the
+# FailurePolicy object's conventional home — ``self.failures.run``).
+# Matched exactly, NOT by substring: a handler that merely counts
+# failures (``metrics.count_failures``) or logs one locally
+# (``log_failure_locally``) has not routed anything and must still
+# answer to serving-except/silent-except
+_CLASSIFIER_CALLS = {"classify_failure", "_handle_step_failure",
+                     "handle_step_failure"}
+
+
+def _routes_to_classifier(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Call):
+            parts = (dotted(node.func) or "").split(".")
+            if parts[-1] in _CLASSIFIER_CALLS \
+                    or "failures" in parts[:-1]:
+                return True
+    return False
+
 
 def _exc_names(node: Optional[ast.AST]) -> List[str]:
     if node is None:
@@ -600,7 +665,11 @@ def _exc_names(node: Optional[ast.AST]) -> List[str]:
 
 
 def _handler_surfaces(handler: ast.ExceptHandler) -> bool:
-    """True when the handler re-raises or logs/prints the failure."""
+    """True when the handler re-raises, logs/prints the failure, or
+    routes it through the serving failure classifier (which logs and
+    acts on every exception it accepts)."""
+    if _routes_to_classifier(handler):
+        return True
     for node in ast.walk(handler):
         if isinstance(node, ast.Raise):
             return True
