@@ -156,6 +156,11 @@ def main(trace_path=None):
         "mesh": {"data": -1},
         "gradient_clipping": 1.0,
         "steps_per_print": 10_000,
+        # device telemetry (docs/OBSERVABILITY.md): per-program
+        # cost_analysis + memory gauges embedded in train_metrics —
+        # the probe's duplicate compile lands in the warmup, outside
+        # every timed window
+        "telemetry": {"device": True},
     }
     engine = ds.initialize(model=model, config=config)
     from deepspeed_tpu.runtime.dataloader import (DataLoader,
@@ -193,6 +198,8 @@ def main(trace_path=None):
     # host-phase telemetry of the timed window (docs/OBSERVABILITY.md):
     # per-phase ms counters + the host-wall histogram summary
     train_metrics = engine.metrics_snapshot()
+    # compiler/device view: train-step cost_analysis + memory poll
+    train_device = engine.devtel.snapshot() if engine.devtel else None
 
     # model FLOPs: 6 * n_params * tokens (fwd+bwd), attention extra term
     from deepspeed_tpu.runtime import param_count
@@ -253,6 +260,7 @@ def main(trace_path=None):
         # the trajectory (bench_fingerprint())
         **bench_fingerprint(),
         "train_metrics": train_metrics,
+        "train_device_metrics": train_device,
     }
     out.update(serve)
     print(json.dumps({**out, **pipe, **prefix, **spec, **overload,  # tpulint: disable=print — the bench's one JSON output line
@@ -266,23 +274,14 @@ def bench_fingerprint():
     PRs keep evolving — pipeline depth, donation, prefix cache, spec
     decode, shed policy, watchdog...).  Two BENCH files with different
     hashes measured different default engines; compare legs only
-    within a hash."""
-    import dataclasses
-    import hashlib
+    within a hash — which is exactly how ``tools/benchdiff.py`` gates:
+    matching hash => hard per-leg thresholds, changed hash =>
+    report-only.  ONE implementation, shared with the flight
+    recorder's post-mortems (telemetry/flight.py), so BENCH captures
+    and black-box dumps join on the same key."""
+    from deepspeed_tpu.telemetry import config_fingerprint
 
-    import deepspeed_tpu as ds
-    from deepspeed_tpu.inference import (FailureConfig, InferenceConfig,
-                                         OverloadConfig)
-
-    blob = json.dumps(
-        {cls.__name__: {f.name: repr(getattr(cls(), f.name))
-                        for f in dataclasses.fields(cls)
-                        if f.name not in ("overload", "failure")}
-         for cls in (InferenceConfig, OverloadConfig, FailureConfig)},
-        sort_keys=True)
-    return {"engine_version": ds.__version__,
-            "config_hash": hashlib.blake2b(
-                blob.encode(), digest_size=8).hexdigest()}
+    return config_fingerprint()
 
 
 def chaos_serving_bench(on_tpu: bool):
@@ -342,6 +341,7 @@ def moe_train_bench(on_tpu: bool, peak: float):
             "mesh": {"data": -1},
             "gradient_clipping": 1.0,
             "steps_per_print": 10_000,
+            "telemetry": {"device": True},
         })
         data = synthetic_lm_data(cfg.vocab_size,
                                  engine.train_batch_size * 12, seq)
@@ -370,6 +370,8 @@ def moe_train_bench(on_tpu: bool, peak: float):
                 tok_s * fpt / peak, 4) if on_tpu else 0.0
         out[f"moe8x_train_tok_s_{mode}"] = round(tok_s, 1)
         out[f"moe8x_train_metrics_{mode}"] = engine.metrics_snapshot()
+        out[f"moe8x_train_device_metrics_{mode}"] = \
+            engine.devtel.snapshot() if engine.devtel else None
         del engine, loader, it, data, model
         gc.collect()
     return out
@@ -413,6 +415,7 @@ def llama_train_bench(on_tpu: bool, peak: float):
         "mesh": {"data": -1},
         "gradient_clipping": 1.0,
         "steps_per_print": 10_000,
+        "telemetry": {"device": True},
     })
     data = synthetic_lm_data(cfg.vocab_size,
                              engine.train_batch_size * 16, seq)
@@ -438,6 +441,8 @@ def llama_train_bench(on_tpu: bool, peak: float):
         "llama07b_train_tok_s": round(tok_s, 1),
         "llama07b_train_mfu": round(mfu, 4),
         "llama07b_train_metrics": engine.metrics_snapshot(),
+        "llama07b_train_device_metrics":
+            engine.devtel.snapshot() if engine.devtel else None,
     }
 
 
@@ -564,7 +569,8 @@ def llama8b_serving_bench(on_tpu: bool):
         kv_block_size=64 if on_tpu else 16,
         num_kv_blocks=128 if on_tpu else 32,
         kv_quant="int8",
-        decode_burst=8 if on_tpu else 2), quant_tree=quant)
+        decode_burst=8 if on_tpu else 2,
+        device_telemetry="on"), quant_tree=quant)
 
     r = np.random.RandomState(0)
     vocab = model.config.vocab_size
@@ -628,6 +634,11 @@ def llama8b_serving_bench(on_tpu: bool):
         f"{name}_decode_tok_s": round(decode_tok_s, 1),
         f"{name}_decode_ms_per_tok_ema": round(ema, 2),
         f"{name}_request_metrics": eng.request_metrics()["aggregate"],
+        # the 8B leg is where utilization matters most: the burst
+        # program's cost_analysis prices the int8 weight stream the
+        # decode floor argument is built on (tools/profile_decode8b.py
+        # reads the same numbers)
+        f"{name}_device_metrics": eng.device_snapshot(),
         **{f"{name}_{k}": v for k, v in sla.items()},
     }
 
@@ -777,7 +788,8 @@ def pipeline_serving_bench(on_tpu: bool, trace_path=None):
             kv_block_size=64 if on_tpu else 16,
             num_kv_blocks=1024 if on_tpu else 64,
             pipeline_depth=depth,
-            trace=bool(trace_path) and depth == 2))
+            trace=bool(trace_path) and depth == 2,
+            device_telemetry="on"))
         # warm the compile caches (probe + both context buckets) outside
         # the timed region
         eng.generate({u: list(p) for u, p in prompts.items()}, sp)
@@ -794,6 +806,7 @@ def pipeline_serving_bench(on_tpu: bool, trace_path=None):
         out[f"pipe{depth}_decode_tok_s"] = round(produced / dt, 1)
         out[f"pipe{depth}_request_metrics"] = \
             eng.request_metrics()["aggregate"]
+        out[f"pipe{depth}_device_metrics"] = eng.device_snapshot()
         if trace_path and depth == 2:
             out["trace_file"] = eng.tracer.export_chrome_trace(trace_path)
         breakdown[f"pipe{depth}"] = {
@@ -858,11 +871,15 @@ def shared_prefix_serving_bench(on_tpu: bool):
     sp = SamplingParams(temperature=0.0, max_new_tokens=1)
     out = {}
     for mode in ("off", "on"):
+        # device telemetry on BOTH arms: the speedup must compare
+        # engines differing in ONE knob (any probe cost lands
+        # symmetrically, outside the timed region anyway)
         eng = InferenceEngine(model, InferenceConfig(
             token_budget=budget, max_seqs=4,
             kv_block_size=64 if on_tpu else 16,
             num_kv_blocks=64 if on_tpu else 48,
-            prefix_cache=mode))
+            prefix_cache=mode,
+            device_telemetry="on"))
         # warm the compile caches with an unrelated prompt (both modes
         # pay it; its blocks never match the shared prefix)
         eng.generate({-1: list(r.randint(0, vocab,
@@ -882,6 +899,7 @@ def shared_prefix_serving_bench(on_tpu: bool):
                 tm["cached_tokens"] / max(tm["prompt_tokens"], 1), 3)
             out["shared_prefix_request_metrics"] = \
                 eng.request_metrics()["aggregate"]
+            out["shared_prefix_device_metrics"] = eng.device_snapshot()
     out["shared_prefix_speedup"] = round(
         out["shared_prefix_prefill_tok_s_on"]
         / max(out["shared_prefix_prefill_tok_s_off"], 1e-9), 2)
@@ -931,12 +949,15 @@ def spec_decode_serving_bench(on_tpu: bool):
     sp = SamplingParams(temperature=0.0, max_new_tokens=gen_tokens)
     out = {}
     for mode in ("off", "on"):
+        # device telemetry on BOTH arms — the on/off speedup must
+        # isolate spec_decode, not spec_decode + telemetry
         eng = InferenceEngine(model, InferenceConfig(
             token_budget=256 if on_tpu else 64, max_seqs=n_seqs,
             kv_block_size=64 if on_tpu else 16,
             num_kv_blocks=256 if on_tpu else 96,
             pipeline_depth=1,
-            spec_decode=mode, spec_max_draft=4))
+            spec_decode=mode, spec_max_draft=4,
+            device_telemetry="on"))
         # warm the compile caches; generate() flushes everything, so the
         # proposer history starts cold again for the timed run
         eng.generate({u: list(p) for u, p in prompts.items()}, sp)
@@ -957,6 +978,7 @@ def spec_decode_serving_bench(on_tpu: bool):
                 3)
             out["spec_request_metrics"] = \
                 eng.request_metrics()["aggregate"]
+            out["spec_device_metrics"] = eng.device_snapshot()
     out["spec_decode_speedup"] = round(
         out["spec_decode_tok_s_on"]
         / max(out["spec_decode_tok_s_off"], 1e-9), 2)
@@ -1012,7 +1034,8 @@ def serving_bench(on_tpu: bool):
         token_budget=1024 if on_tpu else 16, max_seqs=n_seqs,
         kv_block_size=64 if on_tpu else 16,
         num_kv_blocks=1024 if on_tpu else 32,
-        decode_burst=8 if on_tpu else 2))
+        decode_burst=8 if on_tpu else 2,
+        device_telemetry="on"))
     r = np.random.RandomState(0)
     sp = SamplingParams(temperature=0.0, max_new_tokens=1 << 30)
     vocab = model.config.vocab_size
@@ -1060,7 +1083,13 @@ def serving_bench(on_tpu: bool):
     req = eng.request_metrics()["aggregate"]
     return {"serving_ttft_p50_ms": round(ttft_p50_ms, 1),
             "serving_decode_tok_s": round(produced / dt, 1),
-            "serving_request_metrics": req}
+            "serving_request_metrics": req,
+            # device-telemetry capture (docs/OBSERVABILITY.md "Device &
+            # compiler telemetry"): per-program cost_analysis, derived
+            # MFU / HBM-bandwidth utilization over the timed window,
+            # and peak memory_stats — BENCH_r06+ records utilization,
+            # not just tok/s (absent fields = backend can't say)
+            "serving_device_metrics": eng.device_snapshot()}
 
 
 if __name__ == "__main__":

@@ -412,6 +412,12 @@ class TelemetryConfig(ConfigModel):
     (``engine.tracer.export_chrome_trace(path)``, open in Perfetto)."""
     trace: bool = False
     trace_capacity: int = 1 << 16       # spans retained (ring wraps)
+    # device & compiler telemetry (telemetry/device.py): per-program
+    # cost_analysis (one explicit AOT compile per program — why this is
+    # opt-in), derived training_mfu / training_hbm_bw_util pull-gauges,
+    # and memory_stats polling at the steps_per_print boundary.  The
+    # compile/retrace counters are always on regardless.
+    device: bool = False
 
 
 @dataclass

@@ -33,8 +33,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..comm.mesh import FSDP_AXIS, MeshTopology, TENSOR_AXIS
 from ..models.transformer import Model, TransformerConfig
-from ..telemetry import (CounterDictView, MetricsRegistry, RequestTracker,
-                         SpanTracer)
+from ..telemetry import (CounterDictView, DeviceTelemetry, FlightRecorder,
+                         MetricsRegistry, RequestTracker, SpanTracer)
 from ..utils.logging import logger
 from .failures import (FATAL_ENGINE, POISON_STEP,
                        DispatchTimeoutError, EngineDeadError,
@@ -141,6 +141,21 @@ class InferenceConfig:
     # host-side counter bumps that never touch device arrays.
     trace: bool = False
     trace_capacity: int = 1 << 16   # spans retained (ring wraps beyond)
+    # device & compiler telemetry (telemetry/device.py,
+    # docs/OBSERVABILITY.md "Device & compiler telemetry"): per-program
+    # ``compiled.cost_analysis()`` (flops / bytes / HLO size, probed
+    # once per executable-cache fill via an explicit AOT compile of the
+    # already-warm program), derived ``serving_mfu`` /
+    # ``serving_hbm_bw_util`` pull-gauges computed from the existing
+    # step timings at export time, and ``device.memory_stats()`` polled
+    # at phase boundaries (health checks, dumps, bench captures).  Off
+    # by default: the cost probe pays one duplicate compile per program
+    # — "on" is what bench legs and the future autotuner (ROADMAP
+    # item 4) opt into; "auto" defers to the engine and today resolves
+    # OFF.  The compile/retrace COUNTERS, the KV-pool pull-gauges, and
+    # the flight recorder are always on — they are host counter bumps
+    # and read-time probes that cost the hot path nothing.
+    device_telemetry: str = "auto"
     # model-free speculative decoding (inference/spec_decode.py,
     # docs/SERVING.md "Speculative decoding"): an n-gram prompt-lookup
     # proposer drafts up to ``spec_max_draft`` continuation tokens per
@@ -432,8 +447,98 @@ class InferenceEngine:
                 "requests terminally closed with status 'failed' "
                 "(poison quarantine / unreplayable after a failure)",
                 int_valued=True),
+            # compile observatory (docs/OBSERVABILITY.md "Device &
+            # compiler telemetry"): every serving executable-cache fill
+            # counts; a fill whose (kind, key) was ALREADY compiled in
+            # this engine's lifetime is a runtime RETRACE — the dynamic
+            # complement of tpulint's static retrace-hazard rule, and
+            # each one logs a loud warning (something is churning the
+            # program cache: LRU thrash, shape churn, weight refresh)
+            "compiles": reg.counter(
+                "serving_compiles_total",
+                "serving programs built (executable-cache fills)",
+                int_valued=True),
+            "compile_retraces": reg.counter(
+                "serving_compile_retraces_total",
+                "re-builds of a program key this engine had already "
+                "compiled (runtime retrace — each warns loudly)",
+                int_valued=True),
         }
+        # first-call wall time of each program (compile rides it): the
+        # timestamps are the dispatch path's existing t2/t3, so this
+        # adds no clock reads — it is the always-on compile-span feed
+        ms["compile_ms"] = reg.counter(
+            "serving_compile_wall_ms_total",
+            "cumulative first-call (compile-carrying) dispatch wall ms")
         self.timings = CounterDictView({**ms, **ints})
+        # --- KV-pool occupancy gauges: pull-based (FnGauge — computed
+        # from allocator truth at export time), so the serving loop
+        # never updates them and a scrape is always current.  The
+        # scheduler fuzz cross-checks gauge == assert_invariants truth.
+        pool = lambda k: (lambda: self.state.pool_stats()[k])  # noqa: E731
+        reg.gauge_fn("serving_kv_blocks_free", pool("free"),
+                     "plain-free KV blocks (excludes cached-free)")
+        reg.gauge_fn("serving_kv_blocks_cached_free", pool("cached_free"),
+                     "evictable prefix-cached free KV blocks")
+        reg.gauge_fn("serving_kv_blocks_referenced", pool("referenced"),
+                     "KV blocks referenced by live sequences")
+        reg.gauge_fn("serving_kv_blocks_peak_referenced",
+                     pool("peak_referenced"),
+                     "high-water mark of referenced KV blocks")
+        reg.gauge_fn("serving_kv_blocks_total", pool("total"),
+                     "KV pool size")
+        reg.gauge_fn("serving_prefix_index_entries",
+                     pool("prefix_index_entries"),
+                     "content hashes resident in the prefix-cache index")
+        reg.gauge_fn("serving_prefix_hit_rate", self._prefix_hit_rate,
+                     "cached_tokens / prompt_tokens over the measured "
+                     "window (absent before any prompt token)")
+        # --- flight recorder (telemetry/flight.py): always constructed
+        # — the happy path never touches it, and the failure path's
+        # breadcrumbs must exist BEFORE the crash someone debugs
+        self.flight = FlightRecorder()
+        # --- gated device telemetry (telemetry/device.py): cost-probe
+        # table + derived MFU/BW gauges + memory polling.  None when
+        # off: the serving loop then contains not one added clock read,
+        # device sync, or cost_analysis call (enforced by test)
+        mode = self.icfg.device_telemetry
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(f"device_telemetry={mode!r}: expected "
+                             "'auto', 'on', or 'off'")
+        # "auto" resolves OFF today: the cost probe pays one duplicate
+        # compile per program — the autotuner (ROADMAP item 4) is meant
+        # to flip it where the signals pay for themselves
+        self.devtel = DeviceTelemetry(
+            reg, "serving",
+            step_ms_fn=lambda: (self.timings["device_ms"]
+                                + self.timings["wait_ms"])) \
+            if mode == "on" else None
+        # (kind, key) of every program EVER built by this engine —
+        # unlike _warm_keys this survives LRU eviction, so a re-build
+        # is recognized as a retrace
+        self._compiled_ever: set = set()
+
+    def _prefix_hit_rate(self):
+        prompt = self.timings["prompt_tokens"]
+        if not prompt:
+            return None
+        return self.timings["cached_tokens"] / prompt
+
+    def _note_compile(self, kind: str, key) -> None:
+        """Count one executable-cache fill; a (kind, key) this engine
+        already compiled is a runtime retrace and warns loudly (the
+        dynamic complement of tpulint's static retrace-hazard rule)."""
+        tm = self.timings
+        tm["compiles"] += 1
+        if (kind, key) in self._compiled_ever:
+            tm["compile_retraces"] += 1
+            logger.warning(
+                "serving program %s/%r RECOMPILED at runtime (retrace "
+                "#%d): the executable cache is churning — LRU thrash, "
+                "shape churn, or a weight refresh",
+                kind, key, int(tm["compile_retraces"]))
+        else:
+            self._compiled_ever.add((kind, key))
 
     def reset_timings(self) -> None:
         """Zero the cumulative per-phase breakdown the serving loop
@@ -465,6 +570,16 @@ class InferenceEngine:
         self.metrics.reset()
         self.requests.clear()
         self.tracer.clear()
+        # rearm the pool high-water mark so a timed region reports ITS
+        # peak, not the warmup's (the pull-gauges read live truth)
+        self.state.allocator.reset_peaks()
+
+    def device_snapshot(self) -> Optional[Dict]:
+        """JSON-able device-telemetry summary (per-program cost
+        analysis, derived MFU / HBM-bandwidth utilization, last memory
+        poll) — what bench legs embed next to their request-metrics
+        aggregates.  None when ``device_telemetry`` is off."""
+        return None if self.devtel is None else self.devtel.snapshot()
 
     def request_metrics(self) -> Dict:
         """Per-request lifecycle story + fleet aggregate:
@@ -511,6 +626,16 @@ class InferenceEngine:
             # step/burst closures hold the old quant tree
             self._pstep_fns.clear()
             self._burst_fns.clear()
+            # the rebuilt programs recompile on their next call: they
+            # are cold again (warm programs run under the watchdog,
+            # and a deadline must never time an XLA compile)
+            self._warm_keys.clear()
+            # rebuilding against fresh weights is a LEGITIMATE
+            # recompile: reset the retrace ledger and the per-program
+            # cost table (the new programs get probed anew)
+            self._compiled_ever.clear()
+            if self.devtel is not None:
+                self.devtel.program_costs.clear()
         self._shard_weights()
 
     # ------------------------------------------------------------------
@@ -1592,15 +1717,30 @@ class InferenceEngine:
             consecutive_timeouts=self._consec_timeouts, cfg=self.fcfg)
         if verdict is None:
             raise exc
+        # the FIRST failure of a window flips health() to degraded —
+        # the transition (not every failure) is a flight-dump trigger
+        fresh_degrade = self._steps_done - self._last_failure_step \
+            > self.fcfg.health_window_steps
         self._consec_failures += 1
         self._last_failure_step = self._steps_done
         logger.warning(
             f"serving step failure at {phase} "
             f"({type(exc).__name__}: "
             f"{(str(exc).splitlines() or [''])[0][:120]}) -> {verdict}")
+        # black-box breadcrumb (telemetry/flight.py): verdicts survive
+        # in the ring even when no dump is configured, so a later
+        # debug_dump() still carries the failure history
+        self.flight.note(
+            "step_failure", verdict=verdict, phase=phase,
+            exc=type(exc).__name__, step=self._steps_done,
+            uids=[int(u) for u in uids])
         if verdict == FATAL_ENGINE:
             self._health = "dead"
             self._health_gauge.set(3)
+            self.flight.note("engine_dead", phase=phase,
+                             exc=type(exc).__name__,
+                             step=self._steps_done)
+            self._flight_autodump("engine_dead")
             raise EngineDeadError(
                 f"serving backend dead after {type(exc).__name__} at "
                 f"{phase}; snapshot() holds the host-side truth — "
@@ -1697,6 +1837,69 @@ class InferenceEngine:
             self._backoff_rounds = min(
                 self.fcfg.max_backoff_rounds,
                 1 << min(self._consec_failures - 1, 6))
+        # non-fatal auto-dump triggers (docs/OBSERVABILITY.md): a
+        # watchdog expiry (the call was abandoned — the artifact is how
+        # anyone learns what it carried) and the healthy->degraded
+        # transition of a fresh failure window
+        if isinstance(exc, DispatchTimeoutError):
+            self._flight_autodump("watchdog_expiry")
+        elif fresh_degrade:
+            self._flight_autodump("health_degraded")
+
+    def _flight_autodump(self, reason: str) -> Optional[str]:
+        """Write one black-box artifact into ``FailureConfig.
+        flight_dir`` (no-op when unset).  Best-effort: the recorder
+        itself swallows I/O failures — a post-mortem writer must never
+        make a failing engine fail harder."""
+        d = self.fcfg.flight_dir
+        if not d:
+            return None
+        import os
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError as e:
+            logger.warning("flight_dir %r unusable (%s)", d, e)
+            return None
+        # collision-avoid across engine GENERATIONS sharing one dir: a
+        # warm-restarted engine replaying the same workload dies at the
+        # same step with the same counters, and overwriting the prior
+        # engine's black box would destroy the one artifact the
+        # recorder exists to preserve
+        n = self.flight.dumps
+        while True:
+            path = os.path.join(
+                d, f"flight_{reason}_s{self._steps_done}_{n}.json")
+            if not os.path.exists(path):
+                break
+            n += 1
+        self.flight.note("dump", reason=reason, path=path)
+        return self.flight.dump(
+            path, reason, metrics=self.metrics, tracer=self.tracer,
+            requests=self.requests, health=self.health(),
+            steps=self._steps_done,
+            extra={"device": None if self.devtel is None
+                   else self.devtel.snapshot()})
+
+    def debug_dump(self, path: Optional[str] = None,
+                   reason: str = "debug") -> Dict:
+        """On-demand flight-recorder snapshot (docs/OBSERVABILITY.md
+        "Device & compiler telemetry"): the same black-box artifact the
+        failure path auto-dumps — last-N spans, full metrics snapshot,
+        recent request statuses, config fingerprint, health, failure
+        breadcrumbs, and the device-telemetry summary when enabled.
+        Returns the dict; with ``path`` also writes it as JSON (through
+        the recorder's best-effort writer — a post-mortem must never
+        make a failing engine fail harder).  Valid on a DEAD engine
+        (everything it reads is host truth)."""
+        snap = self.flight.snapshot(
+            reason, metrics=self.metrics, tracer=self.tracer,
+            requests=self.requests, health=self.health(),
+            steps=self._steps_done,
+            extra={"device": None if self.devtel is None
+                   else self.devtel.snapshot()})
+        if path is not None:
+            self.flight.dump(path, reason, snap=snap)
+        return snap
 
     def health(self) -> Dict:
         """Engine health for the router's liveness probe
@@ -1715,6 +1918,10 @@ class InferenceEngine:
         self._health_gauge.set(
             {"healthy": 0, "degraded": 1, "draining": 2,
              "dead": 3}[state])
+        if self.devtel is not None:
+            # a health check is a phase boundary: refresh the memory
+            # gauges here (one host call per device, never per step)
+            self.devtel.poll_memory()
         tm = self.timings
         return {
             "state": state,
@@ -1981,6 +2188,7 @@ class InferenceEngine:
                 # cold again or the watchdog would time the compile
                 self._warm_keys.discard(("p", evicted))
             step_fn = self._build_pstep(mbs, sampling)
+            self._note_compile("p", key)
         self._pstep_fns[key] = step_fn    # reinsert: LRU, not FIFO
         cold = ("p", key) not in self._warm_keys
         t1 = time.perf_counter()
@@ -2026,8 +2234,18 @@ class InferenceEngine:
                 # zeros — recreate it
                 self.state.kv = self.state.cfg.kv_zeros()
                 self._pstep_fns.clear()
+                # a backend-capability fallback is a LEGITIMATE rebuild
+                # of every serving program (like refresh_params): the
+                # dropped programs are cold again and their keys leave
+                # the retrace ledger — this must not count (or warn) as
+                # cache churn
+                self._warm_keys = {k for k in self._warm_keys
+                                   if k[0] != "p"}
+                self._compiled_ever = {k for k in self._compiled_ever
+                                       if k[0] != "p"}
                 step_fn = self._pstep_fns[key] = self._build_pstep(
                     mbs, sampling)
+                self._note_compile("p", key)
                 toks, self.state.kv = step_fn(
                     self.params, self._quant, self.state.kv, batch, prev,
                     rng)
@@ -2048,6 +2266,21 @@ class InferenceEngine:
         tm["stage_ms"] += (t2 - t1) * 1e3
         tm["device_ms"] += (t3 - t2) * 1e3
         tm["steps"] += 1
+        if cold:
+            # first completed call of this program: its dispatch wall
+            # time carried the XLA compile (the timestamps are the ones
+            # above — the compile span costs no extra clock reads)
+            tm["compile_ms"] += (t3 - t2) * 1e3
+            if self.devtel is not None:
+                # cost-analysis probe, once per program, on the warm
+                # executable — args are the post-call live buffers
+                # (the donated kv was rebound to the step's output)
+                self.devtel.probe_program(
+                    ("p",) + key, step_fn,
+                    (self.params, self._quant, self.state.kv, batch,
+                     prev, rng))
+        if self.devtel is not None:
+            self.devtel.on_dispatch(("p",) + key)
         for uid, _ in sched:
             self.requests.on_prefill_start(uid, t3)
         tr = self.tracer
@@ -2059,6 +2292,9 @@ class InferenceEngine:
             tr.record("stage", t1, t2, track="stage", sid=sid)
             tr.record("dispatch", t2, t3, track="dispatch", sid=sid,
                       n_tokens=sum(len(t) for _, t in sched))
+            if cold:
+                tr.record("compile", t2, t3, track="dispatch", sid=sid,
+                          key=repr(key))
         emit = tuple((uid, self.state.slot(uid)) for uid, _ in sched
                      if not self._pending.get(uid))
         for uid in uids:
@@ -2336,20 +2572,31 @@ class InferenceEngine:
                 self._burst_fns.pop(evicted)
                 self._warm_keys.discard(("b", evicted))
             self._burst_fns[key] = self._build_burst(steps, sampling, P)
+            self._note_compile("b", key)
         burst_cold = ("b", key) not in self._warm_keys
         if rng is None:
             self._rng, rng = jax.random.split(self._rng)
         t0 = time.perf_counter()
         burst_fn = self._burst_fns[key]
+        # staging runs INSIDE the guarded call: a device error (or
+        # hang) during the host->device transfers must route through
+        # the watchdog + classifier like the dispatch itself.  The
+        # staged operands are kept for the one-time cost probe below
+        staged_box: List[tuple] = []
+
+        def _staged_burst():
+            staged = (self._stage(jnp.asarray(tables)),
+                      self._stage(jnp.asarray(base)),
+                      self._stage(jnp.asarray(tok0)),
+                      self._stage(jnp.asarray(uids_arr)),
+                      self._stage(rng))
+            staged_box.append(staged)
+            return burst_fn(self.params, self._quant, self.state.kv,
+                            *staged)
+
         try:
             toks, self.state.kv = self.failures.run(
-                lambda: burst_fn(
-                    self.params, self._quant, self.state.kv,
-                    self._stage(jnp.asarray(tables)),
-                    self._stage(jnp.asarray(base)),
-                    self._stage(jnp.asarray(tok0)),
-                    self._stage(jnp.asarray(uids_arr)), self._stage(rng)),
-                uids=tuple(pending), cold=burst_cold)
+                _staged_burst, uids=tuple(pending), cold=burst_cold)
             t1 = time.perf_counter()
             toks_np = self._fetch_tokens(toks)         # ONE fetch
         except Exception as e:
@@ -2360,6 +2607,18 @@ class InferenceEngine:
             self._handle_step_failure(e, tuple(pending), "burst")
             return {}
         self._warm_keys.add(("b", key))
+        if burst_cold:
+            self.timings["compile_ms"] += (t1 - t0) * 1e3
+            if self.devtel is not None and staged_box:
+                self.devtel.probe_program(
+                    ("b",) + key, burst_fn,
+                    (self.params, self._quant, self.state.kv)
+                    + staged_box[-1])
+        if self.devtel is not None:
+            # one burst = `steps` model invocations of this program's
+            # scan body; cost_analysis already prices the WHOLE scan,
+            # so the program cost is attributed once per dispatch
+            self.devtel.on_dispatch(("b",) + key)
         self._steps_done += steps
         # burst success resets escalation/strikes like a collected
         # step — without this a burst-heavy workload would count

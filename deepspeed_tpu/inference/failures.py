@@ -155,6 +155,12 @@ class FailureConfig:
     # health(): "degraded" while the last failure is within this many
     # steps (docs/OBSERVABILITY.md health-state table)
     health_window_steps: int = 64
+    # post-mortem flight recorder (telemetry/flight.py): directory to
+    # auto-dump the black-box JSON into on watchdog expiry, on the
+    # fatal engine-dead transition, and on the first healthy->degraded
+    # transition of a failure window.  None (default) disables the
+    # automatic dumps; ``engine.debug_dump(path)`` works regardless.
+    flight_dir: Optional[str] = None
 
     def __post_init__(self):
         t = self.dispatch_timeout_ms

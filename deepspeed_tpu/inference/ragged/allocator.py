@@ -47,6 +47,10 @@ class BlockedAllocator:
         self._cached_free: "OrderedDict[int, None]" = OrderedDict()
         self._hashed: Set[int] = set()   # blocks registered in the index
         self.on_evict = on_evict
+        # high-water mark of the referenced pool (pure int compare on
+        # the paths that grow it — the pool-pressure gauge device
+        # telemetry exports; reset_peaks() rearms it for a bench leg)
+        self._peak_referenced = 0
 
     # ---- introspection ---------------------------------------------------
     @property
@@ -65,6 +69,14 @@ class BlockedAllocator:
     @property
     def total_blocks(self) -> int:
         return self._num_blocks
+
+    @property
+    def peak_referenced_blocks(self) -> int:
+        """High-water mark of concurrently referenced blocks."""
+        return self._peak_referenced
+
+    def reset_peaks(self) -> None:
+        self._peak_referenced = len(self._refs)
 
     def refcount(self, block: int) -> int:
         return self._refs.get(block, 0)
@@ -110,6 +122,8 @@ class BlockedAllocator:
             out.append(b)
         for b in out:
             self._refs[b] = 1
+        if len(self._refs) > self._peak_referenced:
+            self._peak_referenced = len(self._refs)
         return out
 
     def ref(self, block: int) -> None:
@@ -120,6 +134,8 @@ class BlockedAllocator:
         elif block in self._cached_free:
             del self._cached_free[block]
             self._refs[block] = 1
+            if len(self._refs) > self._peak_referenced:
+                self._peak_referenced = len(self._refs)
         else:
             raise ValueError(
                 f"Cannot ref block {block}: not referenced or cached-free")

@@ -431,6 +431,25 @@ class StateManager:
         self._hash_index.clear()
         self.cow_pending.clear()
 
+    def pool_stats(self) -> Dict[str, int]:
+        """Allocator-truth pool occupancy — the numbers the engine's
+        ``serving_kv_*`` pull-gauges export (docs/OBSERVABILITY.md
+        "Device & compiler telemetry").  Computed from the SAME state
+        ``BlockedAllocator.assert_invariants`` checks, so the scheduler
+        fuzz can cross-check gauge == truth after every op; pure host
+        ints, safe to read at any phase boundary."""
+        al = self.allocator
+        return {
+            "free": len(al._free),
+            "cached_free": al.cached_free_blocks,
+            "referenced": al.referenced_blocks,
+            "total": al.total_blocks,
+            "peak_referenced": al.peak_referenced_blocks,
+            "prefix_index_entries": len(self._hash_index),
+            "live_seqs": len(self.seqs),
+            "free_slots": len(self._free_slots),
+        }
+
     def take_cow_copies(self) -> List[Tuple[int, int]]:
         """Hand the queued (src, dst) copy-on-write block copies to the
         engine (which executes them on device BEFORE the next step) and

@@ -39,7 +39,7 @@ from ..comm.collectives import init_distributed
 from ..config.config import Config, ConfigError, load_config
 from ..parallel.zero import ZeroPolicy
 from ..parallel import sharding as shd
-from ..telemetry import MetricsRegistry, SpanTracer
+from ..telemetry import DeviceTelemetry, MetricsRegistry, SpanTracer
 from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from .loss_scaler import LossScaler, LossScaleState, all_finite
@@ -292,19 +292,54 @@ class Engine:
                                  enabled=tcfg.trace)
         reg = self.metrics
         self._phase_ms = {
-            k: reg.counter(f"train_{k}_ms_total",
+            k: reg.counter(f"training_{k}_ms_total",
                            f"cumulative host milliseconds in the {k} "
                            "phase of train_batch")
             for k in ("pre_step", "stage", "dispatch", "fetch")}
-        self._c_steps = reg.counter("train_steps_total",
+        self._c_steps = reg.counter("training_steps_total",
                                     "optimizer steps taken",
                                     int_valued=True)
         self._h_step_host = reg.histogram(
-            "train_step_host_ms",
+            "training_step_host_ms",
             (0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
              1000.0, 2000.0, 5000.0, 10000.0, 60000.0),
             "host-side wall ms per train_batch call (dispatch is async: "
             "device time appears here only when something blocks)")
+        # compile observatory (docs/OBSERVABILITY.md "Device & compiler
+        # telemetry"): always-on host counters — a train-step rebuild
+        # after the first is a runtime retrace and warns loudly (the
+        # dynamic complement of tpulint's static retrace-hazard rule)
+        self._c_compiles = reg.counter(
+            "training_compiles_total",
+            "training step programs built (jit-cache fills)",
+            int_valued=True)
+        self._c_retraces = reg.counter(
+            "training_compile_retraces_total",
+            "re-builds of a program key this engine had already "
+            "compiled (runtime retrace — each warns loudly)",
+            int_valued=True)
+        self._compiled_ever: set = set()
+        # gated device telemetry (telemetry/device.py): per-program
+        # cost_analysis + derived training_mfu / training_hbm_bw_util
+        # gauges (divided by the throughput timer's step wall — the
+        # training dispatch is async, so host phase ms would lie) +
+        # memory polling at the steps_per_print boundary.  config:
+        # {"telemetry": {"device": true}}
+        self.devtel = DeviceTelemetry(
+            reg, "training",
+            step_ms_fn=lambda: self.tput.total_elapsed_time * 1e3) \
+            if tcfg.device else None
+
+    def _note_compile(self, key: str) -> None:
+        self._c_compiles.inc()
+        if key in self._compiled_ever:
+            self._c_retraces.inc()
+            logger.warning(
+                "training program %r RECOMPILED at runtime (retrace "
+                "#%d) — something invalidated the step executable",
+                key, int(self._c_retraces.value()))
+        else:
+            self._compiled_ever.add(key)
 
     def metrics_snapshot(self) -> Dict[str, Any]:
         """JSON-able snapshot of the training metrics registry; see also
@@ -1553,6 +1588,16 @@ class Engine:
             self.state, metrics = step_fn(self.state, batch, rng)
         self._offload_validated = True
         t3 = time.perf_counter()
+        if self.devtel is not None:
+            # cost probe once per program (post-call: the donated state
+            # was rebound to the step's output, same avals), then
+            # attribute this dispatch's flops/bytes from the table
+            pkey = ("train_step_warmup"
+                    if step_fn is self._warmup_step_fn else "train_step")
+            if pkey not in self.devtel.program_costs:
+                self.devtel.probe_program(pkey, step_fn,
+                                          (self.state, batch, rng))
+            self.devtel.on_dispatch(pkey)
         self._phase_ms["pre_step"].inc((t1 - t0) * 1e3)
         self._phase_ms["stage"].inc((t2 - t1) * 1e3)
         self._phase_ms["dispatch"].inc((t3 - t2) * 1e3)
@@ -1575,9 +1620,11 @@ class Engine:
             if self._warmup_step_fn is None:
                 self._warmup_step_fn = self._build_train_step(
                     onebit_compress=False)
+                self._note_compile("train_step_warmup")
             return self._warmup_step_fn
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
+            self._note_compile("train_step")
         return self._train_step_fn
 
     def _finish_step(self, batch, rng, metrics) -> Dict[str, Any]:
@@ -1596,6 +1643,14 @@ class Engine:
         need_host = (self.global_steps % self.config.steps_per_print == 0
                      or self.monitor is not None)
         if need_host:
+            if self.devtel is not None and self.global_steps \
+                    % self.config.steps_per_print == 0:
+                # the steps_per_print boundary is the training loop's
+                # phase boundary: refresh the memory gauges here (one
+                # host call per device — NOT every step; a configured
+                # monitor makes need_host true per step, so the poll
+                # keeps its own cadence guard like publish below)
+                self.devtel.poll_memory()
             t_f0 = time.perf_counter()
             fetched = jax.device_get(metrics)        # ONE transfer
             t_f1 = time.perf_counter()

@@ -13,14 +13,21 @@ Three pieces, composable and JAX-free:
   queue wait / token accounting) for the inference engine.
 """
 
-from .metrics import (Counter, CounterDictView, Gauge, Histogram,
+from .metrics import (Counter, CounterDictView, FnGauge, Gauge, Histogram,
                       MetricsRegistry, parse_prometheus_text)
 from .lifecycle import (QUEUE_WAIT_BUCKETS_MS, RequestRecord,
                         RequestTracker, TERMINAL_STATUSES,
                         TPOT_BUCKETS_MS, TTFT_BUCKETS_MS)
 from .tracer import SpanTracer
+from .device import (DeviceTelemetry, cost_analysis_of, peak_flops,
+                     peak_hbm_bw, poll_memory_stats)
+from .flight import (FlightRecorder, config_fingerprint,
+                     validate_flight_dump)
 
-__all__ = ["SpanTracer", "MetricsRegistry", "Counter", "Gauge",
+__all__ = ["SpanTracer", "MetricsRegistry", "Counter", "Gauge", "FnGauge",
            "Histogram", "CounterDictView", "parse_prometheus_text",
            "RequestTracker", "RequestRecord", "TERMINAL_STATUSES",
-           "TTFT_BUCKETS_MS", "TPOT_BUCKETS_MS", "QUEUE_WAIT_BUCKETS_MS"]
+           "TTFT_BUCKETS_MS", "TPOT_BUCKETS_MS", "QUEUE_WAIT_BUCKETS_MS",
+           "DeviceTelemetry", "cost_analysis_of", "peak_flops",
+           "peak_hbm_bw", "poll_memory_stats", "FlightRecorder",
+           "config_fingerprint", "validate_flight_dump"]

@@ -120,6 +120,51 @@ class Gauge(Metric):
         self._values[k] = self._values.get(k, 0.0) + amount
 
 
+class FnGauge(Gauge):
+    """Pull-based gauge: its value is computed by a zero-arg callable at
+    *read/export* time (snapshot / Prometheus exposition / monitor
+    fan-out), so the measured subsystem never pays an update on its hot
+    path and a scrape always sees the current truth.  The callable
+    returns a number, or ``None`` for "no sample right now" — the gauge
+    is then ABSENT from the exposition (the contract device telemetry
+    uses for probes a backend does not support: absent, never fake).
+    Exceptions from the callable also read as absent (a gauge must
+    never take the exporter down), ``set()`` raises (there is nothing
+    to set), and ``reset()`` is a no-op (the source owns the state)."""
+    kind = "gauge"
+
+    def __init__(self, name: str, fn, help: str = ""):
+        super().__init__(name, help)
+        self._fn = fn
+
+    def value(self, **labels) -> float:
+        v = self._read()
+        return 0.0 if v is None else v
+
+    def _read(self) -> Optional[float]:
+        try:
+            v = self._fn()
+        except Exception:  # tpulint: disable=silent-except — a broken probe reads as an absent sample, never an export crash
+            return None
+        return None if v is None else float(v)
+
+    def series(self) -> Iterator[Tuple[LabelKey, float]]:
+        v = self._read()
+        if v is not None:
+            yield (), v
+
+    def set(self, value: float, **labels) -> None:
+        raise TypeError(f"{self.name} is a pull-based FnGauge; "
+                        "its source computes the value")
+
+    def inc(self, amount: float = 1, **labels) -> None:
+        raise TypeError(f"{self.name} is a pull-based FnGauge; "
+                        "its source computes the value")
+
+    def reset(self) -> None:
+        pass                    # the source owns the state
+
+
 class Histogram(Metric):
     """Fixed-bucket histogram (cumulative ``le`` buckets, Prometheus
     semantics).  Bucket bounds are chosen at registration — observation
@@ -237,6 +282,20 @@ class MetricsRegistry:
     def gauge(self, name: str, help: str = "") -> Gauge:
         return self._register(name, lambda: Gauge(name, help), "gauge")
 
+    def gauge_fn(self, name: str, fn, help: str = "") -> FnGauge:
+        """Register a pull-based gauge computed by ``fn()`` at read
+        time (:class:`FnGauge`); re-registering rebinds the callable
+        (an engine rebuilt over the same registry must not read a dead
+        object's state).  A name already held by a PLAIN gauge raises —
+        silently dropping the callable would freeze the metric."""
+        g = self._register(name, lambda: FnGauge(name, fn, help), "gauge")
+        if not isinstance(g, FnGauge):
+            raise ValueError(
+                f"metric {name!r} already registered as a set-based "
+                "gauge; gauge_fn cannot rebind it to a callable")
+        g._fn = fn
+        return g
+
     def histogram(self, name: str, buckets: Sequence[float],
                   help: str = "") -> Histogram:
         return self._register(
@@ -278,6 +337,11 @@ class MetricsRegistry:
                         for k in sorted(m._counts)}
                 continue
             vals = dict(m.series())
+            if not vals:
+                # a pull-based gauge with no current sample (FnGauge
+                # returning None — e.g. memory_stats on a backend
+                # without them) is ABSENT, not zero
+                continue
             if list(vals) == [()]:
                 v = vals[()]
                 out[name] = int(v) if getattr(m, "int_valued", False) \

@@ -1,0 +1,117 @@
+"""tools/benchdiff regression sentinel — the tier-1 gate that turns the
+BENCH_r* trajectory from an eyeballed log into a guarded one: same
+config fingerprint => hard per-leg thresholds (nonzero exit on
+regression), changed fingerprint => report-only.  Pure host JSON work,
+no JAX."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.benchdiff import (compare, diff_files, main,  # noqa: E402
+                             metric_direction, smoke)
+
+
+def test_smoke_is_the_acceptance_check():
+    out = smoke()
+    assert out["ok"] and len(out["checks"]) == 6
+
+
+def test_metric_direction_classification():
+    assert metric_direction("pipe2_decode_tok_s") == 1
+    assert metric_direction("value") == 1
+    assert metric_direction("shared_prefix_speedup") == 1
+    assert metric_direction("goodput_qps_sla4") == 1
+    assert metric_direction("mfu") == 1
+    assert metric_direction("serving_ttft_p50_ms") == -1
+    assert metric_direction("llama8b_int8_decode_ms_per_tok_ema") == -1
+    assert metric_direction("platform") is None
+    assert metric_direction("steps") is None
+    assert metric_direction("config_hash") is None
+
+
+def test_matching_fingerprint_enforces_and_exits_nonzero(tmp_path):
+    old = {"engine_version": "1", "config_hash": "aaaa",
+           "value": 100.0, "serving_decode_tok_s": 700.0}
+    new = dict(old, serving_decode_tok_s=400.0)
+    po, pn = tmp_path / "old.json", tmp_path / "new.json"
+    po.write_text(json.dumps(old))
+    pn.write_text(json.dumps(new))
+    assert main([str(po), str(pn), "--json"]) == 1
+    v = diff_files(str(po), str(pn))
+    assert v["enforced"] and not v["ok"]
+    assert v["regressions"][0]["metric"] == "serving_decode_tok_s"
+    # same capture against itself is green
+    assert main([str(po), str(po)]) == 0
+
+
+def test_mismatched_fingerprint_is_report_only(tmp_path):
+    old = {"engine_version": "1", "config_hash": "aaaa",
+           "value": 100.0, "serving_decode_tok_s": 700.0}
+    new = {"engine_version": "2", "config_hash": "bbbb",
+           "value": 100.0, "serving_decode_tok_s": 400.0}
+    po, pn = tmp_path / "old.json", tmp_path / "new.json"
+    po.write_text(json.dumps(old))
+    pn.write_text(json.dumps(new))
+    assert main([str(po), str(pn)]) == 0          # reported, not gated
+    v = diff_files(str(po), str(pn))
+    assert not v["enforced"] and v["ok"] and v["regressions"]
+
+
+def test_missing_fingerprint_never_enforces():
+    # pre-PR-8 captures (BENCH_r01..r05) carry no config_hash: nothing
+    # to anchor comparability, so the gate must not fire
+    v = compare({"value": 100.0}, {"value": 10.0})
+    assert not v["enforced"] and v["ok"] and v["regressions"]
+
+
+def test_diagnostic_subtrees_and_directionless_keys_skipped():
+    old = {"config_hash": "x", "engine_version": "1", "value": 10.0,
+           "steps": 100, "platform": "cpu",
+           "serving_request_metrics": {"ttft_ms": {"p50": 5.0}}}
+    new = dict(old, steps=1, platform="tpu",
+               serving_request_metrics={"ttft_ms": {"p50": 500.0}})
+    assert compare(old, new)["ok"]
+
+
+def test_latency_direction_and_threshold_boundary():
+    base = {"config_hash": "x", "engine_version": "1",
+            "serving_ttft_p50_ms": 100.0}
+    assert compare(base, dict(base, serving_ttft_p50_ms=114.0))["ok"]
+    assert not compare(base, dict(base, serving_ttft_p50_ms=120.0))["ok"]
+    # looser threshold clears it
+    assert compare(base, dict(base, serving_ttft_p50_ms=120.0),
+                   threshold=0.3)["ok"]
+
+
+def test_dropped_leg_is_a_regression():
+    base = {"config_hash": "x", "engine_version": "1",
+            "value": 10.0, "spec_decode_speedup": 1.5}
+    v = compare(base, {"config_hash": "x", "engine_version": "1",
+                       "value": 10.0})
+    assert not v["ok"] and v["only_old"] == ["spec_decode_speedup"]
+
+
+def test_cli_smoke_leg():
+    """The wired tier-1 leg: ``python -m tools.benchdiff --smoke``."""
+    r = subprocess.run([sys.executable, "-m", "tools.benchdiff",
+                        "--smoke"], cwd=REPO, capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["ok"]
+
+
+def test_real_capture_parses_if_present():
+    """benchdiff must at least parse the repo's own BENCH trajectory
+    (old captures have no fingerprint -> report-only)."""
+    captures = sorted(REPO.glob("BENCH_r*.json"))
+    if len(captures) < 2:
+        pytest.skip("fewer than two BENCH captures in the repo")
+    v = diff_files(str(captures[-2]), str(captures[-1]))
+    assert isinstance(v["regressions"], list)

@@ -87,6 +87,25 @@ def _check_pool_accounting(eng):
     # block that sequence actually holds
     for uid, src, dst in st.cow_pending:
         assert uid in st.seqs and dst in st.seqs[uid].blocks
+    # the device-telemetry pull-gauges (docs/OBSERVABILITY.md "Device &
+    # compiler telemetry") read allocator truth at export time — a
+    # scrape after ANY op must equal the reality assert_invariants just
+    # validated, or the gauges are lying to the router/autotuner
+    snap = eng.metrics_snapshot()
+    ps = st.pool_stats()
+    assert snap["serving_kv_blocks_referenced"] == ps["referenced"] \
+        == al.referenced_blocks
+    assert snap["serving_kv_blocks_cached_free"] == ps["cached_free"] \
+        == al.cached_free_blocks
+    assert snap["serving_kv_blocks_free"] == ps["free"] \
+        == al.free_blocks - al.cached_free_blocks
+    assert snap["serving_kv_blocks_total"] == al.total_blocks
+    assert (snap["serving_kv_blocks_free"]
+            + snap["serving_kv_blocks_cached_free"]
+            + snap["serving_kv_blocks_referenced"]) == al.total_blocks
+    assert snap["serving_kv_blocks_peak_referenced"] \
+        == al.peak_referenced_blocks >= al.referenced_blocks
+    assert snap["serving_prefix_index_entries"] == len(st._hash_index)
 
 
 @pytest.mark.parametrize("seed", range(4))

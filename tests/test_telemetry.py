@@ -492,7 +492,8 @@ class TestEngineTelemetry:
         eng.generate({0: [1, 2, 3, 4]}, SamplingParams(max_new_tokens=4))
         tm = eng.timings
         assert set(tm) == {"schedule_ms", "stage_ms", "device_ms",
-                           "wait_ms", "readback_ms", "steps",
+                           "wait_ms", "readback_ms", "compile_ms",
+                           "steps", "compiles", "compile_retraces",
                            "prompt_tokens", "cached_tokens",
                            "prefix_hits", "generated_tokens",
                            "spec_drafted_tokens", "spec_accepted_tokens",
@@ -575,10 +576,10 @@ class TestTrainingTelemetry:
         for _ in range(2):
             eng.train_batch(self._batch(eng))
         snap = eng.metrics_snapshot()
-        assert snap["train_steps_total"] == 2
-        assert snap["train_step_host_ms"]["count"] == 2
-        for k in ("train_pre_step_ms_total", "train_stage_ms_total",
-                  "train_dispatch_ms_total"):
+        assert snap["training_steps_total"] == 2
+        assert snap["training_step_host_ms"]["count"] == 2
+        for k in ("training_pre_step_ms_total", "training_stage_ms_total",
+                  "training_dispatch_ms_total"):
             assert snap[k] >= 0.0
         names = {e["name"] for e in eng.tracer.events()}
         assert {"pre_step", "stage", "dispatch", "fetch"} <= names
@@ -603,5 +604,5 @@ class TestTrainingTelemetry:
         names = {n for n, _, _ in mon.events}
         # loss scalars AND registry metrics through ONE writer
         assert "Train/loss" in names
-        assert "train_steps_total" in names
-        assert "train_step_host_ms_count" in names
+        assert "training_steps_total" in names
+        assert "training_step_host_ms_count" in names

@@ -1,0 +1,15 @@
+"""Known-bad fixture for the metric-name rule: registry metric names
+off the ^(serving|training)_[a-z0-9_]+$ grammar, and a duplicate
+registration site forking a series."""
+
+
+def setup_metrics(registry):
+    registry.counter("request_count")               # BAD: no family prefix
+    registry.gauge("serving_QueueDepth")            # BAD: uppercase
+    registry.histogram("servng_ttft_ms", (1.0,))    # BAD: typo'd prefix
+    registry.gauge_fn("serving-mfu", lambda: 0.0)   # BAD: dash not underscore
+    for k in ("schedule", "stage"):
+        registry.counter(f"srv_{k}_ms_total")       # BAD: dynamic head off-grammar
+    a = registry.counter("serving_tokens_total")
+    b = registry.counter("serving_tokens_total")    # BAD: second site forks the series
+    return a, b

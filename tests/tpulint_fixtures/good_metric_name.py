@@ -1,0 +1,17 @@
+"""Known-good fixture for the metric-name rule: grammar-conforming
+names, a prefix-carrying dynamic name, a fully dynamic name (skipped as
+unverifiable), and a non-registry receiver (out of scope)."""
+
+
+def setup_metrics(registry, reg, sink, compute_name):
+    registry.counter("serving_steps_total")
+    reg.gauge("training_mfu")
+    registry.histogram("serving_ttft_ms", (1.0, 2.0))
+    registry.gauge_fn("serving_kv_blocks_free", lambda: 0)
+    for k in ("schedule", "stage"):
+        registry.counter(f"serving_{k}_ms_total")
+    # fully dynamic: the rule cannot verify it and stays quiet
+    registry.counter(compute_name())
+    # not a metrics registry: naming is that object's own business
+    sink.counter("WhateverCase")
+    return registry

@@ -1,0 +1,246 @@
+"""BENCH JSON regression sentinel (docs/OBSERVABILITY.md "Device &
+compiler telemetry" — the benchdiff workflow).
+
+The bench trajectory (BENCH_r01.json, r02, ...) has so far been guarded
+by eyeballs: a PR that quietly cost 20% of decode throughput would land
+green.  ``benchdiff`` compares two BENCH captures **fingerprint-aware**
+(the ``bench_fingerprint()`` PR 8 put in every capture):
+
+* **same ``config_hash``** — the two runs measured the same default
+  engine, so the numbers are comparable: every top-level leg metric is
+  held to a hard relative threshold and any regression exits nonzero
+  (the CI contract).
+* **different ``config_hash``** — a PR changed engine defaults, so
+  every leg moved for config reasons; the comparison is REPORT-ONLY
+  (printed, exit 0) because a hard gate would either mask real
+  regressions behind "the hash changed" or block every default-changing
+  PR on noise.
+
+Only **top-level numeric leg metrics** with a recognizable direction
+are compared — ``*_tok_s`` / ``*_speedup`` / ``goodput_qps_*`` / ``mfu``
+up-is-better, ``*_ttft*`` / ``*_ms*`` / ``*_ema`` down-is-better.
+Nested diagnostic subtrees (``*_request_metrics``, ``train_metrics``,
+SLO curves, chaos variant tallies) are deliberately skipped: they are
+post-mortem material, not gateable headline numbers.
+
+CLI::
+
+    python -m tools.benchdiff OLD.json NEW.json [--threshold 0.15]
+    python -m tools.benchdiff --smoke       # tier-1 self-check (asserts)
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+# direction markers matched against the (lowercased) metric name;
+# first match wins, unmatched names are skipped as directionless
+_HIGHER_BETTER = ("tok_s", "speedup", "goodput", "mfu", "hit_rate",
+                  "acceptance_rate", "bw_util", "vs_baseline")
+_LOWER_BETTER = ("ttft", "tpot", "_ms", "ms_per", "ema", "latency")
+
+
+def metric_direction(name: str) -> Optional[int]:
+    """+1 up-is-better, -1 down-is-better, None not gateable.  The
+    headline ``value`` key (the gpt2s tokens/s number) is up-is-better
+    by definition of the bench."""
+    low = name.lower()
+    if low == "value" or any(m in low for m in _HIGHER_BETTER):
+        return 1
+    if any(m in low for m in _LOWER_BETTER):
+        return -1
+    return None
+
+
+def _leg_metrics(bench: Dict[str, Any]) -> Dict[str, float]:
+    """Top-level numeric leg metrics with a direction (bools are not
+    metrics; nested dicts are diagnostics and skipped)."""
+    out = {}
+    for k, v in bench.items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        if metric_direction(k) is not None:
+            out[k] = float(v)
+    return out
+
+
+def compare(old: Dict[str, Any], new: Dict[str, Any],
+            threshold: float = 0.15) -> Dict[str, Any]:
+    """Compare two BENCH captures; returns the verdict dict::
+
+        {"fingerprint_match": bool, "enforced": bool,
+         "regressions": [...], "improvements": [...], "unchanged": n,
+         "only_old": [...], "only_new": [...], "ok": bool}
+
+    ``ok`` is False only for an ENFORCED (matching-fingerprint) run
+    with regressions; a mismatched fingerprint reports but never
+    fails.  A leg metric present in ``old`` but absent from ``new``
+    counts as a regression too — a silently dropped bench leg must not
+    read as green (error keys like ``<leg>_error`` mark the drop)."""
+    old_fp = (old.get("config_hash"), old.get("engine_version"))
+    new_fp = (new.get("config_hash"), new.get("engine_version"))
+    match = old_fp[0] is not None and old_fp[0] == new_fp[0]
+    om, nm = _leg_metrics(old), _leg_metrics(new)
+    regressions: List[Dict[str, Any]] = []
+    improvements: List[Dict[str, Any]] = []
+    unchanged = 0
+    for k in sorted(set(om) & set(nm)):
+        d = metric_direction(k)
+        o, n = om[k], nm[k]
+        if o == 0:
+            unchanged += 1
+            continue
+        rel = (n - o) / abs(o)
+        entry = {"metric": k, "old": o, "new": n,
+                 "rel_change": round(rel, 4)}
+        if d * rel < -threshold:
+            regressions.append(entry)
+        elif d * rel > threshold:
+            improvements.append(entry)
+        else:
+            unchanged += 1
+    only_old = sorted(set(om) - set(nm))
+    only_new = sorted(set(nm) - set(om))
+    for k in only_old:
+        regressions.append({"metric": k, "old": om[k], "new": None,
+                            "rel_change": None,
+                            "note": "leg metric disappeared"})
+    return {
+        "fingerprint_match": match,
+        "old_fingerprint": {"config_hash": old_fp[0],
+                            "engine_version": old_fp[1]},
+        "new_fingerprint": {"config_hash": new_fp[0],
+                            "engine_version": new_fp[1]},
+        "enforced": match,
+        "threshold": threshold,
+        "regressions": regressions,
+        "improvements": improvements,
+        "unchanged": unchanged,
+        "only_old": only_old,
+        "only_new": only_new,
+        "ok": match is False or not regressions,
+    }
+
+
+def diff_files(old_path: str, new_path: str,
+               threshold: float = 0.15) -> Dict[str, Any]:
+    with open(old_path) as f:
+        old = json.load(f)
+    with open(new_path) as f:
+        new = json.load(f)
+    return compare(old, new, threshold)
+
+
+def _render(v: Dict[str, Any]) -> str:
+    lines = []
+    mode = "ENFORCED (same config_hash)" if v["enforced"] else \
+        "REPORT-ONLY (config_hash changed — defaults moved, legs " \
+        "are not comparable as regressions)"
+    lines.append(f"benchdiff: {mode}, threshold ±{v['threshold']:.0%}")
+    for e in v["regressions"]:
+        if e.get("new") is None:
+            lines.append(f"  REGRESSION {e['metric']}: "
+                         f"{e['old']} -> MISSING")
+        else:
+            lines.append(f"  REGRESSION {e['metric']}: {e['old']} -> "
+                         f"{e['new']} ({e['rel_change']:+.1%})")
+    for e in v["improvements"]:
+        lines.append(f"  improved   {e['metric']}: {e['old']} -> "
+                     f"{e['new']} ({e['rel_change']:+.1%})")
+    lines.append(f"  unchanged: {v['unchanged']}, "
+                 f"new-only legs: {len(v['only_new'])}")
+    lines.append("benchdiff: " + ("OK" if v["ok"] else "REGRESSED"))
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# smoke: the tier-1 self-check (synthetic captures, asserts)
+# --------------------------------------------------------------------------
+
+def smoke() -> Dict[str, Any]:
+    """Deterministic self-check on synthetic BENCH captures: one
+    regressed leg under a MATCHING fingerprint must fail; the same
+    regression under a MISMATCHED fingerprint must report-only; an
+    improvement must never flag; a disappeared leg must fail."""
+    base = {"engine_version": "1.0", "config_hash": "aaaa",
+            "value": 1000.0,                       # headline tok/s
+            "pipe2_decode_tok_s": 500.0,
+            "serving_ttft_p50_ms": 100.0,
+            "spec_decode_speedup": 1.8,
+            "goodput_qps_sla2": 2.0,
+            "platform": "cpu", "steps": 40,        # directionless: skipped
+            "serving_request_metrics": {"ttft_ms": {"p50": 1.0}}}
+
+    regressed = dict(base, pipe2_decode_tok_s=350.0)       # -30% tok/s
+    v = compare(base, regressed)
+    assert v["enforced"] and not v["ok"], v
+    assert [e["metric"] for e in v["regressions"]] \
+        == ["pipe2_decode_tok_s"], v["regressions"]
+
+    lat_regressed = dict(base, serving_ttft_p50_ms=140.0)  # +40% latency
+    v = compare(base, lat_regressed)
+    assert not v["ok"] and v["regressions"][0]["metric"] \
+        == "serving_ttft_p50_ms", v
+
+    mismatched = dict(regressed, config_hash="bbbb")
+    v_mm = compare(base, mismatched)
+    assert not v_mm["enforced"] and v_mm["ok"], v_mm       # report-only
+    assert v_mm["regressions"], "mismatch must still REPORT the delta"
+
+    improved = dict(base, pipe2_decode_tok_s=800.0,
+                    serving_ttft_p50_ms=50.0)
+    v_up = compare(base, improved)
+    assert v_up["ok"] and len(v_up["improvements"]) == 2, v_up
+
+    dropped = {k: v2 for k, v2 in base.items()
+               if k != "spec_decode_speedup"}
+    v_drop = compare(base, dropped)
+    assert not v_drop["ok"] and any(
+        e.get("note") == "leg metric disappeared"
+        for e in v_drop["regressions"]), v_drop
+
+    within = dict(base, pipe2_decode_tok_s=460.0)          # -8% < 15%
+    assert compare(base, within)["ok"]
+
+    return {"ok": True,
+            "checks": ["enforced_regression_fails",
+                       "latency_regression_fails",
+                       "fingerprint_mismatch_report_only",
+                       "improvement_passes",
+                       "dropped_leg_fails",
+                       "within_threshold_passes"]}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old", nargs="?", help="baseline BENCH JSON")
+    ap.add_argument("new", nargs="?", help="candidate BENCH JSON")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="relative regression threshold per leg "
+                    "(default 0.15)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the verdict dict as JSON")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the deterministic self-check (asserts; "
+                    "the tier-1 leg)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        out = smoke()
+        print(json.dumps(out))  # tpulint: disable=print — CLI output
+        return 0
+    if not args.old or not args.new:
+        ap.error("OLD and NEW BENCH JSONs required (or --smoke)")
+    verdict = diff_files(args.old, args.new, args.threshold)
+    if args.json:
+        print(json.dumps(verdict))  # tpulint: disable=print — CLI output
+    else:
+        print(_render(verdict))  # tpulint: disable=print — CLI output
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -533,30 +533,46 @@ def chaos_smoke(seed: int = 0) -> Dict:
     * every NON-poisoned request's token stream is EXACTLY the
       fault-free run's — crash re-queues, bisection probes, watchdog
       retries, and the snapshot/restore each resume token-identically
-      (greedy and seeded, cache on and off)."""
+      (greedy and seeded, cache on and off);
+    * every death variant leaves a POST-MORTEM: the second consecutive
+      watchdog expiry escalates to engine-dead, and the flight
+      recorder (telemetry/flight.py) auto-dumps its black box —
+      validated here against the schema, with the failure breadcrumbs
+      present (docs/OBSERVABILITY.md "Device & compiler telemetry")."""
+    import os
+    import tempfile
+
     import jax
 
     from deepspeed_tpu.inference import FailureConfig, SamplingParams
+    from deepspeed_tpu.telemetry import validate_flight_dump
 
     trace = make_trace(seed=seed, n_requests=12, qps=30.0,
                        arrival="bursty", prompt_lens=(4, 24),
                        out_lens=(2, 4), tiers=(0, 1))
     poison_uid = trace[3].uid
     last = max(q.step for q in trace)
+    # hang x2: the first injected expiry classifies retryable, the
+    # second (no clean step between them) escalates to ENGINE-DEAD —
+    # the death variant every chaos run drills, and the flight
+    # recorder's auto-dump trigger
     faults = [Fault("poison", step=0, uid=poison_uid),
               Fault("crash", step=2),
               Fault("hang", step=4),
+              Fault("hang", step=5),
               Fault("restart", step=last // 2 + 1)]
     # the injected faults are deterministic, so the real watchdog
     # thread is off the replay's path (its own unit tests cover it);
     # generous strikes let bisection — not the cap — isolate the poison
-    fcfg = FailureConfig(dispatch_timeout_ms=None)
+    flight_root = tempfile.mkdtemp(prefix="chaos_flight_")
     model_box = []
 
-    def factory(cache):
-        eng, m = build_engine(None, model=model_box[0] if model_box
-                              else None, prefix_cache=cache,
-                              failure=fcfg)
+    def factory(cache, flight_dir=None):
+        eng, m = build_engine(
+            None, model=model_box[0] if model_box else None,
+            prefix_cache=cache,
+            failure=FailureConfig(dispatch_timeout_ms=None,
+                                  flight_dir=flight_dir))
         if not model_box:
             model_box.append(m)
         return eng
@@ -580,14 +596,16 @@ def chaos_smoke(seed: int = 0) -> Dict:
     checks: Dict[str, bool] = {}
     for mode, cache in variants:
         sp, rng = samplers[mode]
-        res = replay(factory(cache), trace, list(faults), sampling=sp,
-                     engine_factory=lambda: factory(cache), rng=rng,
-                     check_invariants=True)
+        name = f"{mode}_cache_{cache}"
+        fdir = os.path.join(flight_root, name)
+        res = replay(factory(cache, fdir), trace, list(faults),
+                     sampling=sp,
+                     engine_factory=lambda: factory(cache, fdir),
+                     rng=rng, check_invariants=True)
         eng = res["engine"]
         al = eng.state.allocator
         al.assert_invariants()
         agg = eng.request_metrics()["aggregate"]
-        name = f"{mode}_cache_{cache}"
         parity = all(res["tokens"].get(q.uid, []) ==
                      refs[mode].get(q.uid, [])
                      for q in trace if q.uid != poison_uid)
@@ -596,9 +614,24 @@ def chaos_smoke(seed: int = 0) -> Dict:
         checks[f"{name}_all_terminal"] = agg["open"] == 0 and all(
             s in ("finished", "failed") for s in res["status"].values())
         checks[f"{name}_unaffected_parity"] = parity
-        checks[f"{name}_restarted"] = res["restarts"] >= 1
+        # >= 2: the explicit restart drill AND the engine-dead death
+        checks[f"{name}_restarted"] = res["restarts"] >= 2
         checks[f"{name}_no_leak"] = \
             al.free_blocks == al.total_blocks
+        # the death left a black box: at least one auto-dump exists,
+        # the engine-dead one among them, every dump passes the schema
+        # validator, and the failure breadcrumbs are inside
+        dumps = sorted(os.listdir(fdir)) if os.path.isdir(fdir) else []
+        loaded = []
+        for p in dumps:
+            with open(os.path.join(fdir, p)) as f:
+                loaded.append(json.load(f))
+        checks[f"{name}_flight_dumped"] = \
+            any("engine_dead" in p for p in dumps)
+        checks[f"{name}_flight_valid"] = bool(loaded) and all(
+            not validate_flight_dump(s) for s in loaded) and any(
+            any(e.get("kind") == "step_failure" for e in s["events"])
+            for s in loaded)
         out["variants"][name] = {
             "steps": res["steps"], "restarts": res["restarts"],
             "statuses": {s: list(res["status"].values()).count(s)
@@ -606,6 +639,7 @@ def chaos_smoke(seed: int = 0) -> Dict:
             "step_retries": int(eng.timings["step_retries"]),
             "requests_failed": int(eng.timings["requests_failed"]),
             "health": eng.health()["state"],
+            "flight_dumps": len(dumps),
         }
     out["checks"] = checks
     out["ok"] = all(checks.values())

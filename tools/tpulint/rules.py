@@ -841,6 +841,99 @@ def check_donated_reuse(ctx: FileContext) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
+# rule: metric-name — registry metric names are an API with a grammar
+# --------------------------------------------------------------------------
+
+import re as _re
+
+# every registry metric belongs to one engine family; the grammar keeps
+# dashboards/scrapes joinable and makes a typo'd name visibly wrong
+_METRIC_NAME_RE = _re.compile(r"^(serving|training)_[a-z0-9_]+$")
+_METRIC_PREFIX_RE = _re.compile(r"^(serving|training)_")
+# MetricsRegistry registration entry points (telemetry/metrics.py)
+_METRIC_REG_ATTRS = {"counter", "gauge", "gauge_fn", "histogram"}
+# receiver segments that identify a metrics registry (the conventional
+# spellings: ``reg`` / ``registry`` locals, ``self.metrics`` /
+# ``engine.metrics`` attributes) — whole-segment matched, like
+# telemetry-hotpath's receiver check
+_REGISTRY_SEGMENTS = {"reg", "registry", "metrics"}
+
+
+def _metric_name_literal(arg: ast.AST):
+    """``(full_name, None)`` for a plain string literal, ``(None,
+    prefix)`` for an f-string with a leading constant part, ``(None,
+    None)`` for anything unverifiable (skipped — conservatism over
+    noise)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value, None
+    if isinstance(arg, ast.JoinedStr) and arg.values \
+            and isinstance(arg.values[0], ast.Constant) \
+            and isinstance(arg.values[0].value, str):
+        return None, arg.values[0].value
+    return None, None
+
+
+@rule("metric-name",
+      "registry metric names must match ^(serving|training)_[a-z0-9_]+$ "
+      "and each name must be registered from exactly one source site — "
+      "a typo'd or duplicated registration silently forks a second "
+      "series that dashboards and the benchdiff sentinel never join "
+      "back up", library_only=True, scope="program")
+def check_metric_name(program) -> Iterator[Finding]:
+    sites: Dict[str, List[Tuple[str, int]]] = {}
+    for mod in program.modules.values():
+        ctx = mod.ctx
+        if "counter" not in ctx.source and "gauge" not in ctx.source \
+                and "histogram" not in ctx.source:
+            continue
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_REG_ATTRS
+                    and node.args):
+                continue
+            recv = dotted(node.func.value) or ""
+            if not set(recv.split(".")) & _REGISTRY_SEGMENTS:
+                continue          # not a metrics-registry receiver
+            name, prefix = _metric_name_literal(node.args[0])
+            if name is not None:
+                if not _METRIC_NAME_RE.match(name):
+                    yield Finding(
+                        "metric-name", ctx.path, node.lineno,
+                        node.col_offset,
+                        f"metric name {name!r} does not match "
+                        "^(serving|training)_[a-z0-9_]+$ — registry "
+                        "names are one grammar per engine family")
+                else:
+                    sites.setdefault(name, []).append(
+                        (ctx.path, node.lineno))
+            elif prefix is not None:
+                # dynamic name with a constant head: the head must
+                # already carry the family prefix (f"serving_{k}_total");
+                # a fully dynamic name is unverifiable and skipped
+                if not _METRIC_PREFIX_RE.match(prefix):
+                    yield Finding(
+                        "metric-name", ctx.path, node.lineno,
+                        node.col_offset,
+                        f"dynamic metric name starts with {prefix!r} — "
+                        "the constant head must carry the serving_/"
+                        "training_ family prefix so the grammar stays "
+                        "checkable")
+    for name, locs in sites.items():
+        unique = sorted(set(locs))
+        if len(unique) <= 1:
+            continue
+        first = unique[0]
+        for path, line in unique[1:]:
+            yield Finding(
+                "metric-name", path, line, 0,
+                f"metric {name!r} is also registered at "
+                f"{first[0]}:{first[1]} — one name, one registration "
+                "site (get-or-create returns the existing series; a "
+                "second literal is how typo'd counters fork)")
+
+
+# --------------------------------------------------------------------------
 # rule: telemetry-hotpath — telemetry must never slow (or break) the
 # paths it measures
 # --------------------------------------------------------------------------
