@@ -130,9 +130,12 @@ def _serving_device():
         return jax.devices()[0]
 
 
-def main(trace_path=None):
+def main(trace_path=None, profile_dir=None):
     """``trace_path``: export a Chrome trace (Perfetto-loadable) of the
-    pipelined serving leg's depth-2 run (``--trace out.json``)."""
+    pipelined serving leg's depth-2 run (``--trace out.json``).
+    ``profile_dir``: additionally arm a deep-capture window on that leg
+    and emit a MERGED host+device timeline via tools/tracemerge.py
+    (``--profile out/``)."""
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import build_model
 
@@ -237,7 +240,7 @@ def main(trace_path=None):
                     f"{(str(e).splitlines() or [''])[0][:120]}"}
 
     serve = leg(serving_bench, on_tpu)
-    pipe = leg(pipeline_serving_bench, on_tpu, trace_path)
+    pipe = leg(pipeline_serving_bench, on_tpu, trace_path, profile_dir)
     prefix = leg(shared_prefix_serving_bench, on_tpu)
     spec = leg(spec_decode_serving_bench, on_tpu)
     overload = leg(overload_serving_bench, on_tpu)
@@ -747,7 +750,8 @@ def sla_goodput_sweep(eng, on_tpu: bool, prompt_len: int):
             "goodput_curve": curve}
 
 
-def pipeline_serving_bench(on_tpu: bool, trace_path=None):
+def pipeline_serving_bench(on_tpu: bool, trace_path=None,
+                           profile_dir=None):
     """Pipelined vs strict-sync serving loop at identical shapes: decode
     tokens/s for pipeline_depth 1 vs 2 plus the engine's per-step
     host-overhead breakdown (schedule / stage / device / readback ms)
@@ -755,7 +759,12 @@ def pipeline_serving_bench(on_tpu: bool, trace_path=None):
     timed run.  With ``trace_path``, the depth-2 leg runs with span
     tracing on and exports a Chrome trace of the timed region (open in
     Perfetto: one track per pipeline stage, the dispatch-ahead overlap
-    visible directly).
+    visible directly).  With ``profile_dir`` (``--profile out/``), the
+    depth-2 timed leg additionally arms a deep-capture window
+    (telemetry/profiler.py) and emits a MERGED host+device timeline
+    via tools/tracemerge.py — host stages and device/XLA activity on
+    one Perfetto timeline, the ROADMAP-3 "track it before you can
+    trigger it" bar.
     The pipeline's win is the host work it moves off the critical path:
     schedule+stage of step N+1 and the token readback of step N overlap
     step N/N+1's device compute, so the per-token host overhead
@@ -789,7 +798,7 @@ def pipeline_serving_bench(on_tpu: bool, trace_path=None):
             num_kv_blocks=1024 if on_tpu else 64,
             pipeline_depth=depth,
             trace=bool(trace_path) and depth == 2,
-            device_telemetry="on"))
+            device_telemetry="on", anomaly="on"))
         # warm the compile caches (probe + both context buckets) outside
         # the timed region
         eng.generate({u: list(p) for u, p in prompts.items()}, sp)
@@ -797,6 +806,12 @@ def pipeline_serving_bench(on_tpu: bool, trace_path=None):
         # the span ring, so every exported number covers the timed
         # region only
         eng.reset_metrics()
+        if profile_dir and depth == 2:
+            # deep capture over the head of the timed region: a
+            # bounded jax.profiler window whose merged host+device
+            # timeline shows the dispatch-ahead overlap for real
+            eng.capture(steps=8, reason="bench_pipe2",
+                        out_dir=profile_dir)
         t0 = time.perf_counter()
         toks = eng.generate({u: list(p) for u, p in prompts.items()}, sp)
         dt = time.perf_counter() - t0
@@ -807,8 +822,12 @@ def pipeline_serving_bench(on_tpu: bool, trace_path=None):
         out[f"pipe{depth}_request_metrics"] = \
             eng.request_metrics()["aggregate"]
         out[f"pipe{depth}_device_metrics"] = eng.device_snapshot()
+        out[f"pipe{depth}_anomalies"] = eng.anomaly_summary()
         if trace_path and depth == 2:
             out["trace_file"] = eng.tracer.export_chrome_trace(trace_path)
+        if profile_dir and depth == 2 and eng.capture_dirs:
+            from tools.tracemerge import merge_capture
+            out["merged_trace_file"] = merge_capture(eng.capture_dirs[-1])
         breakdown[f"pipe{depth}"] = {
             "schedule_ms": round(tl["schedule_ms"] / steps, 3),
             "stage_ms": round(tl["stage_ms"] / steps, 3),
@@ -879,7 +898,7 @@ def shared_prefix_serving_bench(on_tpu: bool):
             kv_block_size=64 if on_tpu else 16,
             num_kv_blocks=64 if on_tpu else 48,
             prefix_cache=mode,
-            device_telemetry="on"))
+            device_telemetry="on", anomaly="on"))
         # warm the compile caches with an unrelated prompt (both modes
         # pay it; its blocks never match the shared prefix)
         eng.generate({-1: list(r.randint(0, vocab,
@@ -900,6 +919,7 @@ def shared_prefix_serving_bench(on_tpu: bool):
             out["shared_prefix_request_metrics"] = \
                 eng.request_metrics()["aggregate"]
             out["shared_prefix_device_metrics"] = eng.device_snapshot()
+            out["shared_prefix_anomalies"] = eng.anomaly_summary()
     out["shared_prefix_speedup"] = round(
         out["shared_prefix_prefill_tok_s_on"]
         / max(out["shared_prefix_prefill_tok_s_off"], 1e-9), 2)
@@ -957,7 +977,7 @@ def spec_decode_serving_bench(on_tpu: bool):
             num_kv_blocks=256 if on_tpu else 96,
             pipeline_depth=1,
             spec_decode=mode, spec_max_draft=4,
-            device_telemetry="on"))
+            device_telemetry="on", anomaly="on"))
         # warm the compile caches; generate() flushes everything, so the
         # proposer history starts cold again for the timed run
         eng.generate({u: list(p) for u, p in prompts.items()}, sp)
@@ -979,6 +999,7 @@ def spec_decode_serving_bench(on_tpu: bool):
             out["spec_request_metrics"] = \
                 eng.request_metrics()["aggregate"]
             out["spec_device_metrics"] = eng.device_snapshot()
+            out["spec_anomalies"] = eng.anomaly_summary()
     out["spec_decode_speedup"] = round(
         out["spec_decode_tok_s_on"]
         / max(out["spec_decode_tok_s_off"], 1e-9), 2)
@@ -1035,7 +1056,7 @@ def serving_bench(on_tpu: bool):
         kv_block_size=64 if on_tpu else 16,
         num_kv_blocks=1024 if on_tpu else 32,
         decode_burst=8 if on_tpu else 2,
-        device_telemetry="on"))
+        device_telemetry="on", anomaly="on"))
     r = np.random.RandomState(0)
     sp = SamplingParams(temperature=0.0, max_new_tokens=1 << 30)
     vocab = model.config.vocab_size
@@ -1089,7 +1110,11 @@ def serving_bench(on_tpu: bool):
             # MFU / HBM-bandwidth utilization over the timed window,
             # and peak memory_stats — BENCH_r06+ records utilization,
             # not just tok/s (absent fields = backend can't say)
-            "serving_device_metrics": eng.device_snapshot()}
+            "serving_device_metrics": eng.device_snapshot(),
+            # streaming-detector tally of the leg (anomaly counts are
+            # report-only in benchdiff — a noisy rig fires latency
+            # detectors without being a regression)
+            "serving_anomalies": eng.anomaly_summary()}
 
 
 if __name__ == "__main__":
@@ -1099,4 +1124,9 @@ if __name__ == "__main__":
     ap.add_argument("--trace", metavar="OUT.json", default=None,
                     help="export a Chrome trace (Perfetto-loadable) of "
                     "the pipelined serving leg's depth-2 timed run")
-    main(trace_path=ap.parse_args().trace)
+    ap.add_argument("--profile", metavar="OUT_DIR", default=None,
+                    help="arm a deep-capture window on the depth-2 "
+                    "timed leg and emit a merged host+device Perfetto "
+                    "timeline (tools/tracemerge.py) under OUT_DIR")
+    args = ap.parse_args()
+    main(trace_path=args.trace, profile_dir=args.profile)

@@ -418,6 +418,21 @@ class TelemetryConfig(ConfigModel):
     # and memory_stats polling at the steps_per_print boundary.  The
     # compile/retrace counters are always on regardless.
     device: bool = False
+    # streaming anomaly detection (telemetry/anomaly.py,
+    # docs/OBSERVABILITY.md "Anomaly detection & deep capture"):
+    # EWMA+MAD detectors over the train step's host phases (step
+    # interval, host ms) and the retrace storm signal, counted as
+    # training_anomalies_total{signal=...}; a fire arms a deep-capture
+    # window when ``profile`` names a directory.  Off adds nothing to
+    # the step path.
+    anomaly: bool = False
+    # deep-capture directory (telemetry/profiler.py): ``profile`` with
+    # ``profile_steps > 0`` arms a bounded jax.profiler window over
+    # the first N train steps at construction; ``engine.capture()``
+    # arms explicit windows any time.  tools/tracemerge.py merges each
+    # capture into one Perfetto timeline with the host phase spans.
+    profile: Optional[str] = None
+    profile_steps: int = 4
 
 
 @dataclass

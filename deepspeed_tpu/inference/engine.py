@@ -33,8 +33,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..comm.mesh import FSDP_AXIS, MeshTopology, TENSOR_AXIS
 from ..models.transformer import Model, TransformerConfig
-from ..telemetry import (CounterDictView, DeviceTelemetry, FlightRecorder,
-                         MetricsRegistry, RequestTracker, SpanTracer)
+from ..telemetry import (AnomalyConfig, AnomalyMonitor, CounterDictView,
+                         DeviceTelemetry, FlightRecorder, MetricsRegistry,
+                         ProfilerCapture, RequestTracker, SpanTracer,
+                         default_serving_detectors)
 from ..utils.logging import logger
 from .failures import (FATAL_ENGINE, POISON_STEP,
                        DispatchTimeoutError, EngineDeadError,
@@ -156,6 +158,36 @@ class InferenceConfig:
     # the flight recorder are always on — they are host counter bumps
     # and read-time probes that cost the hot path nothing.
     device_telemetry: str = "auto"
+    # streaming anomaly detection (telemetry/anomaly.py,
+    # docs/OBSERVABILITY.md "Anomaly detection & deep capture"): EWMA+
+    # MAD / rolling-percentile / threshold detectors over per-step
+    # signals the loop already computes — step interval / device /
+    # wait / host ms, TTFT/TPOT, runtime retraces, KV-referenced
+    # slope, prefix hit rate, spec acceptance.  A fire is note()d into
+    # the flight recorder, counted
+    # (``serving_anomalies_total{signal=...}``), surfaced through
+    # ``engine.health()`` (sustained fires => degraded), and —
+    # cooldown- and budget-limited — arms a deep-capture window.  Off
+    # costs literally nothing: no monitor is constructed, no clock is
+    # read; on reuses the timestamps the loop already takes (the
+    # zero-extra-clock-reads bar is tested).  "auto" resolves OFF
+    # today — the ROADMAP-4 autotuner is the intended flipper.
+    anomaly: str = "auto"
+    anomaly_cfg: Optional["AnomalyConfig"] = None
+    # deep-capture output directory (telemetry/profiler.py): armed
+    # captures record a bounded ``jax.profiler`` device trace + the
+    # window's host spans + a flight dump under
+    # ``<profile>/capture_<n>_<reason>/``, which
+    # ``tools/tracemerge.py`` merges into ONE Perfetto timeline.
+    # Setting ``profile`` with ``profile_steps > 0`` arms an explicit
+    # window over the first ``profile_steps`` engine steps (the bench
+    # ``--profile`` path); ``profile_steps = 0`` just designates the
+    # directory (anomaly-armed captures land there).  Explicit windows
+    # can also be armed any time via ``engine.capture(steps=N)``.
+    # Backends/builds without profiler support degrade loudly: the
+    # window completes host-only and the merge says so.
+    profile: Optional[str] = None
+    profile_steps: int = 4
     # model-free speculative decoding (inference/spec_decode.py,
     # docs/SERVING.md "Speculative decoding"): an n-gram prompt-lookup
     # proposer drafts up to ``spec_max_draft`` continuation tokens per
@@ -517,6 +549,37 @@ class InferenceEngine:
         # unlike _warm_keys this survives LRU eviction, so a re-build
         # is recognized as a retrace
         self._compiled_ever: set = set()
+        # --- streaming anomaly detection (telemetry/anomaly.py): None
+        # when off — the serving loop then contains not one added
+        # clock read or detector call (the same zero-cost bar as
+        # device telemetry, extended by test to the detector hooks)
+        amode = self.icfg.anomaly
+        if amode not in ("auto", "on", "off"):
+            raise ValueError(f"anomaly={amode!r}: expected 'auto', "
+                             "'on', or 'off'")
+        # "auto" resolves OFF today — the ROADMAP-4 autotuner is the
+        # intended flipper, exactly like device_telemetry
+        self._acfg = self.icfg.anomaly_cfg or AnomalyConfig()
+        self._anom = None
+        if amode == "on":
+            self._anom = AnomalyMonitor(self._acfg, reg, "serving")
+            self._anom.watch_all(default_serving_detectors(self._acfg))
+        # per-step signal scratch (last dispatch t0, last counter
+        # reads) — plain floats, touched only when the monitor exists
+        self._anom_prev: Dict[str, float] = {}
+        # --- deep-capture windows (telemetry/profiler.py): the ONE
+        # profiler seam for this engine.  Constructed when a capture
+        # directory is configured; engine.capture(out_dir=...) and the
+        # anomaly path (falling back to FailureConfig.flight_dir) can
+        # also create it lazily via _ensure_capture
+        self._cap = None
+        self._warned_no_capture_dir = False
+        if self.icfg.profile:
+            self._cap = ProfilerCapture(
+                self.icfg.profile, tracer=self.tracer,
+                max_captures=self._acfg.max_captures)
+            if self.icfg.profile_steps > 0:
+                self._cap.arm(self.icfg.profile_steps, "config")
 
     def _prefix_hit_rate(self):
         prompt = self.timings["prompt_tokens"]
@@ -573,6 +636,13 @@ class InferenceEngine:
         # rearm the pool high-water mark so a timed region reports ITS
         # peak, not the warmup's (the pull-gauges read live truth)
         self.state.allocator.reset_peaks()
+        # rearm the anomaly detectors (fresh baselines for the timed
+        # region) and the anomaly-capture budget
+        if self._anom is not None:
+            self._anom.reset()
+            self._anom_prev.clear()
+        if self._cap is not None:
+            self._cap.reset_budget()
 
     def device_snapshot(self) -> Optional[Dict]:
         """JSON-able device-telemetry summary (per-program cost
@@ -580,6 +650,115 @@ class InferenceEngine:
         poll) — what bench legs embed next to their request-metrics
         aggregates.  None when ``device_telemetry`` is off."""
         return None if self.devtel is None else self.devtel.snapshot()
+
+    def anomaly_summary(self) -> Optional[Dict]:
+        """JSON-able anomaly tally — total fires, per-signal counts,
+        the most recent events, and the completed capture-window dirs
+        — what bench legs and the loadgen SLO sweep embed.  None when
+        anomaly detection is off."""
+        if self._anom is None:
+            return None
+        return {**self._anom.summary(), "captures": self.capture_dirs}
+
+    @property
+    def capture_dirs(self) -> List[str]:
+        """Completed deep-capture window directories (each mergeable
+        into one Perfetto timeline by ``tools/tracemerge.py``)."""
+        return [] if self._cap is None else list(self._cap.captures)
+
+    def capture(self, steps: Optional[int] = None,
+                reason: str = "manual",
+                out_dir: Optional[str] = None) -> Optional[str]:
+        """Arm an explicit deep-capture window around the next
+        ``steps`` engine steps (default ``AnomalyConfig.
+        capture_steps``): a bounded ``jax.profiler`` device trace +
+        the window's host spans + a flight dump, merged into one
+        Perfetto timeline by ``tools/tracemerge.py``.  Returns the
+        capture directory (recording starts at the next step
+        boundary), or None when a window is already armed/active.
+        ``out_dir`` overrides the configured directory for a manager
+        not yet constructed; with neither configured nor passed this
+        raises — an explicit capture with nowhere to write is a
+        caller error (the ANOMALY path degrades instead)."""
+        cap = self._ensure_capture(out_dir)
+        if cap is None:
+            raise ValueError(
+                "no capture directory: pass out_dir=, or set "
+                "InferenceConfig.profile / FailureConfig.flight_dir")
+        return cap.arm(steps or self._acfg.capture_steps, reason,
+                       budgeted=False)
+
+    def _ensure_capture(self, out_dir: Optional[str] = None):
+        """The capture manager, constructed on first need from the
+        first configured directory (explicit ``out_dir``, then
+        ``InferenceConfig.profile``, then ``FailureConfig.flight_dir``
+        — the post-mortem dir is a sensible home for anomaly
+        captures).  None — once loudly — when no directory exists."""
+        if self._cap is None:
+            d = out_dir or self.icfg.profile \
+                or getattr(self, "fcfg", None) and self.fcfg.flight_dir
+            if not d:
+                if not self._warned_no_capture_dir:
+                    self._warned_no_capture_dir = True
+                    logger.warning(
+                        "anomaly capture skipped: no capture directory "
+                        "(set InferenceConfig.profile or FailureConfig."
+                        "flight_dir) — detectors still fire/count")
+                return None
+            self._cap = ProfilerCapture(
+                d, tracer=self.tracer,
+                max_captures=self._acfg.max_captures)
+        return self._cap
+
+    def _on_anomaly(self, ev) -> None:
+        """One fired detector: breadcrumb it into the flight recorder
+        (the counter was bumped by the monitor) and — budget and
+        one-window-at-a-time permitting — arm a deep capture around
+        the next ``capture_steps`` steps so the artifact answers WHY,
+        not just WHEN."""
+        self.flight.note("anomaly", **ev.as_dict())
+        cap = self._ensure_capture()
+        if cap is not None:
+            cap.arm(self._acfg.capture_steps,
+                    f"anomaly_{ev.signal}", budgeted=True)
+
+    def _feed_step_signals(self, t0: float, t2: float,
+                           t3: float) -> None:
+        """Feed the per-dispatch anomaly signals from the timestamps
+        and counters the step already took — zero added clock reads.
+        Called only when the monitor exists."""
+        anom, prev, tm = self._anom, self._anom_prev, self.timings
+        step = self._steps_done
+        fired = []
+        last_t0 = prev.get("t0")
+        prev["t0"] = t0
+        if last_t0 is not None:
+            fired.append(anom.observe("step_interval_ms",
+                                      (t0 - last_t0) * 1e3, step))
+        fired.append(anom.observe("step_device_ms", (t3 - t2) * 1e3,
+                                  step))
+        fired.append(anom.observe("step_host_ms", (t2 - t0) * 1e3,
+                                  step))
+        retr = tm["compile_retraces"]
+        fired.append(anom.observe("retrace",
+                                  retr - prev.get("retrace", 0), step))
+        prev["retrace"] = retr
+        ref = float(self.state.pool_stats()["referenced"])
+        last_ref = prev.get("referenced")
+        prev["referenced"] = ref
+        if last_ref is not None:
+            fired.append(anom.observe("kv_referenced_delta",
+                                      ref - last_ref, step))
+        prompt, cached = tm["prompt_tokens"], tm["cached_tokens"]
+        dp = prompt - prev.get("prompt", 0)
+        if dp > 0:
+            fired.append(anom.observe(
+                "prefix_hit_rate",
+                (cached - prev.get("cached", 0)) / dp, step))
+        prev["prompt"], prev["cached"] = prompt, cached
+        for ev in fired:
+            if ev is not None:
+                self._on_anomaly(ev)
 
     def request_metrics(self) -> Dict:
         """Per-request lifecycle story + fleet aggregate:
@@ -1278,7 +1457,16 @@ class InferenceEngine:
         self._strikes.pop(uid, None)
         if self._spec is not None:
             self._spec.forget(uid)
+        rec = self.requests.open.get(uid) if self._anom is not None \
+            else None
         self.requests.on_finish(uid, status=status)
+        if rec is not None and rec.tpot_ms is not None:
+            # TPOT is only final at terminal close — feed it here so a
+            # decode-tail slowdown is a per-request latency signal too
+            evt = self._anom.observe("tpot_ms", rec.tpot_ms,
+                                     self._steps_done)
+            if evt is not None:
+                self._on_anomaly(evt)
 
     def _on_state_release(self, uid: int) -> None:
         """``StateManager.on_release`` hook: a sequence's KV was just
@@ -1734,6 +1922,12 @@ class InferenceEngine:
             "step_failure", verdict=verdict, phase=phase,
             exc=type(exc).__name__, step=self._steps_done,
             uids=[int(u) for u in uids])
+        if self._cap is not None and self._cap.active:
+            # a capture that witnessed the failure is worth more
+            # finished than abandoned — close it with what it has
+            fin = self._cap.finish_now()
+            if fin is not None:
+                self._finish_capture(fin)
         if verdict == FATAL_ENGINE:
             self._health = "dead"
             self._health_gauge.set(3)
@@ -1846,6 +2040,32 @@ class InferenceEngine:
         elif fresh_degrade:
             self._flight_autodump("health_degraded")
 
+    def _finish_capture(self, cdir: str) -> None:
+        """A capture window just completed: drop the flight dump next
+        to its traces (the post-mortem half of the artifact) and leave
+        a breadcrumb.  ``tools/tracemerge.py`` merges the dir into one
+        Perfetto timeline."""
+        import os
+        self.flight.note("capture_complete", path=cdir)
+        self.debug_dump(os.path.join(cdir, "flight.json"),
+                        reason="capture")
+
+    def finish_capture(self) -> Optional[str]:
+        """Close any ACTIVE capture window immediately with the steps
+        it has (the artifact is written; the jax profiler session and
+        the force-enabled tracer are released).  The generate()
+        drivers and ``drain()`` call this when their work runs out —
+        a window armed for more steps than the workload will run must
+        not strand the process-wide profiler session — and direct
+        step()-API callers can call it themselves.  Returns the
+        capture dir, or None when no window was active."""
+        if self._cap is None or not self._cap.active:
+            return None
+        fin = self._cap.finish_now()
+        if fin is not None:
+            self._finish_capture(fin)
+        return fin
+
     def _flight_autodump(self, reason: str) -> Optional[str]:
         """Write one black-box artifact into ``FailureConfig.
         flight_dir`` (no-op when unset).  Best-effort: the recorder
@@ -1878,7 +2098,8 @@ class InferenceEngine:
             requests=self.requests, health=self.health(),
             steps=self._steps_done,
             extra={"device": None if self.devtel is None
-                   else self.devtel.snapshot()})
+                   else self.devtel.snapshot(),
+                   "anomalies": self.anomaly_summary()})
 
     def debug_dump(self, path: Optional[str] = None,
                    reason: str = "debug") -> Dict:
@@ -1896,7 +2117,8 @@ class InferenceEngine:
             requests=self.requests, health=self.health(),
             steps=self._steps_done,
             extra={"device": None if self.devtel is None
-                   else self.devtel.snapshot()})
+                   else self.devtel.snapshot(),
+                   "anomalies": self.anomaly_summary()})
         if path is not None:
             self.flight.dump(path, reason, snap=snap)
         return snap
@@ -1914,6 +2136,12 @@ class InferenceEngine:
         state = self._health
         if state == "healthy" and self._steps_done \
                 - self._last_failure_step <= self.fcfg.health_window_steps:
+            state = "degraded"
+        if state == "healthy" and self._anom is not None \
+                and self._anom.sustained(self._steps_done):
+            # sustained anomaly fires inside the window: the engine is
+            # not failing, but it is not behaving either — the router
+            # should prefer another replica while this one is probed
             state = "degraded"
         self._health_gauge.set(
             {"healthy": 0, "degraded": 1, "draining": 2,
@@ -1935,6 +2163,9 @@ class InferenceEngine:
             "backoff_rounds": self._backoff_rounds,
             "live": len(self.state.seqs),
             "queued": sum(1 for t in self._pending.values() if t),
+            # streaming-detector view (0 / [] while anomaly is off)
+            "anomalies": 0 if self._anom is None else self._anom.total(),
+            "captures": len(self.capture_dirs),
         }
 
     def snapshot(self) -> Dict:
@@ -2110,6 +2341,9 @@ class InferenceEngine:
             empty_rounds = 0 if out else empty_rounds + 1
             if empty_rounds > self.fcfg.max_backoff_rounds + 2:
                 break
+        # a drain ends this engine's serving life: an active capture
+        # window closes with what it has (never strands the session)
+        self.finish_capture()
         snap = self.snapshot()
         for uid in list(dict.fromkeys(list(self._pending)
                                       + list(self.state.seqs)
@@ -2165,6 +2399,14 @@ class InferenceEngine:
         self._close_ctx_exhausted()
         if not sched:
             return None
+        cap = self._cap
+        if cap is not None and cap.armed:
+            # the armed deep-capture window opens only once a step is
+            # KNOWN to launch (an idle/backoff round must not start a
+            # session nothing will count down), before staging — the
+            # one profiler seam (tpulint: profiler-capture)
+            cap.begin(sid=self._dispatch_seq + 1,
+                      step=self._steps_done)
         # context bucket: the compiled block bound covers every scheduled
         # sequence's post-step context, rounded to a power of two so a
         # growing context mints O(log) programs, not one per block
@@ -2281,6 +2523,10 @@ class InferenceEngine:
                      prev, rng))
         if self.devtel is not None:
             self.devtel.on_dispatch(("p",) + key)
+        if self._anom is not None:
+            # streaming detectors fed from the timestamps/counters
+            # above — no clock reads beyond the ones timings took
+            self._feed_step_signals(t0, t2, t3)
         for uid, _ in sched:
             self.requests.on_prefill_start(uid, t3)
         tr = self.tracer
@@ -2402,6 +2648,11 @@ class InferenceEngine:
         if tr.enabled:
             tr.record("wait", t0, t1, track="wait", sid=st.sid)
             tr.record("readback", t1, t2, track="readback", sid=st.sid)
+        if self._anom is not None:
+            ev = self._anom.observe("step_wait_ms", (t1 - t0) * 1e3,
+                                    self._steps_done)
+            if ev is not None:
+                self._on_anomaly(ev)
         spec = self._n_verify > 1
         drafts = dict(st.drafts)
         out: Dict[int, List[int]] = {}
@@ -2432,6 +2683,13 @@ class InferenceEngine:
                     tm["spec_rejected_tokens"] += len(d) - (len(emitted)
                                                             - 1)
                     self.requests.on_draft(uid, len(d), len(emitted) - 1)
+                    if self._anom is not None:
+                        evt = self._anom.observe(
+                            "spec_acceptance",
+                            (len(emitted) - 1) / len(d),
+                            self._steps_done)
+                        if evt is not None:
+                            self._on_anomaly(evt)
             else:
                 emitted = [int(row[0] if spec else row)]
             if live:
@@ -2441,6 +2699,16 @@ class InferenceEngine:
                 # invariant, tests/test_telemetry.py)
                 tm["generated_tokens"] += len(emitted)
                 self.requests.on_tokens(uid, len(emitted), t2)
+                if self._anom is not None:
+                    rec = self.requests.open.get(uid)
+                    if rec is not None \
+                            and rec.generated_tokens == len(emitted):
+                        # this emission WAS the first token — TTFT is
+                        # known now, not at finish
+                        evt = self._anom.observe(
+                            "ttft_ms", rec.ttft_ms, self._steps_done)
+                        if evt is not None:
+                            self._on_anomaly(evt)
                 if self._spec is not None:
                     self._spec.observe(uid, emitted)
             out[uid] = emitted
@@ -2452,6 +2720,11 @@ class InferenceEngine:
                     # last emitted one (markers are never speculated
                     # for drafting rows, so this is column 0's sample)
                     p[0] = emitted[-1]
+        cap = self._cap
+        if cap is not None and cap.active:
+            fin = cap.end_step(sid=st.sid, step=self._steps_done)
+            if fin is not None:
+                self._finish_capture(fin)
         return out
 
     # ------------------------------------------------------------------
@@ -2540,6 +2813,11 @@ class InferenceEngine:
                 raise RuntimeError(      # unreachable after the fit check
                     f"uid {uid}: cannot reserve {steps} tokens of KV")
 
+        capw = self._cap
+        if capw is not None and capw.armed:
+            # capture windows count bursts as one step each (the one
+            # profiler seam — profile_decode8b drives this path)
+            capw.begin(sid=self._dispatch_seq, step=self._steps_done)
         self._drain_cow()        # pending COW copies precede burst writes
         st = self.state
         S = self.icfg.max_seqs
@@ -2631,6 +2909,11 @@ class InferenceEngine:
                       n_seqs=len(pending))
             tr.record("burst_readback", t1, t2, track="readback",
                       steps=steps)
+        if capw is not None and capw.active:
+            fin = capw.end_step(sid=self._dispatch_seq,
+                                step=self._steps_done)
+            if fin is not None:
+                self._finish_capture(fin)
         tm = self.timings
         out: Dict[int, List[int]] = {}
         for uid in pending:
@@ -2742,6 +3025,10 @@ class InferenceEngine:
             i += 1
             if i > 100_000:
                 raise RuntimeError("generate() did not terminate")
+        # the workload ran out before an active capture window did:
+        # close it with the steps it has rather than strand the
+        # process-wide profiler session
+        self.finish_capture()
         return done
 
     def _generate_pipelined(self, done: Dict[int, List[int]], active: set,
@@ -2858,4 +3145,7 @@ class InferenceEngine:
                 stall += 1
                 if stall > 100_000:
                     raise RuntimeError("generate() did not terminate")
+        # close any still-active capture window with the steps it has
+        # (see _generate_sync — the session must not outlive the work)
+        self.finish_capture()
         return done

@@ -26,7 +26,8 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+from typing import (Any, Callable, Dict, List, NamedTuple, Optional,
+                    Tuple)
 
 import jax
 import jax.numpy as jnp
@@ -39,7 +40,9 @@ from ..comm.collectives import init_distributed
 from ..config.config import Config, ConfigError, load_config
 from ..parallel.zero import ZeroPolicy
 from ..parallel import sharding as shd
-from ..telemetry import DeviceTelemetry, MetricsRegistry, SpanTracer
+from ..telemetry import (AnomalyConfig, AnomalyMonitor, DeviceTelemetry,
+                         MetricsRegistry, ProfilerCapture, SpanTracer,
+                         default_training_detectors)
 from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from .loss_scaler import LossScaler, LossScaleState, all_finite
@@ -329,6 +332,90 @@ class Engine:
             reg, "training",
             step_ms_fn=lambda: self.tput.total_elapsed_time * 1e3) \
             if tcfg.device else None
+        # streaming anomaly detection (telemetry/anomaly.py): None when
+        # off — the step path then contains no detector call and no
+        # added clock read (the serving engine's zero-cost bar, shared)
+        self._acfg = AnomalyConfig()
+        self._anom = None
+        self._anom_prev: Dict[str, float] = {}
+        if tcfg.anomaly:
+            self._anom = AnomalyMonitor(self._acfg, reg, "training")
+            self._anom.watch_all(default_training_detectors(self._acfg))
+        # deep-capture windows (telemetry/profiler.py): the training
+        # engine's one profiler seam, same artifact layout as serving
+        # (tools/tracemerge.py merges host phases + device trace)
+        self._cap = None
+        if tcfg.profile:
+            self._cap = ProfilerCapture(tcfg.profile, tracer=self.tracer,
+                                        max_captures=self._acfg.
+                                        max_captures)
+            if tcfg.profile_steps > 0:
+                self._cap.arm(tcfg.profile_steps, "config")
+
+    def anomaly_summary(self) -> Optional[Dict[str, Any]]:
+        """JSON-able anomaly tally (total / by-signal / recent events +
+        completed capture dirs); None while anomaly detection is off."""
+        if self._anom is None:
+            return None
+        return {**self._anom.summary(), "captures": self.capture_dirs}
+
+    @property
+    def capture_dirs(self) -> List[str]:
+        return [] if self._cap is None else list(self._cap.captures)
+
+    def capture(self, steps: Optional[int] = None,
+                reason: str = "manual",
+                out_dir: Optional[str] = None) -> Optional[str]:
+        """Arm an explicit deep-capture window over the next ``steps``
+        train steps (jax.profiler device trace + host phase spans,
+        merged by tools/tracemerge.py); returns the capture dir or
+        None when a window is already armed/active."""
+        if self._cap is None:
+            if not out_dir:
+                raise ValueError("no capture directory: pass out_dir= "
+                                 "or set config telemetry.profile")
+            self._cap = ProfilerCapture(out_dir, tracer=self.tracer,
+                                        max_captures=self._acfg.
+                                        max_captures)
+        return self._cap.arm(steps or self._acfg.capture_steps, reason,
+                             budgeted=False)
+
+    def finish_capture(self) -> Optional[str]:
+        """Close any ACTIVE capture window immediately with the steps
+        it has (releases the process-wide jax profiler session and the
+        force-enabled tracer) — call when training ends before a
+        window armed for more steps ran out.  Returns the capture dir
+        or None."""
+        if self._cap is None or not self._cap.active:
+            return None
+        return self._cap.finish_now()
+
+    def _feed_step_signals(self, t0: float, t3: float) -> None:
+        """Per-step anomaly feed from timestamps already taken (no
+        added clock reads); called only when the monitor exists."""
+        anom, prev = self._anom, self._anom_prev
+        step = self.global_steps
+        fired = []
+        last_t0 = prev.get("t0")
+        prev["t0"] = t0
+        if last_t0 is not None:
+            fired.append(anom.observe("step_interval_ms",
+                                      (t0 - last_t0) * 1e3, step))
+        fired.append(anom.observe("step_host_ms", (t3 - t0) * 1e3,
+                                  step))
+        retr = self._c_retraces.value()
+        fired.append(anom.observe("retrace",
+                                  retr - prev.get("retrace", 0), step))
+        prev["retrace"] = retr
+        for ev in fired:
+            if ev is not None:
+                logger.warning(
+                    "training anomaly: %s observed=%.3f baseline=%.3f "
+                    "score=%.1f (step %d)", ev.signal, ev.observed,
+                    ev.baseline, ev.score, ev.step)
+                if self._cap is not None:
+                    self._cap.arm(self._acfg.capture_steps,
+                                  f"anomaly_{ev.signal}", budgeted=True)
 
     def _note_compile(self, key: str) -> None:
         self._c_compiles.inc()
@@ -1555,6 +1642,10 @@ class Engine:
         local view is fine under multi-host; see ``shard_batch``); with
         gas>1, leaves are reshaped to [gas, micro, ...] for the scan.
         """
+        if self._cap is not None and self._cap.armed:
+            # an armed deep-capture window opens at the step boundary
+            # (the one profiler seam — tpulint: profiler-capture)
+            self._cap.begin(step=self.global_steps)
         t0 = time.perf_counter()
         if rng is None:
             rng = jax.random.PRNGKey(self.config.seed + self.global_steps)
@@ -1602,6 +1693,9 @@ class Engine:
         self._phase_ms["stage"].inc((t2 - t1) * 1e3)
         self._phase_ms["dispatch"].inc((t3 - t2) * 1e3)
         self._h_step_host.observe((t3 - t0) * 1e3)
+        if self._anom is not None:
+            # detectors fed from the timestamps above — no added reads
+            self._feed_step_signals(t0, t3)
         tr = self.tracer
         if tr.enabled:
             # one track per phase — reuses the timestamps above, so
@@ -1631,6 +1725,8 @@ class Engine:
         self.global_steps += 1
         self.global_samples += self.train_batch_size
         self._c_steps.inc()
+        if self._cap is not None and self._cap.active:
+            self._cap.end_step(step=self.global_steps)
         # metrics stay on device — a host fetch every step would stall the
         # async dispatch pipeline (and on tunneled TPUs pay a round trip
         # per value); fetch once, and only when someone actually looks

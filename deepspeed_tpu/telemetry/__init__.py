@@ -23,6 +23,11 @@ from .device import (DeviceTelemetry, cost_analysis_of, peak_flops,
                      peak_hbm_bw, poll_memory_stats)
 from .flight import (FlightRecorder, config_fingerprint,
                      validate_flight_dump)
+from .anomaly import (AnomalyConfig, AnomalyEvent, AnomalyMonitor,
+                      EwmaMadDetector, RollingPercentileDetector,
+                      ThresholdDetector, default_serving_detectors,
+                      default_training_detectors)
+from .profiler import ProfilerCapture, profiler_available
 
 __all__ = ["SpanTracer", "MetricsRegistry", "Counter", "Gauge", "FnGauge",
            "Histogram", "CounterDictView", "parse_prometheus_text",
@@ -30,4 +35,9 @@ __all__ = ["SpanTracer", "MetricsRegistry", "Counter", "Gauge", "FnGauge",
            "TTFT_BUCKETS_MS", "TPOT_BUCKETS_MS", "QUEUE_WAIT_BUCKETS_MS",
            "DeviceTelemetry", "cost_analysis_of", "peak_flops",
            "peak_hbm_bw", "poll_memory_stats", "FlightRecorder",
-           "config_fingerprint", "validate_flight_dump"]
+           "config_fingerprint", "validate_flight_dump",
+           "AnomalyConfig", "AnomalyEvent", "AnomalyMonitor",
+           "EwmaMadDetector", "RollingPercentileDetector",
+           "ThresholdDetector", "default_serving_detectors",
+           "default_training_detectors", "ProfilerCapture",
+           "profiler_available"]

@@ -165,8 +165,12 @@ class SpanTracer:
     # ------------------------------------------------------------------
     # export
     # ------------------------------------------------------------------
-    def events(self) -> List[Dict[str, Any]]:
-        """Recorded spans, oldest first (wraparound-corrected)."""
+    def events(self, since_ns: Optional[int] = None
+               ) -> List[Dict[str, Any]]:
+        """Recorded spans, oldest first (wraparound-corrected).
+        ``since_ns`` keeps only spans beginning at/after that
+        ``perf_counter_ns`` instant — the capture-window export
+        (telemetry/profiler.py) uses it to emit just the window."""
         if self._buf is None:
             return []
         n = len(self)
@@ -175,6 +179,8 @@ class SpanTracer:
         for k in range(n):
             name, track, ts_ns, dur_ns, depth, args = \
                 self._buf[(start + k) % self.capacity]
+            if since_ns is not None and ts_ns < since_ns:
+                continue
             ev: Dict[str, Any] = {"name": name, "track": track,
                                   "ts_ns": ts_ns, "depth": depth}
             if dur_ns >= 0:
@@ -186,18 +192,20 @@ class SpanTracer:
             out.append(ev)
         return out
 
-    def chrome_trace(self, process_name: str = "deepspeed_tpu") -> Dict:
+    def chrome_trace(self, process_name: str = "deepspeed_tpu",
+                     since_ns: Optional[int] = None) -> Dict:
         """Chrome trace-event JSON object (the ``traceEvents`` array
         format Perfetto and chrome://tracing load).  One tid per track,
         named via thread_name metadata, so each pipeline stage renders
         as its own horizontal track and the dispatch-ahead overlap is
-        visually inspectable."""
+        visually inspectable.  ``since_ns`` restricts the export to
+        spans beginning at/after that instant (capture windows)."""
         tids: Dict[str, int] = {}
         trace_events: List[Dict[str, Any]] = [{
             "name": "process_name", "ph": "M", "pid": 1, "tid": 0,
             "args": {"name": process_name}}]
         body: List[Dict[str, Any]] = []
-        for ev in self.events():
+        for ev in self.events(since_ns=since_ns):
             track = ev["track"]
             tid = tids.get(track)
             if tid is None:

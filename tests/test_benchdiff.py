@@ -20,7 +20,25 @@ from tools.benchdiff import (compare, diff_files, main,  # noqa: E402
 
 def test_smoke_is_the_acceptance_check():
     out = smoke()
-    assert out["ok"] and len(out["checks"]) == 6
+    assert out["ok"] and len(out["checks"]) == 7
+    assert "anomaly_delta_reports_not_gates" in out["checks"]
+
+
+def test_anomaly_deltas_report_only():
+    """``<leg>_anomalies`` totals (PR 10) are listed as deltas but never
+    gate — detector fires are rig-noise sensitive."""
+    base = {"engine_version": "1.0", "config_hash": "aaaa",
+            "value": 100.0,
+            "pipe2_anomalies": {"total": 0, "by_signal": {}}}
+    noisy = dict(base, pipe2_anomalies={"total": 12,
+                                        "by_signal": {"ttft_ms": 12}})
+    v = compare(base, noisy)
+    assert v["ok"]
+    assert v["anomaly_deltas"] == [
+        {"metric": "pipe2_anomalies", "old": 0, "new": 12}]
+    # a leg whose anomaly subtree is None (anomaly off) stays silent
+    off = dict(base, pipe2_anomalies=None)
+    assert compare(off, off)["anomaly_deltas"] == []
 
 
 def test_metric_direction_classification():

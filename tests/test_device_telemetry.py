@@ -371,6 +371,53 @@ class TestDisabledPathZeroCost:
             counts[mode] = n[0]
         assert counts["on"] == counts["off"], counts
 
+    def test_anomaly_off_never_observes_or_captures(self, model,
+                                                    monkeypatch):
+        """The PR-10 extension of the bar: with anomaly detection off
+        (the default), no detector hook and no capture hook may run —
+        the engine holds no monitor and no capture manager at all."""
+        from deepspeed_tpu.telemetry import anomaly as anomaly_mod
+        from deepspeed_tpu.telemetry import profiler as profiler_mod
+
+        def forbidden(*a, **k):
+            raise AssertionError("anomaly/capture hook ran with the "
+                                 "feature off")
+        monkeypatch.setattr(anomaly_mod.AnomalyMonitor, "observe",
+                            forbidden)
+        monkeypatch.setattr(profiler_mod.ProfilerCapture, "begin",
+                            forbidden)
+        eng = make_engine(model)          # anomaly "auto" == off today
+        assert eng._anom is None and eng._cap is None
+        run_to_first_token(eng)
+        eng.health()
+        eng.metrics_snapshot()
+        eng.flush(0)
+        assert eng.capture_dirs == []
+
+    def test_anomaly_on_adds_no_clock_reads_per_warm_step(self, model):
+        """anomaly='on' must add NO clock reads to the warmed serving
+        loop relative to off: every detector is fed from the
+        timestamps and counters the loop already takes."""
+        sp = SamplingParams(temperature=0.0, max_new_tokens=1 << 30)
+        counts = {}
+        for mode in ("off", "on"):
+            eng = make_engine(model, anomaly=mode)
+            tok = run_to_first_token(eng)
+            eng.put(0, [int(tok)])
+            real = time.perf_counter
+            n = [0]
+
+            def counting():
+                n[0] += 1
+                return real()
+            time.perf_counter = counting
+            try:
+                eng.step(sampling=sp)
+            finally:
+                time.perf_counter = real
+            counts[mode] = n[0]
+        assert counts["on"] == counts["off"], counts
+
 
 # --------------------------------------------------------------------------
 # flight recorder

@@ -74,10 +74,14 @@ def test_replay_single_leg_schema(tmp_path):
                     with_faults=False)
     leg = res["legs"]["4.0"]
     for key in ("statuses", "preemptions", "parity", "ttft_steps_p95",
-                "tpot_ms_p50", "open_records"):
+                "tpot_ms_p50", "open_records", "anomalies"):
         assert key in leg
     assert leg["requests"] == 8
     assert all(leg["parity"].values())
+    # sweep engines run anomaly="on": the per-QPS tally is present
+    # (possibly zero fires, never None)
+    assert leg["anomalies"] is not None
+    assert "total" in leg["anomalies"]
     p = tmp_path / "slo.json"
     p.write_text(json.dumps(res))
     assert json.loads(p.read_text())["qps"] == [4.0]
@@ -138,6 +142,25 @@ def test_chaos_covers_all_variants(chaos_out):
     assert set(chaos_out["variants"]) == {
         "greedy_cache_on", "greedy_cache_off",
         "seeded_cache_on", "seeded_cache_off"}
+
+
+def test_chaos_anomaly_leg_hits_the_acceptance_bar(chaos_out):
+    """PR 10 acceptance (docs/OBSERVABILITY.md "Anomaly detection &
+    deep capture"): the injected latency_spike fault — detector
+    end-to-end under the existing fault injector — produces an anomaly
+    event in the flight dump, a bumped
+    ``serving_anomalies_total{signal=...}``, and a completed capture
+    window whose MERGED trace validates as Chrome-trace JSON carrying
+    BOTH host SpanTracer tracks and device-derived events."""
+    out = chaos_out
+    for k in ("anomaly_latency_fired", "anomaly_in_flight_dump",
+              "anomaly_counter_bumped", "anomaly_capture_completed",
+              "anomaly_merged_trace_valid"):
+        assert out["checks"][k], k
+    assert out["anomaly"]["captures"] >= 1
+    assert out["anomaly"]["summary"]["by_signal"].get(
+        "step_interval_ms", 0) >= 1
+    json.dumps(out["anomaly"])
 
 
 def test_replay_restart_needs_factory():

@@ -1,0 +1,22 @@
+"""Known-bad: profiler session control on the serving loop
+(tpulint: profiler-capture)."""
+import jax
+from jax.profiler import start_trace, stop_trace
+
+
+class Engine:
+    def step(self):  # tpulint: serving-loop
+        jax.profiler.start_trace("/tmp/t")    # BAD: unbounded session
+        out = self._run()
+        jax.profiler.stop_trace()             # BAD: bypasses the seam
+        return out
+
+    def _collect(self):  # tpulint: serving-loop
+        start_trace("/tmp/t")                 # BAD: direct-import form
+        with jax.profiler.trace("/tmp/t"):    # BAD: session ctx manager
+            out = self._run()
+        stop_trace()                          # BAD: direct-import form
+        return out
+
+    def _run(self):
+        return 0

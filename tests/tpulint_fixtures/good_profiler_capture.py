@@ -1,0 +1,26 @@
+"""Known-good twin of bad_profiler_capture (no findings)."""
+import jax
+
+
+class Engine:
+    def step(self):  # tpulint: serving-loop
+        # the gated capture-window seam (telemetry/profiler.py): the
+        # manager owns the jax.profiler session, the budget, and the
+        # clock anchor — the loop only hits step boundaries
+        cap = self._cap
+        if cap is not None and cap.armed:
+            cap.begin(step=0)
+        out = self._run()
+        if cap is not None and cap.active:
+            cap.end_step(step=1)
+        return out
+
+    def _run(self):
+        return 0
+
+
+def offline_profile_tool():
+    # unmarked host tooling (bench scripts, one-shot profilers) may
+    # drive the profiler directly — only the serving loop is gated
+    jax.profiler.start_trace("/tmp/t")
+    jax.profiler.stop_trace()

@@ -106,6 +106,21 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
         regressions.append({"metric": k, "old": om[k], "new": None,
                             "rel_change": None,
                             "note": "leg metric disappeared"})
+    # anomaly-count deltas (``<leg>_anomalies`` subtrees, PR 10):
+    # REPORTED, never gated — detector fires are workload/rig-noise
+    # sensitive, but a leg that suddenly fires 40 latency anomalies is
+    # exactly what a reviewer should look at next to a green diff
+    anomaly_deltas: List[Dict[str, Any]] = []
+    for k in sorted(set(old) | set(new)):
+        if not k.endswith("_anomalies"):
+            continue
+        ov, nv = old.get(k), new.get(k)
+        o = ov.get("total") if isinstance(ov, dict) else None
+        n = nv.get("total") if isinstance(nv, dict) else None
+        if o is None and n is None:
+            continue
+        if (o or 0) != (n or 0):
+            anomaly_deltas.append({"metric": k, "old": o, "new": n})
     return {
         "fingerprint_match": match,
         "old_fingerprint": {"config_hash": old_fp[0],
@@ -119,6 +134,7 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
         "unchanged": unchanged,
         "only_old": only_old,
         "only_new": only_new,
+        "anomaly_deltas": anomaly_deltas,
         "ok": match is False or not regressions,
     }
 
@@ -148,6 +164,9 @@ def _render(v: Dict[str, Any]) -> str:
     for e in v["improvements"]:
         lines.append(f"  improved   {e['metric']}: {e['old']} -> "
                      f"{e['new']} ({e['rel_change']:+.1%})")
+    for e in v.get("anomaly_deltas", []):
+        lines.append(f"  anomalies  {e['metric']}: {e['old']} -> "
+                     f"{e['new']} (report-only, never gates)")
     lines.append(f"  unchanged: {v['unchanged']}, "
                  f"new-only legs: {len(v['only_new'])}")
     lines.append("benchdiff: " + ("OK" if v["ok"] else "REGRESSED"))
@@ -203,13 +222,26 @@ def smoke() -> Dict[str, Any]:
     within = dict(base, pipe2_decode_tok_s=460.0)          # -8% < 15%
     assert compare(base, within)["ok"]
 
+    # anomaly-count deltas REPORT and never gate (PR 10): a 40x fire
+    # jump under a matching fingerprint stays ok=True but is listed
+    noisy_base = dict(base, pipe2_anomalies={"total": 1,
+                                             "by_signal": {"x": 1}})
+    noisy_new = dict(base, pipe2_anomalies={"total": 40,
+                                            "by_signal": {"x": 40}})
+    v_an = compare(noisy_base, noisy_new)
+    assert v_an["ok"], v_an
+    assert v_an["anomaly_deltas"] == [
+        {"metric": "pipe2_anomalies", "old": 1, "new": 40}], v_an
+    assert compare(noisy_base, noisy_base)["anomaly_deltas"] == []
+
     return {"ok": True,
             "checks": ["enforced_regression_fails",
                        "latency_regression_fails",
                        "fingerprint_mismatch_report_only",
                        "improvement_passes",
                        "dropped_leg_fails",
-                       "within_threshold_passes"]}
+                       "within_threshold_passes",
+                       "anomaly_delta_reports_not_gates"]}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

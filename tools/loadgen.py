@@ -345,10 +345,15 @@ def summarize(eng, res: Dict, trace: List[Request]) -> Dict:
     statuses: Dict[str, int] = {}
     for s in res["status"].values():
         statuses[s] = statuses.get(s, 0) + 1
+    # streaming-detector tally (telemetry/anomaly.py): per-signal fire
+    # counts for this leg — None while anomaly detection is off
+    anom = eng.anomaly_summary()
     return {
         "requests": len(trace),
         "steps": res["steps"],
         "statuses": statuses,
+        "anomalies": None if anom is None else {
+            "total": anom["total"], "by_signal": anom["by_signal"]},
         "preemptions": rm["aggregate"]["preemptions"],
         "open_records": rm["aggregate"]["open"],
         "parity": parity,
@@ -403,9 +408,12 @@ def run_sweep(qps_list: Sequence[float], n_requests: int = 32,
     from deepspeed_tpu.inference.overload import OverloadConfig
 
     if eng is None:
+        # anomaly detectors ride every sweep leg, so the SLO curves
+        # carry per-QPS anomaly counts next to their latency numbers
+        # (reset_metrics between legs rearms baselines + counters)
         eng, _ = build_engine(OverloadConfig(
             max_queued_requests=2 * 4, shed_policy=shed_policy,
-            prefill_chunk=8, aging_ms=200.0))
+            prefill_chunk=8, aging_ms=200.0), anomaly="on")
     legs = {}
     uid0 = 0
     for qps in qps_list:
@@ -641,6 +649,58 @@ def chaos_smoke(seed: int = 0) -> Dict:
             "health": eng.health()["state"],
             "flight_dumps": len(dumps),
         }
+
+    # ---- anomaly + deep-capture leg (docs/OBSERVABILITY.md "Anomaly
+    # detection & deep capture"): an injected latency_spike — a host
+    # stall the engine can only see as a dispatch-interval spike —
+    # must fire a latency-signal anomaly END-TO-END under the existing
+    # fault injector: a structured event in the flight dump, a bumped
+    # serving_anomalies_total{signal=...}, a completed capture window,
+    # and a merged host+device timeline that validates as Chrome-trace
+    # JSON carrying BOTH SpanTracer tracks and device-derived events.
+    from deepspeed_tpu.telemetry import AnomalyConfig
+    from tools.tracemerge import merge_capture, validate_merged_trace
+
+    prof_dir = os.path.join(flight_root, "anomaly_profile")
+    eng_a, _ = build_engine(
+        None, model=model_box[0], anomaly="on",
+        anomaly_cfg=AnomalyConfig(warmup=4, cooldown=2,
+                                  z_threshold=6.0, capture_steps=2,
+                                  max_captures=4),
+        profile=prof_dir, profile_steps=0,
+        failure=FailureConfig(dispatch_timeout_ms=None))
+    a_trace = make_trace(seed=seed + 1, n_requests=8, qps=30.0,
+                         arrival="poisson", prompt_lens=(4, 12),
+                         out_lens=(10, 14), uid0=6000)
+    # late enough that the detectors are past warmup, early enough
+    # that decode traffic is still flowing when the stall hits
+    spike_step = max(q.step for q in a_trace) + 6
+    res_a = replay(eng_a, a_trace,
+                   [Fault("latency_spike", step=spike_step, ms=250.0)],
+                   sampling=SamplingParams(max_new_tokens=1 << 30))
+    eng_a = res_a["engine"]
+    asum = eng_a.anomaly_summary()
+    checks["anomaly_latency_fired"] = \
+        asum["by_signal"].get("step_interval_ms", 0) >= 1
+    dump_a = eng_a.debug_dump()
+    checks["anomaly_in_flight_dump"] = any(
+        e.get("kind") == "anomaly"
+        and e.get("signal") == "step_interval_ms"
+        for e in dump_a["events"])
+    counter = eng_a.metrics.get("serving_anomalies_total")
+    checks["anomaly_counter_bumped"] = counter is not None \
+        and counter.value(signal="step_interval_ms") >= 1
+    caps = eng_a.capture_dirs
+    checks["anomaly_capture_completed"] = len(caps) >= 1
+    merged_ok = False
+    if caps:
+        with open(merge_capture(caps[-1])) as f:
+            merged_ok = not validate_merged_trace(json.load(f))
+    checks["anomaly_merged_trace_valid"] = merged_ok
+    out["anomaly"] = {
+        "summary": asum, "captures": len(caps),
+        "spike_step": spike_step, "steps": res_a["steps"],
+    }
     out["checks"] = checks
     out["ok"] = all(checks.values())
     if not out["ok"]:

@@ -983,3 +983,60 @@ def check_telemetry_hotpath(ctx: FileContext) -> Iterator[Finding]:
                     f"{d}() inside a jit-traced function — telemetry is "
                     "host-side only; record around the dispatch, never "
                     "inside the trace")
+
+
+# --------------------------------------------------------------------------
+# rule: profiler-capture — profiler sessions on serving paths go through
+# the one gated capture-window seam
+# --------------------------------------------------------------------------
+
+# jax.profiler session-control entry points: starting/stopping a trace
+# (or opening a session-shaped context manager) mid-serving-loop
+# bypasses the bounded capture window — its budget, its one-session
+# ownership, its clock anchor (without which tracemerge cannot align
+# the device events), and its loud absent-profiler degradation
+_PROFILER_SESSION_NAMES = {"start_trace", "stop_trace", "start_server",
+                           "trace", "TraceAnnotation",
+                           "StepTraceAnnotation"}
+# the direct-import forms are unambiguous session control even without
+# a `profiler` receiver segment
+_PROFILER_BARE_NAMES = {"start_trace", "stop_trace"}
+
+
+@rule("profiler-capture",
+      "jax.profiler session control (start_trace/stop_trace/trace/...) "
+      "inside a '# tpulint: serving-loop' marked method — deep captures "
+      "must route through the gated capture-window seam "
+      "(telemetry/profiler.py ProfilerCapture arm/begin/end_step): it "
+      "owns the session, the clock anchor tracemerge aligns with, the "
+      "cooldown/budget rate limit, and the loud absent-profiler "
+      "degradation")
+def check_profiler_capture(ctx: FileContext) -> Iterator[Finding]:
+    marked = _serving_marked_lines(ctx)
+    if not marked or "profiler" not in ctx.source \
+            and "start_trace" not in ctx.source:
+        return
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        header = range(fn.lineno, fn.body[0].lineno + 1)
+        if not any(ln in marked for ln in header):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func) or ""
+            segs = d.split(".")
+            name = segs[-1]
+            via_profiler = "profiler" in segs[:-1] \
+                and name in _PROFILER_SESSION_NAMES
+            bare = len(segs) == 1 and name in _PROFILER_BARE_NAMES
+            if via_profiler or bare:
+                yield Finding(
+                    "profiler-capture", ctx.path, node.lineno,
+                    node.col_offset,
+                    f"{d}() in a serving-loop method — profiler "
+                    "sessions must route through the gated "
+                    "capture-window seam (ProfilerCapture "
+                    "arm/begin/end_step), which owns the session, "
+                    "budget, and clock anchor")

@@ -1,0 +1,343 @@
+"""Merge a host SpanTracer Chrome trace with a ``jax.profiler`` device
+artifact into ONE Perfetto-loadable timeline
+(docs/OBSERVABILITY.md "Anomaly detection & deep capture").
+
+The host trace (telemetry/tracer.py) timestamps spans on
+``time.perf_counter_ns``; the jax profiler's ``*.trace.json.gz``
+timestamps its events relative to the profiling session start, and its
+``*.xplane.pb`` lines carry ns timestamps of their own.  Until now the
+two could only be eyeballed side by side — the depth-2 dispatch-ahead
+overlap (and later the T3 tile-level comm overlap, arxiv 2401.16677)
+was visually verifiable only on the host half.  The capture window
+(telemetry/profiler.py) records a clock anchor — ``perf_counter_ns``
+and ``epoch ns`` at the instant the session started — and this tool
+uses it to shift device events onto the host ``perf_counter``
+timeline, so host stages (schedule / stage / dispatch / wait /
+readback, each span carrying its step ``sid``) and device/XLA activity
+(including the ``jax.named_scope`` labels from ``comm/collectives.py``)
+render as tracks of ONE Perfetto file.
+
+Device-artifact handling, in preference order:
+
+* ``*.trace.json.gz`` under the capture's ``device/`` dir — already
+  Chrome-trace events, session-relative microseconds; shifted by the
+  anchor and merged as-is.
+* ``*.xplane.pb`` — decoded by the minimal pure-python protobuf reader
+  below (XSpace/XPlane/XLine/XEvent; no tensorflow/xprof dependency),
+  for jaxlib builds that emit only the xplane.
+* neither — the merge still completes, host-only, and says so loudly
+  in ``otherData.device_absent`` (the loud-but-absent contract).
+
+CLI::
+
+    python -m tools.tracemerge CAPTURE_DIR [-o merged.json]
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+# --------------------------------------------------------------------------
+# minimal protobuf wire-format reader (just enough for XSpace)
+# --------------------------------------------------------------------------
+
+
+def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, Any]]:
+    """Yield (field_number, wire_type, value) over one message body.
+    Length-delimited values come back as bytes; varints as ints;
+    fixed32/64 as raw ints."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = _read_varint(buf, i)
+        fno, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _read_varint(buf, i)
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 1:
+            v = int.from_bytes(buf[i:i + 8], "little")
+            i += 8
+        elif wt == 5:
+            v = int.from_bytes(buf[i:i + 4], "little")
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fno, wt, v
+
+
+def _decode_event_metadata(buf: bytes) -> Tuple[int, str]:
+    mid, name = 0, ""
+    for fno, _, v in _fields(buf):
+        if fno == 1:
+            mid = v
+        elif fno == 2:
+            name = v.decode("utf-8", "replace")
+        elif fno == 4 and not name:
+            name = v.decode("utf-8", "replace")
+    return mid, name
+
+
+def _decode_xevent(buf: bytes) -> Dict[str, int]:
+    ev = {"metadata_id": 0, "offset_ps": 0, "duration_ps": 0}
+    for fno, _, v in _fields(buf):
+        if fno == 1:
+            ev["metadata_id"] = v
+        elif fno == 2:
+            ev["offset_ps"] = v
+        elif fno == 3:
+            ev["duration_ps"] = v
+    return ev
+
+
+def _decode_xline(buf: bytes) -> Dict[str, Any]:
+    line = {"id": 0, "name": "", "timestamp_ns": 0, "events": []}
+    for fno, _, v in _fields(buf):
+        if fno == 1:
+            line["id"] = v
+        elif fno == 2:
+            line["name"] = v.decode("utf-8", "replace")
+        elif fno == 11 and not line["name"]:
+            line["name"] = v.decode("utf-8", "replace")
+        elif fno == 3:
+            line["timestamp_ns"] = v
+        elif fno == 4:
+            line["events"].append(_decode_xevent(v))
+    return line
+
+
+def _decode_xplane(buf: bytes) -> Dict[str, Any]:
+    plane = {"id": 0, "name": "", "lines": [], "event_metadata": {}}
+    for fno, _, v in _fields(buf):
+        if fno == 1:
+            plane["id"] = v
+        elif fno == 2:
+            plane["name"] = v.decode("utf-8", "replace")
+        elif fno == 3:
+            plane["lines"].append(_decode_xline(v))
+        elif fno == 4:
+            # map<int64, XEventMetadata> entry: key=1, value=2
+            k, meta = None, None
+            for efno, _, ev in _fields(v):
+                if efno == 1:
+                    k = ev
+                elif efno == 2:
+                    meta = _decode_event_metadata(ev)
+            if meta is not None:
+                plane["event_metadata"][k if k is not None
+                                        else meta[0]] = meta[1]
+    return plane
+
+
+def decode_xspace(buf: bytes) -> List[Dict[str, Any]]:
+    """Planes of one serialized ``XSpace`` (tensorflow xplane.proto) —
+    enough structure for timeline rendering: plane/line names, line
+    timestamps, events with metadata-resolved names."""
+    return [_decode_xplane(v) for fno, _, v in _fields(buf) if fno == 1]
+
+
+def xplane_chrome_events(path: str, t_session_epoch_ns: int,
+                         pid_base: int = 2000) -> List[Dict[str, Any]]:
+    """Chrome trace events (session-relative microsecond ``ts``) from
+    one ``*.xplane.pb``.  Line timestamps that look epoch-absolute
+    (> ~3 years in ns) are rebased on the capture's epoch anchor;
+    small ones are taken as session-relative already."""
+    with open(path, "rb") as f:
+        planes = decode_xspace(f.read())
+    out: List[Dict[str, Any]] = []
+    pid = pid_base
+    for plane in planes:
+        pid += 1
+        out.append({"ph": "M", "pid": pid, "tid": 0,
+                    "name": "process_name",
+                    "args": {"name": plane["name"] or f"plane{pid}"}})
+        for line in plane["lines"]:
+            tid = int(line["id"]) & 0x7FFFFFFF
+            out.append({"ph": "M", "pid": pid, "tid": tid,
+                        "name": "thread_name",
+                        "args": {"name": line["name"] or f"line{tid}"}})
+            base_ns = line["timestamp_ns"]
+            if base_ns > 10**17:          # epoch-absolute ns
+                base_ns -= t_session_epoch_ns
+            for ev in line["events"]:
+                name = plane["event_metadata"].get(
+                    ev["metadata_id"], f"event{ev['metadata_id']}")
+                out.append({
+                    "ph": "X", "pid": pid, "tid": tid,
+                    "name": name,
+                    "ts": (base_ns + ev["offset_ps"] / 1e3) / 1e3,
+                    "dur": ev["duration_ps"] / 1e6,
+                })
+    return out
+
+
+# --------------------------------------------------------------------------
+# device-artifact loading
+# --------------------------------------------------------------------------
+
+def load_device_events(device_dir: str,
+                       t_session_epoch_ns: int) -> List[Dict[str, Any]]:
+    """Chrome events (session-relative µs) from a jax profiler log dir:
+    prefers the ``trace.json.gz`` the profiler already renders, falls
+    back to decoding ``xplane.pb`` directly."""
+    gz = sorted(glob.glob(os.path.join(device_dir, "**",
+                                       "*.trace.json.gz"),
+                          recursive=True))
+    if gz:
+        with gzip.open(gz[-1], "rt") as f:
+            return json.load(f).get("traceEvents", [])
+    pbs = sorted(glob.glob(os.path.join(device_dir, "**", "*.xplane.pb"),
+                           recursive=True))
+    if pbs:
+        return xplane_chrome_events(pbs[-1], t_session_epoch_ns)
+    return []
+
+
+# --------------------------------------------------------------------------
+# merge
+# --------------------------------------------------------------------------
+
+def merge_events(host_events: List[Dict[str, Any]],
+                 device_events: List[Dict[str, Any]],
+                 t_start_perf_ns: int) -> List[Dict[str, Any]]:
+    """Put both event streams on the host ``perf_counter`` timeline
+    (microseconds): host events already are; device events are
+    session-relative and get shifted by the capture's anchor.  Device
+    pids are bumped out of the host's pid space so Perfetto renders
+    host stages and device activity as separate process groups."""
+    anchor_us = t_start_perf_ns / 1e3
+    out: List[Dict[str, Any]] = list(host_events)
+    for ev in device_events:
+        if not isinstance(ev, dict) or "ph" not in ev:
+            continue      # the profiler emits a trailing partial record
+        ev = dict(ev)
+        pid = ev.get("pid", 0)
+        ev["pid"] = pid + 10_000 if pid < 10_000 else pid
+        if ev.get("ph") in ("X", "i", "b", "e") and "ts" in ev:
+            ev["ts"] = ev["ts"] + anchor_us
+        out.append(ev)
+    return out
+
+
+def merge_capture(capture_dir: str,
+                  out_path: Optional[str] = None) -> str:
+    """Merge one capture window's artifacts
+    (telemetry/profiler.py layout: ``meta.json`` + ``host_trace.json``
+    + ``device/``) into a single Perfetto-loadable Chrome trace;
+    returns the written path (default ``<capture_dir>/merged.json``)."""
+    with open(os.path.join(capture_dir, "meta.json")) as f:
+        meta = json.load(f)
+    host: Dict[str, Any] = {"traceEvents": []}
+    if meta.get("host_trace"):
+        with open(os.path.join(capture_dir, meta["host_trace"])) as f:
+            host = json.load(f)
+    device_events: List[Dict[str, Any]] = []
+    device_absent = True
+    if meta.get("device_dir"):
+        ddir = os.path.join(capture_dir, meta["device_dir"])
+        if os.path.isdir(ddir):
+            device_events = load_device_events(
+                ddir, meta.get("t_start_epoch_ns", 0))
+            device_absent = not device_events
+    if device_absent:
+        print(f"tracemerge: NO device events under {capture_dir} — "  # tpulint: disable=print — CLI/loud-degradation output
+              "emitting a host-only timeline (profiler absent or "
+              "unsupported on this backend/build)")
+    merged = {
+        "displayTimeUnit": "ms",
+        "traceEvents": merge_events(host.get("traceEvents", []),
+                                    device_events,
+                                    meta["t_start_perf_ns"]),
+        "otherData": {
+            "merged_by": "tools/tracemerge",
+            "capture": meta,
+            "host_events": len(host.get("traceEvents", [])),
+            "device_events": len(device_events),
+            "device_absent": device_absent,
+        },
+    }
+    out_path = out_path or os.path.join(capture_dir, "merged.json")
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    return out_path
+
+
+def validate_merged_trace(obj: Dict[str, Any],
+                          require_device: bool = True) -> List[str]:
+    """Schema check for a merged timeline: returns violations (empty
+    when valid).  Valid means Chrome-trace-shaped (``traceEvents`` list
+    of dicts with ``ph``), containing at least one host SpanTracer
+    track (pid 1 thread_name metadata) and — unless ``require_device``
+    is off — at least one device-derived duration event (pid >=
+    10000)."""
+    problems: List[str] = []
+    evs = obj.get("traceEvents")
+    if not isinstance(evs, list) or not evs:
+        return ["traceEvents missing or empty"]
+    if not all(isinstance(e, dict) and "ph" in e for e in evs):
+        problems.append("malformed trace events (dict with 'ph' "
+                        "required)")
+        return problems
+    host_tracks = {e["args"]["name"] for e in evs
+                   if e.get("ph") == "M" and e.get("pid") == 1
+                   and e.get("name") == "thread_name"
+                   and isinstance(e.get("args"), dict)
+                   and "name" in e["args"]}
+    if not host_tracks:
+        problems.append("no host SpanTracer tracks (pid 1 thread_name)")
+    host_spans = [e for e in evs if e.get("pid") == 1
+                  and e.get("ph") == "X"]
+    if not host_spans:
+        problems.append("no host span events")
+    dev = [e for e in evs if e.get("pid", 0) >= 10_000
+           and e.get("ph") == "X"]
+    if require_device and not dev:
+        problems.append("no device-derived events (pid >= 10000)")
+    return problems
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("capture_dir",
+                    help="capture window directory "
+                    "(telemetry/profiler.py layout)")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path (default: "
+                    "<capture_dir>/merged.json)")
+    ap.add_argument("--validate", action="store_true",
+                    help="schema-check the merged file and exit "
+                    "nonzero on violations")
+    args = ap.parse_args(argv)
+    path = merge_capture(args.capture_dir, args.out)
+    print(path)  # tpulint: disable=print — the CLI's one output line
+    if args.validate:
+        with open(path) as f:
+            problems = validate_merged_trace(json.load(f))
+        if problems:
+            print("\n".join(problems))  # tpulint: disable=print — CLI output
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
