@@ -248,6 +248,7 @@ def main(trace_path=None, profile_dir=None):
     llama_train = leg(llama_train_bench, on_tpu, peak)
     llama_serve = leg(llama8b_serving_bench, on_tpu)
     moe = leg(moe_train_bench, on_tpu, peak)
+    comm = leg(comm_overlap_bench, on_tpu)
 
     out = {
         "metric": "gpt2s_train_tokens_per_sec_chip",
@@ -267,7 +268,8 @@ def main(trace_path=None, profile_dir=None):
     }
     out.update(serve)
     print(json.dumps({**out, **pipe, **prefix, **spec, **overload,  # tpulint: disable=print — the bench's one JSON output line
-                      **chaos, **llama_train, **llama_serve, **moe}))
+                      **chaos, **llama_train, **llama_serve, **moe,
+                      **comm}))
 
 
 def bench_fingerprint():
@@ -285,6 +287,53 @@ def bench_fingerprint():
     from deepspeed_tpu.telemetry import config_fingerprint
 
     return config_fingerprint()
+
+
+def comm_overlap_bench(on_tpu: bool):
+    """Overlapped-vs-serial collective microbench (T3 arxiv 2401.16677
+    tile decomposition + EQuARX arxiv 2506.17615 quantized wire;
+    docs/SERVING.md "Overlapped & quantized collectives").
+
+    Four comm plans over the same row-parallel GEMM: serial psum,
+    tile-decomposed psum (bitwise-exact), ppermute ring, int8 quantized
+    wire — numerics cross-checked inside the leg before timing.  On a
+    real multi-chip backend it measures the actual fabric in-process;
+    with one local device it runs in a CHILD process on an 8-device
+    virtual CPU mesh (the MULTICHIP driver's trick — the parent's
+    backend stays untouched).  The headline ``comm_*_ms`` /
+    ``comm_*_speedup`` metrics land top-level in the BENCH JSON, where
+    ``tools/benchdiff.py``'s existing direction rules gate them."""
+    import os
+    import subprocess
+
+    import jax
+
+    if len(jax.devices()) > 1:
+        from deepspeed_tpu.comm.bench import overlap_bench
+
+        rec = overlap_bench(trials=10, warmups=3)
+    else:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "host_platform_device_count" not in f]
+        flags.append("--xla_force_host_platform_device_count=8")
+        if not any("concurrency_optimized_scheduler" in f for f in flags):
+            flags.append(
+                "--xla_cpu_enable_concurrency_optimized_scheduler=false")
+        env["XLA_FLAGS"] = " ".join(flags)
+        here = os.path.dirname(os.path.abspath(__file__))
+        env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+        out = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.comm.bench",
+             "--overlap", "--trials", "10"],
+            capture_output=True, text=True, env=env, check=True, cwd=here)
+        rec = json.loads(out.stdout.strip().splitlines()[-1])
+    return {"comm_overlap_bench": rec,
+            "comm_serial_ms": rec["comm_serial_ms"],
+            "comm_overlapped_ms": rec["comm_overlapped_ms"],
+            "comm_overlap_speedup": rec["comm_overlap_speedup"],
+            "comm_quant_speedup": rec["comm_quant_speedup"]}
 
 
 def chaos_serving_bench(on_tpu: bool):

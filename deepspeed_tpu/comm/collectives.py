@@ -145,20 +145,67 @@ def _timed(op_name: str):
 class Collectives:
     """Eager collectives bound to a mesh, for host-level orchestration and
     comm microbenchmarks.  Arrays are treated as sharded along dim 0 over
-    ``axis_name`` (all_gather/reduce_scatter) or replicated (all_reduce)."""
+    ``axis_name`` (all_gather/reduce_scatter) or replicated (all_reduce).
 
-    def __init__(self, topology: MeshTopology):
+    The jitted-executable cache is keyed by (op, axis, **shape/dtype**)
+    and LRU-bounded (the serving ``_pstep_fns`` discipline): each key
+    sees exactly one specialization, so evicting an entry really frees
+    its executable — the unkeyed cache used to retain every shape ever
+    reduced.  Fills and runtime retraces count through the PR-9
+    compile-observatory counters (``training_comm_collective_*``) on
+    ``metrics`` (an optional shared
+    :class:`~deepspeed_tpu.telemetry.metrics.MetricsRegistry`; a
+    private one is created when none is passed)."""
+
+    _CACHE_CAP = 16
+
+    def __init__(self, topology: MeshTopology, metrics=None):
         self.topology = topology
         self._cache = {}
+        self._compiled_ever = set()
+        if metrics is None:
+            from ..telemetry.metrics import MetricsRegistry
+            metrics = MetricsRegistry()
+        self.metrics = metrics
+        self._c_compiles = metrics.counter(
+            "training_comm_collective_compiles_total",
+            "eager-collective executables built (cache fills)",
+            int_valued=True)
+        self._c_retraces = metrics.counter(
+            "training_comm_collective_retraces_total",
+            "re-builds of an eager-collective key already compiled "
+            "(LRU thrash across shapes/dtypes — each warns loudly)",
+            int_valued=True)
 
     @property
     def mesh(self) -> Mesh:
         return self.topology.mesh
 
+    def _sig(self, x) -> tuple:
+        # key on dtype WITHOUT materializing x on device — jnp.asarray
+        # here would pay a full H2D transfer per call just to read a
+        # field, and the real transfer happens inside the jitted op
+        dt = getattr(x, "dtype", None)
+        return (tuple(np.shape(x)),
+                str(dt if dt is not None else jnp.result_type(x)))
+
     def _jit(self, key, build):
-        if key not in self._cache:
-            self._cache[key] = build()
-        return self._cache[key]
+        fn = self._cache.pop(key, None)
+        if fn is None:
+            if len(self._cache) >= self._CACHE_CAP:
+                self._cache.pop(next(iter(self._cache)))
+            fn = build()
+            self._c_compiles.inc()
+            if key in self._compiled_ever:
+                self._c_retraces.inc()
+                logger.warning(
+                    "eager collective %r re-built at runtime (retrace "
+                    "#%d) — the executable cache is thrashing across "
+                    "shapes/dtypes", key, int(self._c_retraces.value()))
+            else:
+                self._compiled_ever.add(key)
+        self._cache[key] = fn            # reinsert: LRU, not FIFO
+        return fn
 
     # -- ops ---------------------------------------------------------------
     @_timed("all_reduce")
@@ -167,14 +214,15 @@ class Collectives:
 
         def build():
             def f(v):
-                r = lax.psum(v, axis_name)
+                with jax.named_scope(f"all_reduce_{axis_name}"):
+                    r = lax.psum(v, axis_name)
                 return r / self.topology.size(axis_name) if op == "mean" else r
 
             return jax.jit(shard_map(
                 f, mesh=mesh, in_specs=P(), out_specs=P(),
                 check_vma=False))
 
-        fn = self._jit(("ar", axis_name, op), build)
+        fn = self._jit(("ar", axis_name, op) + self._sig(x), build)
         return fn(x)
 
     @_timed("all_gather")
@@ -184,13 +232,14 @@ class Collectives:
 
         def build():
             def f(v):
-                return lax.all_gather(v, axis_name, axis=0, tiled=True)
+                with jax.named_scope(f"all_gather_{axis_name}"):
+                    return lax.all_gather(v, axis_name, axis=0, tiled=True)
 
             return jax.jit(shard_map(
                 f, mesh=mesh, in_specs=P(axis_name), out_specs=P(),
                 check_vma=False))
 
-        fn = self._jit(("ag", axis_name), build)
+        fn = self._jit(("ag", axis_name) + self._sig(x), build)
         return fn(x)
 
     @_timed("reduce_scatter")
@@ -200,13 +249,15 @@ class Collectives:
 
         def build():
             def f(v):
-                return lax.psum_scatter(v, axis_name, scatter_dimension=0, tiled=True)
+                with jax.named_scope(f"reduce_scatter_{axis_name}"):
+                    return lax.psum_scatter(v, axis_name,
+                                            scatter_dimension=0, tiled=True)
 
             return jax.jit(shard_map(
                 f, mesh=mesh, in_specs=P(), out_specs=P(axis_name),
                 check_vma=False))
 
-        fn = self._jit(("rs", axis_name), build)
+        fn = self._jit(("rs", axis_name) + self._sig(x), build)
         return fn(x)
 
     @_timed("all_to_all")
@@ -216,8 +267,9 @@ class Collectives:
 
         def build():
             def f(v):
-                return lax.all_to_all(v, axis_name, split_axis=split_dim,
-                                      concat_axis=concat_dim, tiled=True)
+                with jax.named_scope(f"all_to_all_{axis_name}"):
+                    return lax.all_to_all(v, axis_name, split_axis=split_dim,
+                                          concat_axis=concat_dim, tiled=True)
 
             spec = [None] * x.ndim
             spec[concat_dim] = axis_name
@@ -228,7 +280,8 @@ class Collectives:
                 f, mesh=mesh, in_specs=in_spec, out_specs=P(*out_spec_l),
                 check_vma=False))
 
-        fn = self._jit(("a2a", axis_name, split_dim, concat_dim, x.ndim), build)
+        fn = self._jit(("a2a", axis_name, split_dim, concat_dim)
+                       + self._sig(x), build)
         return fn(x)
 
     @_timed("broadcast")
@@ -238,15 +291,16 @@ class Collectives:
 
         def build():
             def f(v):
-                idx = lax.axis_index(axis_name)
-                v = jnp.where(idx == src, v, jnp.zeros_like(v))
-                return lax.psum(v, axis_name)
+                with jax.named_scope(f"broadcast_{axis_name}"):
+                    idx = lax.axis_index(axis_name)
+                    v = jnp.where(idx == src, v, jnp.zeros_like(v))
+                    return lax.psum(v, axis_name)
 
             return jax.jit(shard_map(
                 f, mesh=mesh, in_specs=P(), out_specs=P(),
                 check_vma=False))
 
-        fn = self._jit(("bc", axis_name, src), build)
+        fn = self._jit(("bc", axis_name, src) + self._sig(x), build)
         return fn(x)
 
     def barrier(self) -> None:

@@ -58,6 +58,29 @@ class CommsLogger:
         self.debug = debug
         # op_name -> msg_size -> [count, total_lat_s, total_algbw, total_busbw]
         self.comms_dict: Dict[str, Dict[int, List[float]]] = defaultdict(dict)
+        # optional PR-5 metrics mirror (attach_registry)
+        self._c_time = self._c_bytes = self._c_ops = None
+
+    def attach_registry(self, registry) -> None:
+        """Mirror every profiled op record into a
+        :class:`~deepspeed_tpu.telemetry.metrics.MetricsRegistry` as
+        ``training_comm_*`` counters (op as a label), so comm time
+        reaches the Prometheus exposition and flight dumps instead of
+        only the ad-hoc :meth:`log_all` table.  One registry at a time
+        — the latest attach wins (this is a module singleton; the
+        training engine attaches its registry at construction)."""
+        self._c_time = registry.counter(
+            "training_comm_time_ms_total",
+            "cumulative wall ms in profiled eager collectives "
+            "(comms_logger; label op)")
+        self._c_bytes = registry.counter(
+            "training_comm_msg_bytes_total",
+            "cumulative message bytes through profiled eager "
+            "collectives (comms_logger; label op)", int_valued=True)
+        self._c_ops = registry.counter(
+            "training_comm_ops_profiled_total",
+            "profiled eager collective calls (comms_logger; label op)",
+            int_valued=True)
 
     def configure(self, enabled=None, verbose=None, prof_all=None, prof_ops=None):
         if enabled is not None:
@@ -77,6 +100,10 @@ class CommsLogger:
     def append(self, op_name: str, raw_name: str, latency_s: float,
                msg_size: int, n_participants: int) -> None:
         algbw, busbw = calc_bw_log(op_name, msg_size, latency_s, n_participants)
+        if self._c_time is not None:
+            self._c_time.inc(latency_s * 1e3, op=op_name)
+            self._c_bytes.inc(msg_size, op=op_name)
+            self._c_ops.inc(1, op=op_name)
         per_size = self.comms_dict[raw_name]
         if msg_size in per_size:
             rec = per_size[msg_size]

@@ -394,6 +394,43 @@ class CommsLoggerConfig(ConfigModel):
 
 
 @dataclass
+class CommConfig(ConfigModel):
+    """Overlapped / quantized gradient-sync collectives
+    (comm/overlap.py; T3 arxiv 2401.16677, EQuARX arxiv 2506.17615;
+    docs/SERVING.md "Overlapped & quantized collectives").
+
+    ``overlap``: the per-microbatch gradient reduction runs through the
+    tile-decomposed reduce-scatter/all-reduce inside a manual shard_map
+    region instead of GSPMD's one monolithic collective per leaf —
+    slice *i*'s comm carries no dependency on slice *i+1* (or on the
+    next microbatch's backward), so XLA may co-schedule them.  The
+    default exact rung is bitwise-identical to the plain reduction.
+
+    ``quantized_allreduce``: "int8" | "int4" — promote the qgZ wire
+    format from a zero_quantized_gradients-only leg to a first-class
+    mesh-wide option: every DP-axis gradient collective carries bits/8
+    of the exact payload.  Error-bounded, not exact.
+
+    Both ride ``_manual_reduce_axes``, so meshes that cannot host the
+    manual region (pipeline/sequence parallel, legacy-jax stage-3/TP)
+    keep the PR-1 contract: loud degradation to the plain exact
+    reduction (or a ConfigError unless ``allow_feature_degradation``).
+    ``zero_quantized_gradients`` (qgZ proper) and the 1-bit optimizers
+    take precedence when configured."""
+    overlap: bool = False
+    tiles: int = 4
+    quantized_allreduce: Optional[str] = None      # "int8" | "int4"
+
+    def __post_init__(self):
+        if self.quantized_allreduce not in (None, "int8", "int4"):
+            raise ConfigError(
+                "comm.quantized_allreduce must be null, 'int8' or "
+                f"'int4', got {self.quantized_allreduce!r}")
+        if self.tiles < 1:
+            raise ConfigError(f"comm.tiles must be >= 1, got {self.tiles}")
+
+
+@dataclass
 class FlopsProfilerConfig(ConfigModel):
     enabled: bool = False
     profile_step: int = 1
@@ -580,6 +617,7 @@ class Config(ConfigModel):
     moe: MoEConfig = field(default_factory=MoEConfig)
     activation_checkpointing: ActivationCheckpointingConfig = field(
         default_factory=ActivationCheckpointingConfig)
+    comm: CommConfig = field(default_factory=CommConfig)
     comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
     flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)

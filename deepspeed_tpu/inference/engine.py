@@ -119,6 +119,33 @@ class InferenceConfig:
     # into the synchronous loop.  Host RAM pays one transient cache copy
     # instead.
     kv_donate: str = "auto"
+    # --- overlapped & quantized multi-chip collectives (comm/overlap.py;
+    # T3 arxiv 2401.16677, EQuARX arxiv 2506.17615; docs/SERVING.md
+    # "Overlapped & quantized collectives") ------------------------------
+    # "on": the TP hot path's two heavy collectives — the MLP
+    # down-projection's partial-sum all-reduce and the unembed's logits
+    # all-gather — run tile-decomposed inside shard_map, so XLA can
+    # schedule tile i's comm behind tile i+1's GEMM instead of the
+    # serial GSPMD collective after the whole GEMM.  Bitwise-identical
+    # to "off" (the default exact rung reduces each tile with the same
+    # psum; the gather is pure data movement) — asserted by parity
+    # tests on 1-chip and simulated 8-device meshes.  "auto": on
+    # whenever the mesh has a tensor axis and the shapes divide it;
+    # single-chip auto resolves off (there is nothing to overlap).
+    # "on" without a tensor axis is a loud no-op, never an error — the
+    # same config must run on 1 chip and on the pod.
+    comm_overlap: str = "auto"
+    # tiles per decomposed collective (clamped to divide the row dim)
+    comm_tiles: int = 4
+    # EQuARX-style quantized allreduce for the TP activation reduction:
+    # "int8" | "int4" wire payloads — bits/8 of the exact bytes on the
+    # wire (the telemetry reconciliation test asserts exactly that
+    # ratio).  Applies to the down-projection all-reduce only; the
+    # unembed GATHER always stays exact, because a perturbed logit
+    # could flip a greedy argmax.  Meshes/shapes that cannot support
+    # the quantized wire degrade LOUDLY to the exact reduction (the
+    # PR-1 contract for every quantized collective).
+    comm_quant: Optional[str] = None
     # automatic prefix caching over the paged KV cache: full KV blocks
     # are content-hashed by their token chain (rolling hash of
     # (parent, block_tokens)) and an incoming prompt's longest cached
@@ -341,6 +368,13 @@ class InferenceEngine:
             # explicit force-on with an ineligible layout is a config error
             self._require_mixed_gemm_eligible()
         self._setup_sharding()
+        # resolved overlapped/quantized-collective plan (comm/overlap.py)
+        # — None when the mesh/shapes give the decomposition nothing to
+        # do; _resolve_fw may still drop the down-projection leg when
+        # the mixed-GEMM probe keeps those weights quantized
+        self._serving_comm = self._resolve_serving_comm()
+        self._comm_active = self._serving_comm
+        self._comm_stats: Optional[Dict[str, float]] = None
         if self.topology is None:
             self._place_default_device()
         if self.icfg.kv_offload:
@@ -503,6 +537,25 @@ class InferenceEngine:
             "serving_compile_wall_ms_total",
             "cumulative first-call (compile-carrying) dispatch wall ms")
         self.timings = CounterDictView({**ms, **ints})
+        # --- overlapped/quantized collectives (docs/SERVING.md
+        # "Overlapped & quantized collectives"): static per-dispatch
+        # wire accounting — the shapes of a compiled step fully
+        # determine what its decomposed TP collectives move, so the
+        # counters bump from host-side arithmetic, never a device
+        # probe.  A quantized op's bytes are bits/8 of the exact op's
+        # (asserted by the telemetry reconciliation test).
+        self._c_comm_ops = reg.counter(
+            "serving_comm_ops_total",
+            "decomposed TP collectives dispatched (kind: exact|quant)",
+            int_valued=True)
+        self._c_comm_tiles = reg.counter(
+            "serving_comm_tiles_total",
+            "tiles across dispatched decomposed TP collectives",
+            int_valued=True)
+        self._c_comm_bytes = reg.counter(
+            "serving_comm_bytes_total",
+            "modeled bytes on the wire for decomposed TP collectives "
+            "(kind: exact|quant)")
         # --- KV-pool occupancy gauges: pull-based (FnGauge — computed
         # from allocator truth at export time), so the serving loop
         # never updates them and a scrape is always current.  The
@@ -843,6 +896,67 @@ class InferenceEngine:
         self.state.kv = jax.device_put(self.state.kv, self._kv_nsh)
         self._shard_weights()
 
+    def _resolve_serving_comm(self):
+        """Resolve ``comm_overlap``/``comm_quant``/``comm_tiles`` against
+        the mesh and model shapes into a :class:`ServingComm` plan (or
+        None).  The contract: an eligible mesh gets the decomposed
+        collectives, anything else degrades LOUDLY to the serial exact
+        path — never an error, because one config must serve on a
+        laptop and on the pod (docs/SERVING.md "Overlapped & quantized
+        collectives")."""
+        mode = self.icfg.comm_overlap
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(f"comm_overlap={mode!r}: expected 'auto', "
+                             "'on', or 'off'")
+        qname = self.icfg.comm_quant
+        if qname not in (None, "int8", "int4"):
+            raise ValueError(f"comm_quant={qname!r}: expected None, "
+                             "'int8', or 'int4'")
+        topo = self.topology
+        tp = 0 if topo is None else topo.tp_size
+        if tp <= 1:
+            if mode == "on" or qname is not None:
+                logger.warning(
+                    "comm_overlap/comm_quant: no tensor axis on this "
+                    "engine (%s) — collectives stay serial and exact",
+                    "single-chip" if topo is None
+                    else f"mesh {topo.axis_sizes}")
+            return None
+        if mode == "off" and qname is None:
+            return None
+        cfg = self.cfg
+        downproj = cfg.num_experts <= 1 and cfg.d_ff % tp == 0
+        unembed = cfg.vocab_size % tp == 0
+        if not downproj and not unembed:
+            logger.warning(
+                "comm_overlap: neither d_ff=%d nor vocab=%d is eligible "
+                "on tensor=%d (MoE layers and indivisible dims stay "
+                "with GSPMD); serial exact collectives",
+                cfg.d_ff, cfg.vocab_size, tp)
+            return None
+        bits = {None: None, "int8": 8, "int4": 4}[qname]
+        if bits is not None and not downproj:
+            logger.warning(
+                "comm_quant=%s: the down-projection all-reduce is "
+                "ineligible on this model/mesh and the logits gather "
+                "never quantizes — exact wire", qname)
+            bits = None
+        if mode == "off":
+            # comm_quant alone: ONE serial quantized all-reduce on the
+            # down-projection — and nothing else; "off" must leave the
+            # unembed gather with GSPMD (quantization never applies to
+            # it, and a tiles=1 ppermute ring would replace the fused
+            # all-gather for no benefit)
+            if bits is None:
+                return None
+            tiles, unembed = 1, False
+        else:
+            tiles = max(1, self.icfg.comm_tiles)
+        from ..comm.overlap import ServingComm
+        return ServingComm(mesh=topo.mesh, axis_name=TENSOR_AXIS,
+                           tiles=tiles, quant_bits=bits,
+                           downproj=downproj, unembed=unembed)
+
     def _shard_weights(self) -> None:
         """Place the (possibly quantized) weight trees on the mesh.
 
@@ -1061,9 +1175,21 @@ class InferenceEngine:
             impl = self._probe_attn_impl()
         mixed = self._resolve_mixed_gemm(impl)
         self._mixed_gemm_active = mixed
+        comm = self._serving_comm
+        if comm is not None and mixed and comm.downproj:
+            # mixed-GEMM keeps the down-projection weight quantized for
+            # the VMEM-dequant kernel — only the unembed gather can
+            # still decompose; the plan (and its wire accounting)
+            # shrinks to match the compiled program
+            comm = comm._replace(downproj=False, quant_bits=None)
+            if not comm.unembed:
+                comm = None
+        self._comm_active = comm
+        self._comm_stats = None        # re-derive from the active plan
         return dict(attn_impl=impl, mixed_gemm=mixed,
                     kv_host=getattr(self, "_kv_on_host", False),
-                    shard_mesh=self._tp_mesh, stream=self._stream), mbs
+                    shard_mesh=self._tp_mesh, stream=self._stream,
+                    comm=comm), mbs
 
     def _donate_kv(self) -> bool:
         """Whether serving programs donate the paged cache.  See
@@ -1141,8 +1267,21 @@ class InferenceEngine:
         cfg = self.cfg
         bs = self.icfg.kv_block_size
         fw, mbs = self._resolve_fw(mbs)
+        repl = self._repl
 
         def sample_fn(logits, keys):
+            if repl is not None:
+                # pin the logits replicated BEFORE the categorical: on
+                # legacy jax the threefry bits behind temperature
+                # sampling are sharding-dependent, so a vocab-sharded
+                # logits tensor (GSPMD's natural layout for the serial
+                # unembed) and a replicated one (the shard_map overlap
+                # path's output) would sample DIFFERENT tokens from
+                # bitwise-identical logits — this constraint makes
+                # seeded streams invariant to the comm plan (the gather
+                # it forces happens either way for the replicated
+                # token output)
+                logits = jax.lax.with_sharding_constraint(logits, repl)
             return sample_rows(logits, sampling, keys)
 
         def pstep(params, quant, kv, batch: RaggedBatch, prev_toks, rng):
@@ -2508,6 +2647,8 @@ class InferenceEngine:
         tm["stage_ms"] += (t2 - t1) * 1e3
         tm["device_ms"] += (t3 - t2) * 1e3
         tm["steps"] += 1
+        if self._comm_active is not None:
+            self._bump_comm_counters()
         if cold:
             # first completed call of this program: its dispatch wall
             # time carried the XLA compile (the timestamps are the ones
@@ -2553,6 +2694,51 @@ class InferenceEngine:
                          stop=sampling.stop_token,
                          registered=tuple(self.state.round_registered),
                          cold=cold)
+
+    def _comm_step_stats(self) -> Dict[str, float]:
+        """Modeled wire accounting for ONE dispatched step's decomposed
+        TP collectives, derived from the compiled shapes (host
+        arithmetic only): the down-projection all-reduces one
+        [token_budget, d_model] partial per layer, the unembed gathers
+        one [rows, vocab] logits block.  Tile counts mirror the
+        compiled program's ``_resolve_tiles`` clamp, not the raw
+        config knob."""
+        from ..comm.overlap import _resolve_tiles, wire_bytes
+
+        comm = self._comm_active
+        n = self.topology.tp_size
+        isz = jnp.dtype(self.icfg.param_dtype).itemsize
+        st = {"ops_exact": 0, "ops_quant": 0, "tiles": 0,
+              "bytes_exact": 0.0, "bytes_quant": 0.0}
+        if comm.downproj:
+            elems = self.icfg.token_budget * self.cfg.d_model
+            per = wire_bytes("all_reduce", elems, isz, n, comm.quant_bits)
+            L = self.cfg.num_layers
+            kind = "quant" if comm.quant_bits else "exact"
+            st[f"ops_{kind}"] += L
+            st[f"bytes_{kind}"] += per * L
+            st["tiles"] += L * _resolve_tiles(self.icfg.token_budget,
+                                              comm.tiles)
+        if comm.unembed:
+            rows = self.icfg.max_seqs * self._n_verify
+            per = wire_bytes("all_gather", rows * self.cfg.vocab_size,
+                             isz, n)
+            st["ops_exact"] += 1
+            st["bytes_exact"] += per
+            st["tiles"] += _resolve_tiles(rows, comm.tiles)
+        return st
+
+    def _bump_comm_counters(self) -> None:
+        if self._comm_stats is None:
+            self._comm_stats = self._comm_step_stats()
+        st = self._comm_stats
+        if st["ops_exact"]:
+            self._c_comm_ops.inc(st["ops_exact"], kind="exact")
+            self._c_comm_bytes.inc(st["bytes_exact"], kind="exact")
+        if st["ops_quant"]:
+            self._c_comm_ops.inc(st["ops_quant"], kind="quant")
+            self._c_comm_bytes.inc(st["bytes_quant"], kind="quant")
+        self._c_comm_tiles.inc(st["tiles"])
 
     def _drain_cow(self) -> None:  # tpulint: serving-loop
         """Execute queued copy-on-write block copies (a prefix-cache

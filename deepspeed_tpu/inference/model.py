@@ -26,6 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..compat import shard_map
+from ..comm.overlap import (ServingComm, shard_matmul_allgather,
+                            shard_matmul_allreduce)
 from ..models import layers as L
 from ..models.transformer import TransformerConfig, _norm
 from .ragged.state import RaggedBatch
@@ -304,8 +306,22 @@ def _qkv_proj(cfg, ap, h, dt, cos, sin, positions):
     return q, k, v
 
 
-def _ffn(cfg, lp, h, dt, act):
-    """Shared MLP / MoE branch of a serving layer."""
+def _dense_weight(w) -> bool:
+    """Whether ``w`` is a plain array (mixed-GEMM QuantizedTensor
+    weights keep their VMEM-dequant kernel path and never route through
+    the decomposed collectives)."""
+    from ..ops.quant import QuantizedTensor
+    return not isinstance(w, QuantizedTensor)
+
+
+def _ffn(cfg, lp, h, dt, act, comm: Optional[ServingComm] = None):
+    """Shared MLP / MoE branch of a serving layer.
+
+    With ``comm`` (TP serving, comm_overlap on), the down-projection —
+    the layer's one row-parallel GEMM, whose partial-sum all-reduce
+    GSPMD would otherwise run serially after it — goes through the
+    T3-style tile-decomposed matmul+allreduce instead
+    (comm/overlap.py; bitwise-identical on the default exact rung)."""
     if cfg.num_experts > 1:
         from ..models.transformer import _shared_expert
         from ..parallel import moe as M
@@ -328,7 +344,11 @@ def _ffn(cfg, lp, h, dt, act):
         u = act(_mm(h, mp["wg"], dt)) * u
     else:
         u = act(u)
-    d = _mm(u, mp["wo"], dt)
+    wo = mp["wo"]
+    if comm is not None and comm.downproj and _dense_weight(wo):
+        d = shard_matmul_allreduce(u, wo, comm, dt)
+    else:
+        d = _mm(u, wo, dt)
     if cfg.mlp_bias:
         d = d + mp["bo"].astype(dt)
     return d
@@ -343,6 +363,7 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
                    shard_mesh=None,
                    stream=None,
                    mixed_gemm: bool = False,
+                   comm: Optional[ServingComm] = None,
                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """→ (last_token_logits [max_seqs, vocab], new_kv).
 
@@ -368,6 +389,11 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
     scan fetches each layer's (possibly quantized) weights from NVMe via
     ``io_callback`` so HBM holds one layer's weights at a time
     (reference: partitioned_param_swapper.py:290 / ZeRO-Inference NVMe).
+    ``comm``: a resolved :class:`~..comm.overlap.ServingComm` plan — the
+    MLP down-projection's all-reduce and/or the unembed's logits gather
+    run tile-decomposed (T3) and optionally quantized (EQuARX) instead
+    of as GSPMD's serial collectives (docs/SERVING.md "Overlapped &
+    quantized collectives").
     """
     if quant is not None:
         from .quantization import merge_layer
@@ -428,7 +454,7 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
         elif cfg.parallel_separate_norms:
             h = norm(lp["ln2"], x)   # gpt-neox: MLP norms the original x
         # parallel residual (falcon/phi): MLP reads the same ln1 output
-        d = _ffn(cfg, lp, h, dt, act)
+        d = _ffn(cfg, lp, h, dt, act, comm=comm)
         if kv_host:
             kv_layer = jax.device_put(kv_layer, jax.memory.Space.Host)
         if cfg.parallel_block:
@@ -453,10 +479,22 @@ def ragged_forward(cfg: TransformerConfig, params, kv, batch: RaggedBatch,
         idx = jnp.maximum(batch.logits_idx, 0)
     last = x[idx]                                            # [S(,W), dm]
     last = norm(params["ln_f"], last)
+    # the unembed is the step's other heavy TP collective: a
+    # vocab-split GEMM whose logits all-gather rides the tile-
+    # decomposed ppermute chain under a comm plan (pure data movement
+    # — bitwise-identical to the serial gather)
     if cfg.tie_embeddings:
-        logits = last @ embed_tab["table"].astype(dt).T
+        wmat = embed_tab["table"].astype(dt).T
+        if comm is not None and comm.unembed:
+            logits = shard_matmul_allgather(last, wmat, comm, dt)
+        else:
+            logits = last @ wmat
     else:
-        logits = last @ params["lm_head"]["kernel"].astype(dt)
+        k = params["lm_head"]["kernel"]
+        if comm is not None and comm.unembed and _dense_weight(k):
+            logits = shard_matmul_allgather(last, k.astype(dt), comm, dt)
+        else:
+            logits = last @ k.astype(dt)
         if cfg.head_bias:
             logits = logits + params["lm_head"]["bias"].astype(dt)
     return logits.astype(jnp.float32), new_kv

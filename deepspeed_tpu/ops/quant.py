@@ -293,14 +293,37 @@ def quantized_all_gather(x: jax.Array, axis_name: str, bits: int = 8,
 
 def quantized_psum_scatter(x: jax.Array, axis_name: str, bits: int = 8,
                            num_groups: Optional[int] = None,
-                           mean: bool = False) -> jax.Array:
+                           mean: bool = False,
+                           pad: bool = False) -> jax.Array:
     """qgZ single-hop: split the local (unreduced) tensor into one chunk
     per rank along dim 0, quantize each, all-to-all, dequantize and reduce
     locally (reference: all_to_all_quant_reduce
     runtime/comm/coalesced_collectives.py + quant_reduce.cu).  Wire bytes:
-    int8/int4 instead of fp32 — 4-8x less reduce traffic."""
+    int8/int4 instead of fp32 — 4-8x less reduce traffic.
+
+    ``pad``: a dim 0 the axis does not divide is zero-filled up to the
+    next multiple of the axis size and the PADDED per-rank shard is
+    returned (callers slice; ``quantized_all_reduce``'s padding path
+    does).  Off, a non-divisible shape asserts — the historical
+    contract, which keeps accidental layout changes loud."""
     n = axis_size(axis_name)
+    if pad and x.shape[0] % n:
+        pad_rows = (-x.shape[0]) % n
+        x = jnp.concatenate(
+            [x, jnp.zeros((pad_rows,) + x.shape[1:], x.dtype)])
     assert x.shape[0] % n == 0, (x.shape, n)
+    if bits == 4:
+        # packed nibbles need an even group size; fold the group count
+        # (keeping it a divisor of the per-destination chunk — the
+        # scale regrouping below depends on that) until it is
+        per_chunk = x.size // n
+        ng = num_groups if num_groups is not None \
+            else default_groups(per_chunk)
+        while ng > 1 and (per_chunk % ng or (per_chunk // ng) % 2):
+            ng -= 1
+        assert (per_chunk // ng) % 2 == 0, \
+            f"int4 quantized scatter needs an even chunk size ({per_chunk})"
+        num_groups = ng
     chunks = x.reshape(n, x.shape[0] // n, *x.shape[1:])
     if num_groups is None:
         # per-destination-chunk grouping at the shared default group size
@@ -342,15 +365,39 @@ def quantized_psum_scatter_dim(x: jax.Array, axis_name: str, dim: int = 0,
 
 
 def quantized_all_reduce(x: jax.Array, axis_name: str,
-                         bits: int = 8) -> jax.Array:
-    """Quantized-wire all-reduce: int reduce-scatter + int all-gather when
-    dim 0 divides the axis, else plain psum (tiny leaves).  2 int8 bytes
-    per element on the wire instead of 4 fp32 (reference: the fallback
-    ``all_to_all_quant_reduce`` path of coalesced_collectives.py for
-    tensors every rank keeps whole)."""
+                         bits: int = 8, pad: bool = False) -> jax.Array:
+    """Quantized-wire all-reduce: int reduce-scatter + int all-gather.
+    2 int8 bytes per element on the wire instead of 4 fp32 (reference:
+    the fallback ``all_to_all_quant_reduce`` path of
+    coalesced_collectives.py for tensors every rank keeps whole).
+
+    A dim 0 the axis does not divide falls back to plain psum by
+    default (the historical qgZ contract: tiny leaves ride the exact
+    wire and training numerics stay put) — with ``pad=True`` it
+    instead runs the padding path: flatten, zero-fill to a multiple of
+    the axis size, quantized reduce, slice back.  The serving
+    activation path (comm/overlap.py) opts into padding so every
+    eligible reduction really rides the quantized wire."""
     n = axis_size(axis_name)
-    if x.ndim == 0 or x.shape[0] % n:
+    if x.ndim == 0 or n == 1:
         return jax.lax.psum(x, axis_name)
+    # shapes the direct scatter cannot take: a dim 0 the axis does not
+    # divide, or (int4 packs two codes per byte) an odd per-rank chunk
+    awkward = x.shape[0] % n or (bits == 4 and (x.size // n) % 2)
+    if awkward:
+        if not pad:
+            return jax.lax.psum(x, axis_name)
+        flat = x.reshape(-1)
+        mult = n * (2 if bits == 4 else 1)
+        fill = (-flat.shape[0]) % mult
+        if fill:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((fill,), flat.dtype)])
+        red = quantized_psum_scatter(flat, axis_name, bits=bits,
+                                     pad=True)
+        out = quantized_all_gather(red, axis_name, bits=bits,
+                                   gather_dim=0)
+        return out[:x.size].reshape(x.shape).astype(x.dtype)
     red = quantized_psum_scatter(x, axis_name, bits=bits)
     return quantized_all_gather(red, axis_name, bits=bits, gather_dim=0)
 

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..comm.comms_logging import comms_logger
 from ..comm.mesh import DATA_AXIS, FSDP_AXIS, MeshTopology
 from ..compat import shard_map
 from ..comm.collectives import init_distributed
@@ -194,6 +195,33 @@ class Engine:
         self._build_shardings(params)
         self._qgz_axes = self._qgz_manual_axes()
         self._sparse_axes = self._sparse_manual_axes(params)
+        # overlapped / quantized grad-sync collectives (comm/overlap.py;
+        # ROADMAP item 1): explicit tile-decomposed reduce-scatter /
+        # all-reduce (optionally on the qgZ int8/int4 wire) over the DP
+        # axes.  qgZ proper (zero_quantized_gradients) and sparse
+        # gradients keep precedence — they already own the manual
+        # region; _manual_reduce_axes carries the PR-1 loud-degradation
+        # contract for meshes that cannot host it.
+        self._comm_axes: Tuple[str, ...] = ()
+        ccfg = config.comm
+        opt_name = config.optimizer.type.lower()
+        onebit_opt = "onebit" in opt_name or "zeroone" in opt_name
+        if (ccfg.overlap or ccfg.quantized_allreduce) \
+                and not self._qgz_axes and not self._sparse_axes:
+            if onebit_opt:
+                # the documented precedence: a 1-bit optimizer's packed
+                # sign+scale reduction with error feedback owns the
+                # wire — silently replacing it with the comm path would
+                # downgrade the compression the optimizer is built
+                # around
+                logger.warning(
+                    "comm.overlap/comm.quantized_allreduce: a 1-bit "
+                    "optimizer (%s) owns the gradient reduction; comm "
+                    "settings ignored", config.optimizer.type)
+            else:
+                self._comm_axes = self._manual_reduce_axes(
+                    "comm.overlap/comm.quantized_allreduce gradient sync")
+        self._comm_wire: Optional[Dict[str, float]] = None
 
         # optimizer + schedule (reference: _configure_basic_optimizer :1322)
         opt_cfg = config.optimizer
@@ -312,6 +340,34 @@ class Engine:
         # telemetry"): always-on host counters — a train-step rebuild
         # after the first is a runtime retrace and warns loudly (the
         # dynamic complement of tpulint's static retrace-hazard rule)
+        # overlapped/quantized grad-sync collectives (docs/SERVING.md
+        # "Overlapped & quantized collectives"): static per-step wire
+        # accounting for the comm.{overlap,quantized_allreduce} path —
+        # quantized ops carry bits/8 of the exact bytes (asserted by
+        # the reconciliation test)
+        self._c_comm_ops = reg.counter(
+            "training_comm_ops_total",
+            "explicit grad-sync collectives dispatched "
+            "(kind: exact|quant)", int_valued=True)
+        self._c_comm_tiles = reg.counter(
+            "training_comm_tiles_total",
+            "tiles across dispatched grad-sync collectives",
+            int_valued=True)
+        self._c_comm_bytes = reg.counter(
+            "training_comm_bytes_total",
+            "modeled bytes on the wire for explicit grad-sync "
+            "collectives (kind: exact|quant)")
+        # eager-collective profiling (comm/comms_logging.py): configure
+        # the module logger from config and mirror its op records into
+        # this registry as training_comm_* counters, so comm time shows
+        # up in Prometheus exposition and flight dumps instead of only
+        # the ad-hoc log_summary() table
+        clcfg = self.config.comms_logger
+        if clcfg.enabled:
+            comms_logger.configure(enabled=True, verbose=clcfg.verbose,
+                                   prof_all=clcfg.prof_all,
+                                   prof_ops=clcfg.prof_ops)
+        comms_logger.attach_registry(reg)
         self._c_compiles = reg.counter(
             "training_compiles_total",
             "training step programs built (jit-cache fills)",
@@ -440,6 +496,8 @@ class Engine:
     def _build_shardings(self, params):
         topo = self.topology
         zero = self.zero
+        self.param_shapes = jax.tree.map(lambda p: tuple(np.shape(p)),
+                                         params)
         self.param_specs = zero.tree_param_specs(self.param_axes, params)
         self.master_specs = zero.tree_master_specs(self.param_axes, params)
         self.grad_specs = zero.tree_grad_specs(self.param_axes, params)
@@ -1083,6 +1141,92 @@ class Engine:
 
         return self._build_manual_grads(gas, manual, reduce_leaf)
 
+    def _build_comm_grads(self, gas: int):
+        """Per-microbatch gradients with tile-decomposed (T3, arxiv
+        2401.16677) and optionally quantized (EQuARX, arxiv 2506.17615)
+        explicit reduction over the DP axes — config ``comm:
+        {overlap, tiles, quantized_allreduce}``.
+
+        Per grad leaf: axes appearing in its grad spec get a tiled
+        reduce-scatter onto the owner shard, axes the leaf replicates
+        over get a tiled all-reduce.  Each tile's collective carries no
+        dependency on the next tile (or the next microbatch's backward
+        GEMMs), so XLA may co-schedule them; the default exact rung is
+        bitwise-identical to the plain reduction (parity-tested), the
+        quantized rung rides the qgZ int8/int4 wire."""
+        from ..comm import overlap as ov
+
+        manual = self._comm_axes
+        ccfg = self.config.comm
+        tiles = ccfg.tiles if ccfg.overlap else 1
+        qbits = {None: None, "int8": 8, "int4": 4}[
+            ccfg.quantized_allreduce]
+        sizes = self.topology.axis_sizes
+
+        def plan(spec, ndim):
+            """(scatter ops [(axis, dim)...] in entry order, leftover
+            all-reduce axes) for one leaf — the same major->minor walk
+            the qgZ reduce_leaf does."""
+            ents = list(spec) + [None] * (ndim - len(list(spec)))
+            scat, seen = [], set()
+            for d, e in enumerate(ents):
+                if e is None:
+                    continue
+                ax = (e,) if isinstance(e, str) else tuple(e)
+                for a in ax:
+                    if a in manual:
+                        scat.append((a, d))
+                        seen.add(a)
+            return scat, tuple(a for a in manual if a not in seen)
+
+        def reduce_leaf(g, spec, axes, batch_tokens):
+            scat, rest = plan(spec, g.ndim)
+            for a, d in scat:
+                g = ov.overlapped_reduce_scatter(
+                    g, a, scatter_dim=d, tiles=tiles, quant_bits=qbits)
+            for a in rest:
+                g = ov.overlapped_all_reduce(g, a, tiles=tiles,
+                                             quant_bits=qbits)
+            return g
+
+        # static wire accounting (host arithmetic mirroring reduce_leaf;
+        # bumped once per train_batch in _finish_step): the shapes and
+        # specs fully determine what one microbatch's grad sync moves
+        isz = jnp.dtype(self.compute_dtype).itemsize
+        wire = {"ops_exact": 0, "ops_quant": 0, "tiles": 0,
+                "bytes_exact": 0.0, "bytes_quant": 0.0}
+        s_flat = jax.tree.leaves(self.grad_specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        shp_flat = jax.tree.leaves(self.param_shapes,
+                                   is_leaf=lambda x: isinstance(x, tuple))
+        for spec, shp in zip(s_flat, shp_flat):
+            scat, rest = plan(spec, len(shp))
+            shape = list(shp)
+            for a, d in scat:
+                n = sizes[a]
+                elems = int(np.prod(shape)) if shape else 1
+                kind = "quant" if qbits else "exact"
+                wire[f"bytes_{kind}"] += ov.wire_bytes(
+                    "reduce_scatter", elems, isz, n, qbits)
+                wire[f"ops_{kind}"] += 1
+                td = ov._rs_tile_dim(tuple(shape), d, tiles)
+                wire["tiles"] += (ov._resolve_tiles(shape[td], tiles)
+                                  if td is not None else 1)
+                shape[d] //= n
+            for a in rest:
+                n = sizes[a]
+                elems = int(np.prod(shape)) if shape else 1
+                kind = "quant" if (qbits and shape) else "exact"
+                wire[f"bytes_{kind}"] += ov.wire_bytes(
+                    "all_reduce", elems, isz, n,
+                    qbits if shape else None)
+                wire[f"ops_{kind}"] += 1
+                wire["tiles"] += (ov._resolve_tiles(shape[0], tiles)
+                                  if shape else 1)
+        self._comm_wire = wire
+
+        return self._build_manual_grads(gas, manual, reduce_leaf)
+
     def _build_sparse_grads(self, gas: int):
         """Per-microbatch gradients with SPARSE reduction of embedding
         grads (reference: runtime/sparse_tensor.py + engine.py:2518
@@ -1332,6 +1476,8 @@ class Engine:
         qgz_grads = self._build_qgz_grads(gas) if self._qgz_axes else None
         if qgz_grads is None and self._sparse_axes:
             qgz_grads = self._build_sparse_grads(gas)
+        if qgz_grads is None and self._comm_axes:
+            qgz_grads = self._build_comm_grads(gas)
         stacked = bool(self._onebit_axes)
         if qgz_grads is None and stacked:
             qgz_grads = self._build_local_grads(gas)
@@ -1725,6 +1871,21 @@ class Engine:
         self.global_steps += 1
         self.global_samples += self.train_batch_size
         self._c_steps.inc()
+        if self._comm_wire is not None:
+            # one bump per train_batch: the gas per-microbatch explicit
+            # reductions of the comm grad path (static accounting —
+            # host arithmetic mirroring _build_comm_grads' reduce plan)
+            w = self._comm_wire
+            gas = self.gas
+            if w["ops_exact"]:
+                self._c_comm_ops.inc(w["ops_exact"] * gas, kind="exact")
+                self._c_comm_bytes.inc(w["bytes_exact"] * gas,
+                                       kind="exact")
+            if w["ops_quant"]:
+                self._c_comm_ops.inc(w["ops_quant"] * gas, kind="quant")
+                self._c_comm_bytes.inc(w["bytes_quant"] * gas,
+                                       kind="quant")
+            self._c_comm_tiles.inc(w["tiles"] * gas)
         if self._cap is not None and self._cap.active:
             self._cap.end_step(step=self.global_steps)
         # metrics stay on device — a host fetch every step would stall the

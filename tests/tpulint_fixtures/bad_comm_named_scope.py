@@ -1,0 +1,30 @@
+"""Known-bad: comm helpers whose collectives carry no jax.named_scope
+label (tpulint: comm-named-scope — tracemerge's device tracks, and the
+T3 overlap measurement bar, are built from these labels)."""
+import jax
+from jax import lax
+
+
+def tile_reduce(p):
+    return lax.psum(p, "data")              # BAD: unlabeled all-reduce
+
+
+def ring_hop(x, perm):
+    return lax.ppermute(x, "data", perm)    # BAD: unlabeled ring hop
+
+
+def grad_scatter(g):
+    return lax.psum_scatter(                # BAD: unlabeled reduce-scatter
+        g, "data", scatter_dimension=0, tiled=True)
+
+
+def gather_logits(x):
+    # labeling only the GEMM does not cover a comm helper defined
+    # elsewhere — the gather below runs with no label in ITS chain
+    with jax.named_scope("unembed_gemm"):
+        y = x * 2.0
+    return _unlabeled_gather(y)
+
+
+def _unlabeled_gather(v):
+    return lax.all_gather(v, "tensor", axis=0, tiled=True)  # BAD

@@ -1,0 +1,36 @@
+"""Known-good twin of bad_comm_named_scope (no findings): every
+collective stage carries a jax.named_scope label, directly or through
+its enclosing helper."""
+import jax
+from jax import lax
+
+
+def tile_reduce(p):
+    with jax.named_scope("t3_comm_t0_ar"):
+        return lax.psum(p, "data")
+
+
+def ring_hop(x, perm):
+    with jax.named_scope("ring_ag_hop0"):
+        return lax.ppermute(x, "data", perm)
+
+
+def grad_scatter(g):
+    with jax.named_scope("t3_rs_t0"):
+        return lax.psum_scatter(g, "data", scatter_dimension=0,
+                                tiled=True)
+
+
+def ring_chain(x, perm):
+    # a label on the enclosing helper covers its hops: the chain
+    # renders as one named track with per-hop ops under it
+    with jax.named_scope("ring_reduce"):
+        acc = x
+        for _ in range(3):
+            acc = acc + lax.ppermute(acc, "data", perm)
+        return acc
+
+
+def rank():
+    # axis queries move no data; no label required
+    return lax.axis_index("data")

@@ -626,6 +626,73 @@ def check_axis_name(ctx: FileContext) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
+# rule: comm-named-scope — comm/ collective helpers must label their stages
+# --------------------------------------------------------------------------
+
+# the data-moving collectives (axis_index/axis_size are queries, not comm)
+_SCOPED_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "psum_scatter",
+                       "all_gather", "all_to_all", "ppermute", "pshuffle",
+                       "pbroadcast"}
+
+
+def _is_comm_module(path: str) -> bool:
+    """Files of the comm package (any path segment ``comm``) or
+    modules with ``comm`` as a whole underscore-separated word in the
+    stem — how the ``bad_/good_comm_named_scope`` fixture pair opts in
+    without sweeping ``common.py``/``recommend.py``-style names."""
+    import pathlib
+    p = pathlib.PurePath(path)
+    return "comm" in p.parts or "comm" in p.stem.split("_")
+
+
+def _scope_chain_has_named_scope(node: ast.AST, enc) -> bool:
+    """Whether any enclosing function of ``node`` contains a
+    ``named_scope`` call (``with jax.named_scope(...)`` parses as a
+    Call inside the With item, so one walk covers both forms)."""
+    fn = enc.get(id(node))
+    while fn is not None:
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call):
+                d = dotted(sub.func) or ""
+                if d.split(".")[-1] == "named_scope":
+                    return True
+        fn = enc.get(id(fn))
+    return False
+
+
+@rule("comm-named-scope",
+      "collective calls in comm/ helpers must run under a "
+      "jax.named_scope label — tracemerge's device tracks (and the "
+      "T3 overlap measurement bar) are built from these",
+      library_only=True)
+def check_comm_named_scope(ctx: FileContext) -> Iterator[Finding]:
+    if not _is_comm_module(ctx.path):
+        return
+    if not any(c in ctx.source for c in _SCOPED_COLLECTIVES):
+        return
+    enc = _enclosing_map(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d is None:
+            continue
+        prefix, _, last = d.rpartition(".")
+        if last not in _SCOPED_COLLECTIVES \
+                or prefix not in _COLLECTIVE_PREFIXES:
+            continue
+        if not _scope_chain_has_named_scope(node, enc):
+            yield Finding(
+                "comm-named-scope", ctx.path, node.lineno,
+                node.col_offset,
+                f"{last}() in a comm/ helper without a jax.named_scope "
+                "label anywhere in its enclosing function — unlabeled "
+                "collectives render as anonymous device slices in "
+                "merged timelines (wrap the stage in "
+                "`with jax.named_scope(...)`)")
+
+
+# --------------------------------------------------------------------------
 # rule: silent-except — swallowed exceptions in fallback paths
 # --------------------------------------------------------------------------
 

@@ -39,7 +39,7 @@ import glob
 import gzip
 import json
 import os
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 # --------------------------------------------------------------------------
 # minimal protobuf wire-format reader (just enough for XSpace)
@@ -190,6 +190,113 @@ def xplane_chrome_events(path: str, t_session_epoch_ns: int,
 
 
 # --------------------------------------------------------------------------
+# HLO op-name harvesting (named_scope labels)
+# --------------------------------------------------------------------------
+
+def _try_str(v: bytes) -> Optional[str]:
+    try:
+        s = v.decode("utf-8")
+    except Exception:  # tpulint: disable=silent-except — utf-8 probe: most length-delimited fields are submessages, not strings
+        return None
+    return s if s and s.isprintable() else None
+
+
+def hlo_op_name_map(xplane_path: str) -> Dict[str, Tuple[str, ...]]:
+    """instruction name -> every ``metadata.op_name`` seen for it (the
+    ``jax.named_scope`` paths, e.g. ``jit(f)/.../t3_mm_ar_comm_t0_ar/
+    psum``), harvested from the HLO protos the profiler embeds in the
+    xplane's metadata plane.
+
+    The device timeline names events by bare HLO instruction
+    (``all-reduce.4``) — the scope labels live only in each
+    instruction's OpMetadata.  We walk the nested protobuf generically:
+    any submessage whose field 1 is a printable string and whose
+    field 7 (OpMetadata) carries a '/'-scoped field-2 string is an
+    instruction/name pair.  Bare instruction names COLLIDE across
+    modules (every program compiled in the process embeds metadata, and
+    ``all-reduce.4`` of one module is unrelated to another's), and the
+    timeline events carry no module identity to disambiguate by — so
+    ALL distinct op_names per instruction are kept, in walk order, and
+    the annotation surfaces every candidate rather than letting
+    whichever module was walked first shadow the rest."""
+    with open(xplane_path, "rb") as f:
+        buf = f.read()
+    out: Dict[str, Tuple[str, ...]] = {}
+
+    def walk(b: bytes, depth: int) -> None:
+        if depth > 12:
+            return
+        try:
+            fs = list(_fields(b))
+        except Exception:  # tpulint: disable=silent-except — wire probe: string payloads misparse as submessages by design
+            return
+        name = op = None
+        for fno, wt, v in fs:
+            if wt != 2:
+                continue
+            s = _try_str(v)
+            if s is not None:
+                if fno == 1 and name is None:
+                    name = s
+                continue
+            if fno == 7:
+                try:
+                    for f2, w2, v2 in _fields(v):
+                        if f2 == 2 and w2 == 2:
+                            s2 = _try_str(v2)
+                            if s2 and "/" in s2:
+                                op = s2
+                except Exception:  # tpulint: disable=silent-except — wire probe: field 7 need not be OpMetadata everywhere
+                    pass
+            walk(v, depth + 1)
+        if name and op:
+            have = out.get(name, ())
+            if op not in have:
+                out[name] = have + (op,)
+
+    # walk ONLY each plane's event_metadata table (field 4) — the HLO
+    # protos live there; the event lines (field 3) are the bulk of a
+    # real capture's bytes and contain no names worth harvesting
+    try:
+        for fno, _, plane in _fields(buf):
+            if fno != 1:
+                continue
+            for f2, w2, v2 in _fields(plane):
+                if f2 == 4 and w2 == 2:
+                    walk(v2, 0)
+    except Exception as e:
+        # a corrupt/truncated xplane (or a layout change in a new
+        # jaxlib) must say so — a silent empty map would later surface
+        # as a misleading "no device event carries scope" violation
+        print(f"tracemerge: xplane op-name harvest failed on "  # tpulint: disable=print — CLI/loud-degradation output
+              f"{xplane_path}: {type(e).__name__}: {e}; merged "
+              "timeline will lack scoped op_name annotations")
+    return out
+
+
+def annotate_op_names(events: List[Dict[str, Any]],
+                      op_names: Dict[str, Tuple[str, ...]]) -> int:
+    """Attach ``args.op_name`` to duration events whose bare
+    instruction name is in the map; returns how many were annotated.
+    Cross-module name collisions surface EVERY candidate (joined with
+    `` | ``) — the window genuinely executed an instruction of that
+    name, and hiding all but one module's scope made the timeline (and
+    ``validate_merged_trace``'s scope check) depend on protobuf walk
+    order."""
+    n = 0
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("ph") != "X":
+            continue
+        scoped = op_names.get(ev.get("name", ""))
+        if scoped:
+            args = ev.setdefault("args", {})
+            if isinstance(args, dict):
+                args["op_name"] = " | ".join(scoped)
+                n += 1
+    return n
+
+
+# --------------------------------------------------------------------------
 # device-artifact loading
 # --------------------------------------------------------------------------
 
@@ -197,18 +304,36 @@ def load_device_events(device_dir: str,
                        t_session_epoch_ns: int) -> List[Dict[str, Any]]:
     """Chrome events (session-relative µs) from a jax profiler log dir:
     prefers the ``trace.json.gz`` the profiler already renders, falls
-    back to decoding ``xplane.pb`` directly."""
+    back to decoding ``xplane.pb`` directly.  Either way, events whose
+    instruction appears in the xplane's HLO metadata gain an
+    ``args.op_name`` with the full ``jax.named_scope`` path — the T3
+    tile-comm scopes are only visible through it on backends (XLA:CPU)
+    whose timeline names events by bare instruction."""
+    pbs = sorted(glob.glob(os.path.join(device_dir, "**", "*.xplane.pb"),
+                           recursive=True))
     gz = sorted(glob.glob(os.path.join(device_dir, "**",
                                        "*.trace.json.gz"),
                           recursive=True))
+    events: List[Dict[str, Any]] = []
     if gz:
         with gzip.open(gz[-1], "rt") as f:
-            return json.load(f).get("traceEvents", [])
-    pbs = sorted(glob.glob(os.path.join(device_dir, "**", "*.xplane.pb"),
-                           recursive=True))
-    if pbs:
-        return xplane_chrome_events(pbs[-1], t_session_epoch_ns)
-    return []
+            events = json.load(f).get("traceEvents", [])
+    elif pbs:
+        events = xplane_chrome_events(pbs[-1], t_session_epoch_ns)
+    if events and pbs:
+        # TPU-style traces already name events by scoped op path; only
+        # harvest the xplane when the timeline carries bare instruction
+        # names (XLA:CPU) — the protobuf walk is not free
+        def scoped(e):
+            n = e.get("name", "")
+            # "$"-prefixed names are the host Python tracer's
+            # file-path frames, not XLA op paths
+            return "/" in n and not n.startswith("$")
+
+        if not any(isinstance(e, dict) and e.get("ph") == "X"
+                   and scoped(e) for e in events):
+            annotate_op_names(events, hlo_op_name_map(pbs[-1]))
+    return events
 
 
 # --------------------------------------------------------------------------
@@ -281,13 +406,16 @@ def merge_capture(capture_dir: str,
 
 
 def validate_merged_trace(obj: Dict[str, Any],
-                          require_device: bool = True) -> List[str]:
+                          require_device: bool = True,
+                          require_scopes: Sequence[str] = ()) -> List[str]:
     """Schema check for a merged timeline: returns violations (empty
     when valid).  Valid means Chrome-trace-shaped (``traceEvents`` list
     of dicts with ``ph``), containing at least one host SpanTracer
     track (pid 1 thread_name metadata) and — unless ``require_device``
     is off — at least one device-derived duration event (pid >=
-    10000)."""
+    10000).  ``require_scopes``: substrings that must each match some
+    device event's name or scoped ``args.op_name`` — how a test pins
+    the T3 tile-comm scopes to actual device activity."""
     problems: List[str] = []
     evs = obj.get("traceEvents")
     if not isinstance(evs, list) or not evs:
@@ -311,6 +439,14 @@ def validate_merged_trace(obj: Dict[str, Any],
            and e.get("ph") == "X"]
     if require_device and not dev:
         problems.append("no device-derived events (pid >= 10000)")
+    for scope in require_scopes:
+        if not any(scope in e.get("name", "")
+                   or (isinstance(e.get("args"), dict)
+                       and scope in e["args"].get("op_name", ""))
+                   for e in dev):
+            problems.append(
+                f"no device event carries scope {scope!r} (name or "
+                "args.op_name)")
     return problems
 
 
