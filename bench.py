@@ -245,6 +245,7 @@ def main(trace_path=None, profile_dir=None):
     spec = leg(spec_decode_serving_bench, on_tpu)
     overload = leg(overload_serving_bench, on_tpu)
     chaos = leg(chaos_serving_bench, on_tpu)
+    fleet = leg(fleet_serving_bench, on_tpu)
     llama_train = leg(llama_train_bench, on_tpu, peak)
     llama_serve = leg(llama8b_serving_bench, on_tpu)
     moe = leg(moe_train_bench, on_tpu, peak)
@@ -268,8 +269,8 @@ def main(trace_path=None, profile_dir=None):
     }
     out.update(serve)
     print(json.dumps({**out, **pipe, **prefix, **spec, **overload,  # tpulint: disable=print — the bench's one JSON output line
-                      **chaos, **llama_train, **llama_serve, **moe,
-                      **comm}))
+                      **chaos, **fleet, **llama_train, **llama_serve,
+                      **moe, **comm}))
 
 
 def bench_fingerprint():
@@ -353,6 +354,32 @@ def chaos_serving_bench(on_tpu: bool):
         "ok": out["ok"],
         "variants": out["variants"],
     }}
+
+
+def fleet_serving_bench(on_tpu: bool):
+    """Replica-fleet leg (docs/SERVING.md "Fleet: routing, failover,
+    migration"): the loadgen fleet sweep — one shared-prefix workload
+    through 1 replica, then a 3-replica fleet under cache-affinity
+    placement with a mid-sweep replica KILL, then the same fleet under
+    round-robin (the affinity bar's baseline).  The headline metrics
+    land top-level so ``tools/benchdiff.py``'s existing direction
+    rules gate them: ``*_goodput_tok_s`` / ``*_hit_rate`` up-is-better,
+    ``*_ttft_*_ms`` down-is-better.  The affinity acceptance bar —
+    cache-affinity placement beats round-robin's measured prefix hit
+    rate on this workload — is asserted by tests/test_router.py; the
+    JSON records the margin."""
+    from tools.loadgen import fleet_bench
+
+    out = fleet_bench(seed=0)
+    return {"fleet_serving": out,
+            "fleet_goodput_tok_s": out["affinity"]["goodput_tok_s"],
+            "fleet_single_goodput_tok_s": out["single"]["goodput_tok_s"],
+            "fleet_affinity_hit_rate": out["affinity"]["hit_rate"],
+            "fleet_round_robin_hit_rate": out["round_robin"]["hit_rate"],
+            "fleet_ttft_p95_prekill_ms":
+                out["affinity"]["ttft_p95_prekill_ms"],
+            "fleet_ttft_p95_postkill_ms":
+                out["affinity"]["ttft_p95_postkill_ms"]}
 
 
 def moe_train_bench(on_tpu: bool, peak: float):

@@ -2262,16 +2262,12 @@ class InferenceEngine:
             self.flight.dump(path, reason, snap=snap)
         return snap
 
-    def health(self) -> Dict:
-        """Engine health for the router's liveness probe
-        (docs/OBSERVABILITY.md): ``state`` walks
-        ``healthy -> degraded -> (draining | dead)`` — ``degraded``
-        while the most recent step failure is within
-        ``FailureConfig.health_window_steps`` dispatched steps
-        (failure *rates* from the metrics registry drive it, not a
-        latched flag), ``draining``/``dead`` sticky.  Also exported as
-        the ``serving_health_state`` gauge (0/1/2/3) through the
-        Prometheus exposition."""
+    def health_state(self) -> str:
+        """The health-ladder state ALONE — no gauge write, no memory
+        poll: the cheap form a fleet router may read every step for
+        its per-replica gauges.  :meth:`health` is the phase-boundary
+        probe that additionally refreshes gauges and polls device
+        memory."""
         state = self._health
         if state == "healthy" and self._steps_done \
                 - self._last_failure_step <= self.fcfg.health_window_steps:
@@ -2282,6 +2278,19 @@ class InferenceEngine:
             # not failing, but it is not behaving either — the router
             # should prefer another replica while this one is probed
             state = "degraded"
+        return state
+
+    def health(self) -> Dict:
+        """Engine health for the router's liveness probe
+        (docs/OBSERVABILITY.md): ``state`` walks
+        ``healthy -> degraded -> (draining | dead)`` — ``degraded``
+        while the most recent step failure is within
+        ``FailureConfig.health_window_steps`` dispatched steps
+        (failure *rates* from the metrics registry drive it, not a
+        latched flag), ``draining``/``dead`` sticky.  Also exported as
+        the ``serving_health_state`` gauge (0/1/2/3) through the
+        Prometheus exposition."""
+        state = self.health_state()
         self._health_gauge.set(
             {"healthy": 0, "degraded": 1, "draining": 2,
              "dead": 3}[state])
@@ -2307,6 +2316,56 @@ class InferenceEngine:
             "captures": len(self.capture_dirs),
         }
 
+    # every snapshot this engine emits or restores carries this schema
+    # version.  v2 (PR 13): per-request extraction (`snapshot_requests`)
+    # and merge-restore (`load_snapshot(..., merge=True)`) — the
+    # record shape is unchanged, but v1 consumers assumed a snapshot
+    # was always the WHOLE engine restored onto a FRESH one, so
+    # partial/merging payloads must be rejected by v1 engines (and
+    # vice versa) rather than silently half-applied
+    SNAPSHOT_VERSION = 2
+
+    def _open_uids(self) -> List[int]:
+        """Every uid with open work on this engine (admitted metadata,
+        queued tokens, or a live sequence), in stable admission-ish
+        order — the domain of :meth:`snapshot` / :meth:`snapshot_requests`."""
+        return list(dict.fromkeys(list(self._meta) + list(self._pending)
+                                  + list(self.state.seqs)))
+
+    def _request_record(self, uid: int, now: float) -> Dict:
+        """One open request's restore()-compatible record: the
+        replayable token stream (KV chain + still-pending tokens),
+        generated output so far, and admission metadata — the unit of
+        currency snapshots, drains, and fleet migrations all move.  A
+        stream the host cannot replay (broken chain — decode bursts,
+        an in-flight feedback marker) is recorded ``exact: False``."""
+        seq = self.state.seqs.get(uid)
+        pend = [int(t) for t in self._pending.get(uid, [])]
+        gen = list(self._preempt_gen.get(uid, []))
+        exact = FEEDBACK_TOKEN not in pend
+        stream = pend
+        if seq is not None:
+            exact = exact and seq.resumable
+            stream = [int(t) for t in seq.chain] \
+                + [t for t in pend if t != FEEDBACK_TOKEN]
+            gen += [int(t) for t in seq.tokens]
+        m = self._meta.get(uid)
+        remaining = None
+        if m is not None and m.deadline_ms is not None:
+            remaining = max(
+                0.0, m.deadline_ms - (now - m.t_arrival) * 1e3)
+        rec = self.requests.open.get(uid)
+        return {
+            "uid": int(uid),
+            "tokens": stream if exact else None,
+            "generated": gen,
+            "priority": int(m.priority) if m else 0,
+            "deadline_ms": remaining,
+            "preemptions": rec.preemptions if rec else 0,
+            "retries": rec.retries if rec else 0,
+            "exact": exact,
+        }
+
     def snapshot(self) -> Dict:
         """Serialize the engine's host-side truth — every open
         request's replayable token stream (KV chain + still-pending
@@ -2329,39 +2388,11 @@ class InferenceEngine:
         decode bursts, an in-flight feedback marker) is recorded
         ``exact: False`` and closed ``failed`` at restore."""
         from .. import __version__
-        reqs = []
-        order = dict.fromkeys(list(self._meta) + list(self._pending)
-                              + list(self.state.seqs))
         now = time.perf_counter()
-        for uid in order:
-            seq = self.state.seqs.get(uid)
-            pend = [int(t) for t in self._pending.get(uid, [])]
-            gen = list(self._preempt_gen.get(uid, []))
-            exact = FEEDBACK_TOKEN not in pend
-            stream = pend
-            if seq is not None:
-                exact = exact and seq.resumable
-                stream = [int(t) for t in seq.chain] \
-                    + [t for t in pend if t != FEEDBACK_TOKEN]
-                gen += [int(t) for t in seq.tokens]
-            m = self._meta.get(uid)
-            remaining = None
-            if m is not None and m.deadline_ms is not None:
-                remaining = max(
-                    0.0, m.deadline_ms - (now - m.t_arrival) * 1e3)
-            rec = self.requests.open.get(uid)
-            reqs.append({
-                "uid": int(uid),
-                "tokens": stream if exact else None,
-                "generated": gen,
-                "priority": int(m.priority) if m else 0,
-                "deadline_ms": remaining,
-                "preemptions": rec.preemptions if rec else 0,
-                "retries": rec.retries if rec else 0,
-                "exact": exact,
-            })
+        reqs = [self._request_record(uid, now)
+                for uid in self._open_uids()]
         return {
-            "version": 1,
+            "version": self.SNAPSHOT_VERSION,
             "engine_version": __version__,
             "health": self.health()["state"],
             "counters": {k: self.timings[k]
@@ -2371,11 +2402,54 @@ class InferenceEngine:
             "requests": reqs,
             # content digests of the resident prefix-cache index: the
             # cache-affinity routing key (ROADMAP item 5), not KV
-            "prefix_index": sorted(
-                h.hex() for h in self.state._hash_index),
+            "prefix_index": sorted(self.state.prefix_digests()),
         }
 
-    def load_snapshot(self, snap: Dict) -> None:
+    def snapshot_requests(self, uids: Sequence[int]) -> Dict:
+        """Extract restore()-compatible records for a SUBSET of this
+        engine's open requests — the fleet router's migration payload
+        (move some open work to another replica without touching the
+        rest).  Same schema/version as :meth:`snapshot`, marked
+        ``"partial": True``; uids with no open work here are skipped
+        (the caller may be racing a terminal close).  Extraction does
+        NOT close the requests — :meth:`migrate_out` is the
+        extract-and-close composition."""
+        from .. import __version__
+        now = time.perf_counter()
+        known = set(self._open_uids())
+        wanted = dict.fromkeys(int(u) for u in uids)   # dedup, ordered
+        return {
+            "version": self.SNAPSHOT_VERSION,
+            "engine_version": __version__,
+            "partial": True,
+            "requests": [self._request_record(u, now)
+                         for u in wanted if u in known],
+        }
+
+    def migrate_out(self, uids: Sequence[int]) -> Dict:
+        """Live-migration extraction (docs/SERVING.md "Fleet: routing,
+        failover, migration"): :meth:`snapshot_requests` the given open
+        requests, then terminally close them on THIS engine with
+        status ``migrated`` (their KV releases back through the
+        refcounted allocator; the lifecycle record closes — the
+        request lives on wherever the returned records are
+        ``load_snapshot(..., merge=True)``-ed).  Requests a move would
+        DESTROY are skipped, not extracted: a dispatched-but-
+        uncollected step (its KV rows are still being written) and a
+        non-replayable stream (broken chain — the destination could
+        only close it ``failed``, killing a healthy request); both
+        stay in place, retry at a later step boundary."""
+        eligible = [int(u) for u in uids
+                    if not self._inflight_sched.get(int(u), 0)]
+        part = self.snapshot_requests(eligible)
+        part["requests"] = [rec for rec in part["requests"]
+                            if rec["exact"] and rec["tokens"]]
+        for rec in part["requests"]:
+            self._finish(rec["uid"], "migrated")
+            self._reaped.add(rec["uid"])
+        return part
+
+    def load_snapshot(self, snap: Dict, merge: bool = False) -> None:
         """Re-open a snapshot's requests on THIS engine (the restore
         half of the warm restart — :meth:`restore` wraps construction +
         this).  Admission bounds are bypassed: restored work was
@@ -2384,11 +2458,42 @@ class InferenceEngine:
         re-prefills them, through the prefix cache when their blocks
         re-register), prior generated tokens keep ``query()`` output
         complete, and inexact records (device-side tokens lost with
-        the old engine) close terminally as ``failed``."""
-        if snap.get("version") != 1:
+        the old engine) close terminally as ``failed``.
+
+        By default the engine must be FRESH (no open work) — the warm-
+        restart contract.  ``merge=True`` is the fleet-migration mode:
+        records join a replica that is already serving (a uid already
+        open here raises — one request must never run twice)."""
+        if snap.get("version") != self.SNAPSHOT_VERSION:
             raise ValueError(
                 f"snapshot version {snap.get('version')!r}: this engine "
-                "restores version 1")
+                f"restores version {self.SNAPSHOT_VERSION}")
+        open_now = set(self._open_uids()) | set(self.requests.open)
+        if not merge and open_now:
+            raise ValueError(
+                f"load_snapshot onto an engine with {len(open_now)} open "
+                "request(s): restore assumes a fresh engine — pass "
+                "merge=True to migrate records into live traffic")
+        # validate the WHOLE payload before applying any record: a
+        # rejection must leave the engine untouched, or the caller's
+        # retry-on-another-replica re-places the half-applied records
+        # and double-runs them — the exact hazard this guard exists for
+        incoming = [int(rec["uid"]) for rec in snap["requests"]]
+        seen: set = set()
+        dupes: set = set()
+        for u in incoming:
+            (dupes if u in seen else seen).add(u)
+        if dupes:
+            raise ValueError(
+                f"snapshot payload repeats uid(s) {sorted(dupes)}: a "
+                "request must never be applied twice")
+        if merge:
+            clash = open_now & set(incoming)
+            if clash:
+                raise ValueError(
+                    f"load_snapshot(merge=True): uid(s) {sorted(clash)} "
+                    "already open on this engine — a request must never "
+                    "run on two replicas at once")
         now = time.perf_counter()
         tm = self.timings
         for rec in snap["requests"]:
@@ -2452,8 +2557,17 @@ class InferenceEngine:
         still open as ``shed`` — exactly-one-terminal-status holds
         through a drain like every other exit path.  The snapshot is
         the hand-off: restore it onto the replacement replica and the
-        undone work resumes token-identically."""
+        undone work resumes token-identically.
+
+        The returned snapshot additionally reports the drain's outcome
+        split: ``shed_uids`` — requests closed ``shed`` by the drain
+        (their records are in ``requests``; the router re-places
+        exactly these on surviving replicas) — and ``completed_uids``
+        — requests that reached some OTHER terminal status during the
+        drain (deadline expiry, context exhaustion, a failure close):
+        already settled, so re-placing them would double-run them."""
         self._draining = True
+        open_at_start = set(self._open_uids()) | set(self.requests.open)
         if self._health != "dead":
             self._health = "draining"
             self._health_gauge.set(2)
@@ -2484,11 +2598,15 @@ class InferenceEngine:
         # window closes with what it has (never strands the session)
         self.finish_capture()
         snap = self.snapshot()
-        for uid in list(dict.fromkeys(list(self._pending)
-                                      + list(self.state.seqs)
-                                      + list(self._meta))):
+        shed: List[int] = []
+        for uid in self._open_uids():
             self._finish(uid, "shed")
             self._reaped.add(uid)
+            shed.append(int(uid))
+        shed_set = set(shed)
+        snap["shed_uids"] = sorted(shed_set)
+        snap["completed_uids"] = sorted(
+            int(u) for u in open_at_start if u not in shed_set)
         return snap
 
     def step(self, rng: Optional[jax.Array] = None,

@@ -124,11 +124,17 @@ class AdmissionVerdict(NamedTuple):
     ``degraded`` (accepted at the background tier), or ``shed``.
     ``evicted_uids``: queued requests shed to make room under the
     ``evict-lowest`` policy (several, when the token bound needs more
-    than one eviction to hold)."""
+    than one eviction to hold).  ``replica``: which fleet replica
+    admitted the request when the verdict came through a
+    :class:`~deepspeed_tpu.serving.FleetRouter` (None from a bare
+    engine; a router-level shed with ``replica=None`` is the
+    fleet-saturated 429-equivalent — every routable replica's own
+    bound rejected it)."""
     admitted: bool
     status: str
     reason: str = ""
     evicted_uids: Tuple[int, ...] = ()
+    replica: Optional[str] = None
 
     def __bool__(self) -> bool:          # `if eng.put(...):` reads right
         return self.admitted

@@ -49,6 +49,38 @@ def chain_hash(parent: bytes, tokens) -> bytes:
     return hashlib.blake2b(parent + toks, digest_size=16).digest()
 
 
+def iter_prefix_chain_digests(tokens, block_size: int,
+                              max_blocks: Optional[int] = None):
+    """Lazily yield the chain digest of each FULL block-aligned prefix
+    of ``tokens`` — a GENERATOR so consumers that stop at the first
+    index miss (``match_prefix`` on a cache-miss admission) hash one
+    block, not the whole prompt."""
+    n = len(tokens) // block_size
+    if max_blocks is not None:
+        n = min(n, max_blocks)
+    parent = _CHAIN_ROOT
+    for k in range(n):
+        parent = chain_hash(parent, tokens[k * block_size:
+                                           (k + 1) * block_size])
+        yield parent
+
+
+def prefix_chain_digests(tokens, block_size: int,
+                         max_blocks: Optional[int] = None) -> List[bytes]:
+    """Chain digests of every FULL block-aligned prefix of ``tokens`` —
+    the engine-independent form of the prefix-cache key.  Entry ``k`` is
+    the digest a :class:`StateManager` index holds iff the first
+    ``(k+1) * block_size`` tokens of this stream are resident, so a
+    fleet router can score cache affinity for a prompt against any
+    replica's digest set without touching that replica's engine
+    (docs/SERVING.md "Fleet: routing, failover, migration").
+    ``match_prefix`` consumes the same digests (lazily, via
+    :func:`iter_prefix_chain_digests`), so router-side scoring and
+    engine-side matching can never disagree on the key."""
+    return list(iter_prefix_chain_digests(tokens, block_size,
+                                          max_blocks))
+
+
 @dataclasses.dataclass
 class KVCacheConfig:
     num_layers: int
@@ -340,12 +372,13 @@ class StateManager:
             return 0
         if max_pool_take is None:
             max_pool_take = self.allocator.free_blocks
-        parent = _CHAIN_ROOT
         hashes: List[bytes] = []
         blocks: List[int] = []
         takes = 0
-        for k in range(min(len(tokens) // bs, self.max_blocks_per_seq)):
-            h = chain_hash(parent, tokens[k * bs:(k + 1) * bs])
+        # lazy digests: a cache-miss admission hashes ONE block and
+        # stops, instead of pre-hashing the whole prompt
+        for h in iter_prefix_chain_digests(tokens, bs,
+                                           self.max_blocks_per_seq):
             b = self._hash_index.get(h)
             if b is None:
                 break
@@ -355,7 +388,6 @@ class StateManager:
             takes += t
             hashes.append(h)
             blocks.append(b)
-            parent = h
         if not blocks:
             return 0
         for b in blocks:
@@ -430,6 +462,13 @@ class StateManager:
         self._block_hash.clear()
         self._hash_index.clear()
         self.cow_pending.clear()
+
+    def prefix_digests(self) -> frozenset:
+        """Hex digests resident in the prefix-cache index right now —
+        the router's live cache-affinity key.  The same set
+        ``engine.snapshot()["prefix_index"]`` freezes at snapshot time;
+        score a prompt against it with :func:`prefix_chain_digests`."""
+        return frozenset(h.hex() for h in self._hash_index)
 
     def pool_stats(self) -> Dict[str, int]:
         """Allocator-truth pool occupancy — the numbers the engine's

@@ -1,0 +1,19 @@
+"""Fleet serving: a multi-replica front-end over hardened inference
+engines (docs/SERVING.md "Fleet: routing, failover, migration").
+
+The scheduler/engine boundary split makes every placement and
+migration decision portable: prompts and replicas share one
+engine-independent affinity key (`placement.prompt_digests` vs
+``StateManager.prefix_digests()``), and open work moves between
+replicas as restore()-compatible per-request records
+(``engine.snapshot_requests`` / ``migrate_out`` /
+``load_snapshot(merge=True)``)."""
+
+from .placement import (PLACEMENT_POLICIES, affinity_chain_len,
+                        prompt_digests, rank_replicas)
+from .replica import CircuitBreaker, ReplicaHandle
+from .router import FleetConfig, FleetRouter
+
+__all__ = ["FleetConfig", "FleetRouter", "ReplicaHandle",
+           "CircuitBreaker", "PLACEMENT_POLICIES", "prompt_digests",
+           "affinity_chain_len", "rank_replicas"]

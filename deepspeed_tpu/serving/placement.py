@@ -1,0 +1,102 @@
+"""Placement policy for the multi-replica serving front-end
+(docs/SERVING.md "Fleet: routing, failover, migration").
+
+Pure host-side scoring over engine-independent keys — the scheduler/
+engine boundary split made both sides of a placement decision portable:
+
+* the **prompt side** is :func:`prompt_digests` — the rolling chain
+  digests of the prompt's full block-aligned prefixes
+  (``ragged.state.prefix_chain_digests``, the SAME function
+  ``match_prefix`` consumes, so router-side scoring and engine-side
+  matching can never disagree on the key);
+* the **replica side** is a digest set — ``StateManager.
+  prefix_digests()`` live, or ``engine.snapshot()["prefix_index"]``
+  from a replica's last snapshot.
+
+``affinity_chain_len`` is deliberately a *leading-run* match, not a set
+intersection: the engine can only alias a cached prefix whose every
+ancestor block is resident (``match_prefix`` stops at the first miss),
+so a mid-stream hit is worth nothing to prefill and must score nothing
+to placement.
+
+Everything here is pure functions over small sequences — no device
+work, no engine references — so the router, the load harness, and the
+tests all score placements the same way.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..inference.ragged.state import prefix_chain_digests
+
+PLACEMENT_POLICIES = ("affinity", "least_loaded", "round_robin")
+
+
+def prompt_digests(tokens: Sequence[int], block_size: int,
+                   max_blocks: Optional[int] = None) -> List[str]:
+    """Hex chain digests of the prompt's full block-aligned prefixes —
+    directly comparable against a replica's
+    ``StateManager.prefix_digests()`` or its snapshot's
+    ``prefix_index`` list."""
+    return [h.hex() for h in prefix_chain_digests(tokens, block_size,
+                                                  max_blocks)]
+
+
+def affinity_chain_len(digests: Sequence, index) -> int:
+    """Longest cached-chain match: the number of LEADING prompt digests
+    present in ``index`` (any container supporting ``in`` — the router
+    scores bytes digests against a replica's live index dict; hex
+    digests score against a snapshot's ``prefix_index`` list.  Both
+    sides must use the same encoding).  The run stops at the first
+    miss — blocks past a gap are unreachable to ``match_prefix`` and
+    score nothing."""
+    n = 0
+    for h in digests:
+        if h not in index:
+            break
+        n += 1
+    return n
+
+
+def rank_replicas(policy: str, digests: Sequence,
+                  candidates: Sequence[Tuple[str, object, int]],
+                  rr_offset: int = 0,
+                  scores: Optional[Dict[str, int]] = None,
+                  ) -> Tuple[List[str], Dict[str, int]]:
+    """Order candidate replicas best-first for one placement.
+
+    ``candidates``: ``(name, digest_index, load)`` per routable replica
+    — ``digest_index`` is the replica's resident prefix-digest set,
+    ``load`` its live+queued request count (an int, so ordering is
+    exact and deterministic).  Returns ``(ordered_names, scores)`` with
+    ``scores[name]`` the affinity chain length (computed for every
+    policy — it is the placement-hit telemetry even when the policy
+    ignores it).  Callers scoring many candidates against one prompt
+    may pass precomputed ``scores`` (the router's lazy shared-stream
+    scorer); ``digests`` is then ignored.
+
+    * ``affinity`` — longest cached-chain match first, then least
+      loaded, then name (stable across runs);
+    * ``least_loaded`` — load ascending, then name;
+    * ``round_robin`` — registration order rotated by ``rr_offset``
+      (the bench baseline the affinity bar is measured against).
+    """
+    if policy not in PLACEMENT_POLICIES:
+        raise ValueError(f"placement={policy!r}: expected one of "
+                         f"{PLACEMENT_POLICIES}")
+    if scores is None:
+        scores = {name: affinity_chain_len(digests, idx)
+                  for name, idx, _ in candidates}
+    names = [name for name, _, _ in candidates]
+    if not names:
+        return [], scores
+    if policy == "round_robin":
+        k = rr_offset % len(names)
+        return names[k:] + names[:k], scores
+    if policy == "affinity":
+        order = sorted(candidates,
+                       key=lambda c: (-scores[c[0]], c[2], c[0]))
+    else:                                    # least_loaded
+        order = sorted(candidates, key=lambda c: (c[2], c[0]))
+    return [c[0] for c in order], scores

@@ -1,0 +1,674 @@
+"""Multi-replica serving front-end: cache-affinity routing, failover,
+live request migration (docs/SERVING.md "Fleet: routing, failover,
+migration"; ROADMAP item: multi-replica serving — the fleet analogue of
+data-parallel replication, where availability is won at the replica
+boundary).
+
+The :class:`FleetRouter` stands where one hardened engine used to be
+and speaks the same request API (``put`` / ``step`` / ``flush`` /
+``cancel`` / ``query``), so the load harness and any future gateway
+drive a fleet exactly like an engine.  It composes contracts earlier
+PRs already built — nothing here invents a new one:
+
+* **placement** — each NEW request is scored by prefix-cache affinity
+  (longest cached-chain match of the prompt's chain digests against
+  every replica's live ``prefix_digests()``; PR 4's content hashes are
+  the key), falling back to least-loaded; ``round_robin`` exists as
+  the measured baseline.
+* **health & quarantine** — replicas are watched through the PR-8
+  health ladder and their own failure counters; a per-replica
+  :class:`~.replica.CircuitBreaker` quarantines a replica after
+  consecutive failing steps (NEW placements avoid it; its open work
+  keeps stepping) and re-admits it after a clean probe.
+* **failover** — a replica death mid-traffic (:class:`EngineDeadError`)
+  loses zero requests: the dead engine's ``snapshot()`` (host truth,
+  valid on a dead backend) yields restore()-compatible per-request
+  records that migrate onto surviving replicas via
+  ``load_snapshot(..., merge=True)``, with bounded retry + step-counted
+  exponential backoff while the fleet is unplaceable.  The
+  (uid, position)-folded sampling keys make migrated streams
+  token-identical to an undisturbed run.
+* **live migration & scale-down** — ``migrate()`` moves a chosen
+  subset of open work between live replicas (``engine.migrate_out``);
+  ``scale_down()`` drains a replica and re-places exactly its
+  ``shed_uids``.
+* **fleet-level shed** — a request is rejected only when EVERY
+  routable replica's own admission bound shed it (the 429-equivalent);
+  one replica's backpressure is the next replica's placement.
+
+Everything is step-counted and host-side: no wall-clock waits, no
+polling loops — the router's only clock is its own step counter, so
+chaos replays stay machine-independent (the same discipline tpulint's
+``serving-wait`` rule enforces on the marked methods below).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..inference import SamplingParams
+from ..inference.engine import InferenceEngine
+from ..inference.failures import EngineDeadError
+from ..inference.overload import AdmissionVerdict
+from ..inference.ragged.state import iter_prefix_chain_digests
+from ..telemetry import MetricsRegistry
+from ..utils.logging import logger
+from .placement import PLACEMENT_POLICIES, rank_replicas
+from .replica import ReplicaHandle
+
+# fleet-level view of engine health states, exported per replica as
+# the serving_fleet_replica_health gauge (same 0-3 code space as the
+# engine's own serving_health_state; 4 = router-quarantined)
+_HEALTH_CODE = {"healthy": 0, "degraded": 1, "draining": 2, "dead": 3}
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Knobs for the fleet router."""
+    # placement policy for NEW requests: "affinity" (longest cached-
+    # chain match, least-loaded tiebreak), "least_loaded", or
+    # "round_robin" (the bench baseline the affinity bar beats)
+    placement: str = "affinity"
+    # circuit breaker: consecutive failing steps before a replica is
+    # quarantined from new placements, and how many router steps the
+    # quarantine lasts before the half-open probe
+    failure_threshold: int = 2
+    probe_interval_steps: int = 8
+    # migration placement: bounded retries with step-counted
+    # exponential backoff (base * 2^attempt, capped) while no replica
+    # is routable; exhausted retries close the request "shed" at the
+    # fleet level rather than parking it forever
+    max_migration_retries: int = 8
+    migration_backoff_steps: int = 1
+
+    def __post_init__(self):
+        if self.placement not in PLACEMENT_POLICIES:
+            raise ValueError(f"placement={self.placement!r}: expected "
+                             f"one of {PLACEMENT_POLICIES}")
+        if self.failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        if self.probe_interval_steps < 1:
+            raise ValueError("probe_interval_steps must be >= 1")
+        if self.max_migration_retries < 0:
+            raise ValueError("max_migration_retries must be >= 0")
+        if self.migration_backoff_steps < 1:
+            raise ValueError("migration_backoff_steps must be >= 1")
+
+
+@dataclasses.dataclass
+class _Migration:
+    """One request record waiting for re-placement (failover, live
+    migration, or scale-down hand-off)."""
+    rec: Dict
+    source: str
+    attempts: int = 0
+    next_step: int = 0
+
+
+class FleetRouter:
+    """N engine replicas behind one engine-shaped front-end (module
+    docstring).  ``replicas``: ``{name: InferenceEngine}`` (insertion
+    order is the deterministic rank tiebreak) or a sequence of engines
+    auto-named ``r0, r1, ...``."""
+
+    def __init__(self, replicas, cfg: Optional[FleetConfig] = None):
+        self.cfg = cfg or FleetConfig()
+        self._reps: Dict[str, ReplicaHandle] = {}
+        self._block_size: Optional[int] = None
+        self._max_blocks = 1      # hash budget: fleet max blocks/seq
+        self._owner: Dict[int, str] = {}      # open uid -> replica name
+        self._closed: Dict[int, str] = {}     # fleet-terminal statuses
+        self._reaped: set = set()             # fleet closures to report
+        self._migrations: List[_Migration] = []
+        self._steps = 0
+        self._rr = 0                          # round-robin cursor
+        self._setup_metrics()
+        items = replicas.items() if isinstance(replicas, dict) \
+            else ((f"r{i}", e) for i, e in enumerate(replicas))
+        for name, eng in items:
+            self.add_replica(name, eng)
+        if not self._reps:
+            raise ValueError("FleetRouter needs at least one replica")
+
+    def _setup_metrics(self) -> None:
+        """Fleet gauges/counters (docs/OBSERVABILITY.md "Fleet
+        gauges") — host counter bumps only, exported through the same
+        registry/exposition machinery the engines use."""
+        self.metrics = MetricsRegistry()
+        reg = self.metrics
+        self._c_placements = reg.counter(
+            "serving_fleet_placements_total",
+            "new requests placed on a replica (label policy=)",
+            int_valued=True)
+        self._c_place_hits = reg.counter(
+            "serving_fleet_placement_affinity_hits_total",
+            "placements whose chosen replica held a nonzero cached "
+            "chain for the prompt", int_valued=True)
+        self._c_shed = reg.counter(
+            "serving_fleet_shed_total",
+            "requests shed at the FLEET level (every routable replica "
+            "rejected, or migration retries exhausted) — the "
+            "429-equivalent", int_valued=True)
+        self._c_failovers = reg.counter(
+            "serving_fleet_failovers_total",
+            "replica deaths answered by snapshot migration",
+            int_valued=True)
+        self._c_migrations = reg.counter(
+            "serving_fleet_migrations_total",
+            "request records re-placed onto a surviving replica",
+            int_valued=True)
+        self._c_migration_retries = reg.counter(
+            "serving_fleet_migration_retries_total",
+            "migration placements deferred by backoff (no routable "
+            "replica at that step)", int_valued=True)
+        self._c_quarantines = reg.counter(
+            "serving_fleet_quarantines_total",
+            "circuit-breaker trips (replica quarantined from new "
+            "placements)", int_valued=True)
+        self._c_readmissions = reg.counter(
+            "serving_fleet_readmissions_total",
+            "quarantined replicas re-admitted after a clean probe",
+            int_valued=True)
+        self._c_failed = reg.counter(
+            "serving_fleet_requests_failed_total",
+            "requests closed 'failed' at the fleet level (inexact "
+            "records whose device-side tokens died with a replica)",
+            int_valued=True)
+        self._g_replicas = reg.gauge(
+            "serving_fleet_replicas", "replicas registered (incl. dead)")
+        self._g_routable = reg.gauge(
+            "serving_fleet_replicas_routable",
+            "replicas currently accepting new placements")
+        self._g_rep_health = reg.gauge(
+            "serving_fleet_replica_health",
+            "per-replica health (label replica=): 0 healthy 1 degraded "
+            "2 draining 3 dead 4 quarantined")
+        reg.gauge_fn("serving_fleet_requests_migrating",
+                     lambda: len(self._migrations),
+                     "request records waiting for re-placement")
+        reg.gauge_fn("serving_fleet_placement_hit_rate",
+                     self._placement_hit_rate,
+                     "affinity-hit placements / placements (absent "
+                     "before the first placement)")
+
+    def _placement_hit_rate(self) -> Optional[float]:
+        total = sum(v for _, v in self._c_placements.series())
+        if not total:
+            return None
+        return self._c_place_hits.value() / total
+
+    # ------------------------------------------------------------------
+    # fleet membership
+    # ------------------------------------------------------------------
+    def add_replica(self, name: str, engine: InferenceEngine) -> None:
+        """Register a replica (scale-up).  Fleets must share one KV
+        block size — the chain digest is block-aligned, so a
+        heterogeneous fleet could never compare affinity keys."""
+        if name in self._reps:
+            raise ValueError(f"replica {name!r} already registered")
+        bs = engine.icfg.kv_block_size
+        if self._block_size is None:
+            self._block_size = bs
+        elif bs != self._block_size:
+            raise ValueError(
+                f"replica {name!r} has kv_block_size={bs}, fleet uses "
+                f"{self._block_size}: affinity digests are block-"
+                "aligned and cannot mix sizes")
+        self._max_blocks = max(self._max_blocks,
+                               engine.max_blocks_per_seq)
+        self._reps[name] = ReplicaHandle(
+            name, engine, threshold=self.cfg.failure_threshold,
+            probe_interval=self.cfg.probe_interval_steps)
+
+    def replica(self, name: str) -> ReplicaHandle:
+        return self._reps[name]
+
+    @property
+    def replica_names(self) -> List[str]:
+        return list(self._reps)
+
+    def _routable(self) -> List[ReplicaHandle]:
+        return [r for r in self._reps.values() if r.routable()]
+
+    def _score_candidates(self, tokens, cands) -> Dict[str, int]:
+        """Leading-run affinity scores for one prompt against every
+        candidate's LIVE index dict, from one shared LAZY digest
+        stream: hashing stops at the block where every candidate's run
+        has missed (a fleet-wide cache-miss prompt hashes ONE block —
+        the same discipline as ``match_prefix``) and is capped at the
+        fleet's max blocks/seq (blocks past it can never be cached)."""
+        scores = {name: 0 for name, _, _ in cands}
+        alive = {name: idx for name, idx, _ in cands}
+        if alive:
+            for h in iter_prefix_chain_digests(
+                    tokens, self._block_size, self._max_blocks):
+                for name in list(alive):
+                    if h in alive[name]:
+                        scores[name] += 1
+                    else:
+                        del alive[name]
+                if not alive:
+                    break
+        return scores
+
+    def _rank(self, tokens) -> Tuple[List[str], Dict[str, int]]:
+        """Rank routable replicas for one placement.  Half-open
+        (probing) replicas rank strictly AFTER every closed one
+        whatever their affinity — quarantine means minimal traffic, so
+        they only receive work when no closed replica can take it (and
+        that one placement is the probe)."""
+        closed = [(rep.name, rep.digest_index(), rep.load())
+                  for rep in self._routable()
+                  if rep.breaker.state == "closed"]
+        probing = [(rep.name, rep.digest_index(), rep.load())
+                   for rep in self._routable()
+                   if rep.breaker.state == "half_open"]
+        scores = self._score_candidates(tokens, closed + probing)
+        order, _ = rank_replicas(self.cfg.placement, (), closed,
+                                 rr_offset=self._rr, scores=scores)
+        if probing:
+            p_order, _ = rank_replicas(
+                self.cfg.placement, (), probing,
+                rr_offset=self._rr, scores=scores)
+            order = order + p_order
+        return order, scores
+
+    # ------------------------------------------------------------------
+    # the engine-shaped request API
+    # ------------------------------------------------------------------
+    def put(self, uid: int, tokens: Sequence[int], priority: int = 0,
+            deadline_ms: Optional[float] = None) -> AdmissionVerdict:  # tpulint: serving-loop
+        """Route a request.  Continuations forward to the owning
+        replica (or join the request's queued migration record — the
+        fed-back token is simply the next stream token).  NEW requests
+        are placed by the configured policy; a replica's shed verdict
+        sends the request to the NEXT candidate, and only when every
+        routable replica sheds does the fleet shed (``replica=None`` on
+        the verdict — the 429-equivalent)."""
+        owner = self._owner.get(uid)
+        if owner is not None:
+            v = self._reps[owner].engine.put(uid, tokens,
+                                             priority=priority,
+                                             deadline_ms=deadline_ms)
+            return v._replace(replica=owner)
+        for m in self._migrations:
+            if m.rec["uid"] == uid:
+                m.rec["tokens"].extend(int(t) for t in tokens)
+                return AdmissionVerdict(True, "continued",
+                                        reason="joined migration record")
+        order, scores = self._rank(tokens)
+        if self.cfg.placement == "round_robin" and order:
+            # the rotation cursor advances per ARRIVAL, here only —
+            # migration placements also rank (in _place_record) and
+            # must not skew the baseline's rotation over new requests
+            self._rr += 1
+        for name in order:
+            v = self._reps[name].engine.put(uid, tokens,
+                                            priority=priority,
+                                            deadline_ms=deadline_ms)
+            for eu in v.evicted_uids:
+                # evict-lowest backpressure shed a queued request on
+                # that replica: terminal at the fleet level too
+                self._closed[eu] = "shed"
+                self._owner.pop(eu, None)
+                self._reaped.add(eu)
+            if v.admitted:
+                self._owner[uid] = name
+                # a terminal uid that returns lives a full new life —
+                # the engine's own reuse semantics, mirrored.  The
+                # stale reaped entry goes too: a driver draining later
+                # must not drop the now-live request as closed
+                self._closed.pop(uid, None)
+                self._reaped.discard(uid)
+                self._c_placements.inc(policy=self.cfg.placement)
+                if scores.get(name, 0) > 0:
+                    self._c_place_hits.inc()
+                return v._replace(replica=name)
+        self._c_shed.inc()
+        self._closed[uid] = "shed"
+        self._reaped.add(uid)
+        return AdmissionVerdict(
+            False, "shed",
+            reason="fleet saturated: every routable replica shed the "
+                   "request" if order else "no routable replica")
+
+    def step(self, rng=None,
+             sampling: SamplingParams = SamplingParams()
+             ) -> Dict[int, int]:  # tpulint: serving-loop
+        """One fleet step: every live replica runs one engine step
+        (quarantined replicas included — their open work must finish,
+        and their clean steps are what the probe eventually certifies),
+        breaker bookkeeping folds in each replica's outcome, a replica
+        that died mid-step fails over, and the migration queue pumps.
+        Returns the merged ``{uid: token}`` emissions — uids are
+        disjoint across replicas because each open request is owned by
+        exactly one."""
+        self._steps += 1
+        outs: Dict[int, int] = {}
+        for name in list(self._reps):
+            rep = self._reps[name]
+            if rep.dead:
+                continue
+            rep.breaker.tick(self._steps)
+            try:
+                o = rep.engine.step(rng=rng, sampling=sampling)
+            except EngineDeadError:
+                self._failover(name)
+                continue
+            ev = rep.observe(self._steps)
+            if ev == "opened":
+                self._c_quarantines.inc()
+                logger.warning(
+                    "fleet: replica %s quarantined after %d consecutive "
+                    "failing steps (probe in %d steps)", name,
+                    rep.breaker.failures, self.cfg.probe_interval_steps)
+            elif ev == "readmitted":
+                self._c_readmissions.inc()
+                logger.warning(
+                    "fleet: replica %s re-admitted after a clean probe",
+                    name)
+            for uid in rep.engine._drain_reaped():
+                self._note_engine_close(rep, uid)
+            outs.update(o)
+        self._pump_migrations()
+        self._refresh_gauges()
+        return outs
+
+    def flush(self, uid: int) -> None:
+        """Client-side completion — forwards to the owner and records
+        the fleet-terminal status.  A uid waiting in the migration
+        queue settles HERE: the client is done with it, and a record
+        left in the queue would re-run on a survivor as an orphan
+        nobody ever drives or flushes."""
+        for i, m in enumerate(self._migrations):
+            if m.rec["uid"] == uid:
+                del self._migrations[i]
+                self._closed[uid] = "finished"
+                return
+        owner = self._owner.pop(uid, None)
+        if owner is None:
+            return
+        self._reps[owner].engine.flush(uid)
+        self._closed[uid] = "finished"
+
+    def cancel(self, uid: int) -> None:
+        """Client abort, wherever the request is: owned by a replica,
+        waiting in the migration queue, or already gone (no-op)."""
+        for i, m in enumerate(self._migrations):
+            if m.rec["uid"] == uid:
+                del self._migrations[i]
+                self._closed[uid] = "cancelled"
+                self._reaped.add(uid)
+                return
+        owner = self._owner.pop(uid, None)
+        if owner is None:
+            return
+        rep = self._reps[owner]
+        rep.engine.cancel(uid)
+        for ru in rep.engine._drain_reaped():
+            if ru != uid:          # other staged closures still surface
+                self._note_engine_close(rep, ru)
+        self._closed[uid] = "cancelled"
+        self._reaped.add(uid)
+
+    def query(self, uid: int) -> Dict:
+        """Fleet-level request status: the owning replica's ``query()``
+        plus ``replica``; ``migrating`` while a record waits for
+        re-placement; the fleet-terminal status after closure."""
+        if uid in self._closed:
+            return {"status": self._closed[uid], "replica": None}
+        for m in self._migrations:
+            if m.rec["uid"] == uid:
+                return {"status": "migrating", "replica": None,
+                        "generated": list(m.rec.get("generated", []))}
+        owner = self._owner.get(uid)
+        if owner is not None:
+            d = self._reps[owner].engine.query(uid)
+            d["replica"] = owner
+            return d
+        return {"status": "unknown", "replica": None}
+
+    def drain_reaped(self) -> set:
+        """Uids the FLEET terminally closed since the last call
+        (replica-side closures, fleet sheds, failed migrations) — the
+        driver drops them from its active set, exactly like
+        ``engine._drain_reaped``."""
+        out = self._reaped
+        self._reaped = set()
+        return out
+
+    def _note_engine_close(self, rep: ReplicaHandle, uid: int) -> None:
+        """An engine-side terminal closure surfaced through that
+        replica's reaped set.  ``migrated`` is NOT a fleet closure —
+        the record is in flight to another replica.  A STALE report is
+        ignored: a uid shed on this replica and then re-admitted on
+        another before the reaped set drained is live THERE — closing
+        it here would orphan the revived request."""
+        own = self._owner.get(uid)
+        if own is not None and own != rep.name:
+            return
+        s = rep.engine.query(uid)["status"]
+        if s == "migrated":
+            return
+        if s in ("queued", "running"):
+            # the engine reaps only at terminal close, so a LIVE status
+            # means the uid was re-admitted on this replica after the
+            # reap was staged (same revival race, same-replica form)
+            return
+        if s in ("unknown", "forgotten"):
+            s = "released"
+        self._closed[uid] = s
+        self._owner.pop(uid, None)
+        self._reaped.add(uid)
+
+    # ------------------------------------------------------------------
+    # failover, migration, scale-down
+    # ------------------------------------------------------------------
+    def _failover(self, name: str) -> None:  # tpulint: serving-loop
+        """A replica died mid-step.  Zero lost requests: its
+        ``snapshot()`` (host truth — valid on the dead backend) yields
+        per-request records that enter the migration queue; inexact
+        records (device-side tokens died with the replica) close
+        ``failed`` honestly."""
+        rep = self._reps[name]
+        rep.breaker.kill()
+        self._c_failovers.inc()
+        # closures the engine staged in its dying step (deadline
+        # reaps, sheds) must still surface as fleet closures — the
+        # step that would have delivered them raised instead
+        for uid in rep.engine._drain_reaped():
+            self._note_engine_close(rep, uid)
+        snap = rep.engine.snapshot()
+        n = 0
+        for rec in snap["requests"]:
+            self._owner.pop(int(rec["uid"]), None)
+            n += self._enqueue_migration(rec, source=name)
+        logger.warning(
+            "fleet: replica %s died; %d open request(s) queued for "
+            "migration, %d inexact record(s) closed failed", name, n,
+            len(snap["requests"]) - n)
+
+    def _enqueue_migration(self, rec: Dict, source: str) -> int:
+        uid = int(rec["uid"])
+        if not rec.get("exact", True) or not rec.get("tokens"):
+            self._closed[uid] = "failed"
+            self._reaped.add(uid)
+            self._c_failed.inc()
+            return 0
+        self._migrations.append(
+            _Migration(rec=rec, source=source, next_step=self._steps))
+        return 1
+
+    def _pump_migrations(self) -> None:  # tpulint: serving-loop
+        """Place queued migration records on surviving replicas.  A
+        record that cannot place (no routable replica right now)
+        retries with step-counted exponential backoff, bounded by
+        ``max_migration_retries`` — exhausted retries shed at the
+        fleet level instead of parking forever."""
+        if not self._migrations:
+            return
+        still: List[_Migration] = []
+        for m in self._migrations:
+            if m.next_step > self._steps:
+                still.append(m)
+                continue
+            name = self._place_record(m.rec, exclude=m.source)
+            if name is not None:
+                self._owner[m.rec["uid"]] = name
+                self._c_migrations.inc()
+                continue
+            m.attempts += 1
+            self._c_migration_retries.inc()
+            if m.attempts > self.cfg.max_migration_retries:
+                # last resort before destroying the work: going HOME
+                # beats shedding — the source may be alive again (a
+                # quarantined-then-readmitted replica); only a record
+                # with nowhere at all left sheds
+                name = self._place_record(m.rec)
+                if name is not None:
+                    self._owner[m.rec["uid"]] = name
+                    self._c_migrations.inc()
+                    continue
+                self._closed[m.rec["uid"]] = "shed"
+                self._reaped.add(m.rec["uid"])
+                self._c_shed.inc()
+                logger.warning(
+                    "fleet: migration of uid %d exhausted %d retries "
+                    "with no routable replica — shed",
+                    m.rec["uid"], m.attempts - 1)
+                continue
+            m.next_step = self._steps + self.cfg.migration_backoff_steps \
+                * (1 << min(m.attempts - 1, 6))
+            still.append(m)
+        self._migrations = still
+
+    def _place_record(self, rec: Dict,
+                      exclude: Optional[str] = None) -> Optional[str]:
+        """Place one migration record by the same affinity ranking new
+        requests get (its stream's cached chain may still be resident
+        somewhere).  The SOURCE replica is excluded — its cached-free
+        chain makes it the top affinity score for its own evictee, and
+        a migration that lands back home moved nothing.
+        ``load_snapshot(merge=True)`` bypasses admission bounds — the
+        request was admitted by the fleet once; shedding it again
+        would double-charge the client."""
+        order, _ = self._rank(rec.get("tokens") or ())
+        for name in order:
+            if name == exclude:
+                continue
+            rep = self._reps[name]
+            try:
+                rep.engine.load_snapshot(
+                    {"version": InferenceEngine.SNAPSHOT_VERSION,
+                     "partial": True, "requests": [rec]}, merge=True)
+            except ValueError:
+                continue          # uid collision: try the next replica
+            # no placement-hit bump here: migrations are not counted
+            # in the placements denominator, and the MEASURED hit rate
+            # (engine cached/prompt counters) covers them anyway
+            return name
+        return None
+
+    def migrate(self, uids: Sequence[int], source: str) -> int:
+        """Live request migration: extract the given OPEN requests from
+        ``source`` (``engine.migrate_out`` — closes them ``migrated``
+        there, releasing their KV) and re-place them by affinity on the
+        rest of the fleet.  Returns the number of records that entered
+        the migration queue.  With no routable destination besides the
+        source, nothing is extracted (0) — a migration that could only
+        end in retry-exhaustion must not destroy requests the source
+        is serving fine."""
+        if not any(rep.routable() for rep in self._reps.values()
+                   if rep.name != source):
+            return 0
+        rep = self._reps[source]
+        part = rep.engine.migrate_out(uids)
+        n = 0
+        for rec in part["requests"]:
+            self._owner.pop(int(rec["uid"]), None)
+            n += self._enqueue_migration(rec, source=source)
+        for uid in rep.engine._drain_reaped():
+            self._note_engine_close(rep, uid)  # "migrated" returns early
+        self._pump_migrations()
+        return n
+
+    def scale_down(self, name: str,
+                   deadline_ms: Optional[float] = None,
+                   sampling: SamplingParams = SamplingParams(),
+                   rng=None) -> Dict:
+        """Drain-to-scale-down: ``engine.drain()`` the replica, then
+        re-place exactly its ``shed_uids`` records (the drain's
+        completed set stays settled — re-placing it would double-run).
+        The replica leaves the routable set permanently; returns the
+        drain's snapshot."""
+        rep = self._reps[name]
+        snap = rep.engine.drain(deadline_ms=deadline_ms,
+                                sampling=sampling, rng=rng)
+        rep.breaker.kill()
+        recs = {int(r["uid"]): r for r in snap["requests"]}
+        shed = set(snap["shed_uids"])
+        for uid in snap["shed_uids"]:
+            if uid in recs:
+                self._owner.pop(uid, None)
+                self._enqueue_migration(recs[uid], source=name)
+        for uid in rep.engine._drain_reaped():
+            if uid in shed:
+                continue          # re-placing, not closing
+            self._note_engine_close(rep, uid)
+        self._pump_migrations()
+        return snap
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+    def _refresh_gauges(self) -> None:
+        # health_state(), not health(): the full probe is a phase
+        # boundary (it polls device memory under device_telemetry) and
+        # must not run per replica per router step
+        self._g_replicas.set(len(self._reps))
+        self._g_routable.set(len(self._routable()))
+        for name, rep in self._reps.items():
+            if rep.breaker.state in ("open", "half_open"):
+                code = 4
+            else:
+                code = _HEALTH_CODE.get(rep.engine.health_state(), 3)
+            self._g_rep_health.set(code, replica=name)
+
+    def health(self) -> Dict:
+        """Fleet health summary — the gateway's ``/healthz`` payload:
+        per-replica engine state + breaker state + load, and the
+        fleet-level tallies."""
+        self._refresh_gauges()
+        reps = {}
+        for name, rep in self._reps.items():
+            reps[name] = {
+                "state": rep.engine.health()["state"],
+                "breaker": rep.breaker.state,
+                "load": rep.load(),
+                "quarantines": rep.breaker.quarantines,
+                "readmissions": rep.breaker.readmissions,
+            }
+        return {
+            "replicas": reps,
+            "routable": len(self._routable()),
+            "migrating": len(self._migrations),
+            "steps": self._steps,
+            "failovers": int(self._c_failovers.value()),
+            "migrations": int(self._c_migrations.value()),
+            "fleet_shed": int(self._c_shed.value()),
+        }
+
+    def metrics_snapshot(self) -> Dict:
+        """JSON-able snapshot of the fleet gauges/counters (the
+        replicas' own registries are separate — scrape them per
+        replica)."""
+        return self.metrics.snapshot()
+
+    def request_metrics(self) -> Dict:
+        """Fleet-wide per-request aggregate: each replica's lifecycle
+        aggregate keyed by replica name (a migrated request has one
+        open record fleet-wide; its prior replicas hold closed
+        ``migrated``/``shed`` records by design)."""
+        return {name: rep.engine.request_metrics()["aggregate"]
+                for name, rep in self._reps.items()}

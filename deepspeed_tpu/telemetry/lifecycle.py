@@ -62,9 +62,15 @@ QUEUE_WAIT_BUCKETS_MS = TTFT_BUCKETS_MS
 #                       (poison — docs/SERVING.md "Failure domains &
 #                       recovery"), or its device-side tokens were lost
 #                       to a failure the host could not replay
+#   migrated          — its open work was extracted
+#                       (engine.migrate_out) and re-placed on another
+#                       replica by the fleet router: terminal on THIS
+#                       engine, while the request lives on at the
+#                       fleet level (docs/SERVING.md "Fleet: routing,
+#                       failover, migration")
 TERMINAL_STATUSES = ("finished", "shed", "deadline_exceeded",
                      "context_exhausted", "cancelled", "released",
-                     "failed")
+                     "failed", "migrated")
 
 
 @dataclasses.dataclass

@@ -54,6 +54,28 @@ def test_metric_direction_classification():
     assert metric_direction("config_hash") is None
 
 
+def test_fleet_leg_metrics_are_gated():
+    """The fleet_serving_bench leg's headline metrics (PR 13) land
+    top-level under names the EXISTING direction rules gate: goodput /
+    hit-rate up-is-better, TTFT ms down-is-better — a fleet goodput or
+    affinity regression fails a same-fingerprint benchdiff run."""
+    assert metric_direction("fleet_goodput_tok_s") == 1
+    assert metric_direction("fleet_single_goodput_tok_s") == 1
+    assert metric_direction("fleet_affinity_hit_rate") == 1
+    assert metric_direction("fleet_round_robin_hit_rate") == 1
+    assert metric_direction("fleet_ttft_p95_prekill_ms") == -1
+    assert metric_direction("fleet_ttft_p95_postkill_ms") == -1
+    # and a regression actually trips the gate
+    base = {"engine_version": "1", "config_hash": "aaaa",
+            "value": 100.0, "fleet_goodput_tok_s": 500.0,
+            "fleet_affinity_hit_rate": 0.7}
+    worse = dict(base, fleet_goodput_tok_s=300.0)
+    v = compare(base, worse)
+    assert not v["ok"]
+    assert any(r["metric"] == "fleet_goodput_tok_s"
+               for r in v["regressions"])
+
+
 def test_matching_fingerprint_enforces_and_exits_nonzero(tmp_path):
     old = {"engine_version": "1", "config_hash": "aaaa",
            "value": 100.0, "serving_decode_tok_s": 700.0}

@@ -311,7 +311,7 @@ class TestSnapshotRestore:
         eng.put(1, [7, 8, 9])
         eng.step()                           # 0/1 live with output
         snap = eng.snapshot()
-        assert snap["version"] == 1 and snap["engine_version"]
+        assert snap["version"] == 2 and snap["engine_version"]
         assert isinstance(snap["prefix_index"], list)
         recs = {r["uid"]: r for r in snap["requests"]}
         assert recs[0]["priority"] == 1
@@ -329,12 +329,18 @@ class TestSnapshotRestore:
         assert set(out) == {0, 1}
 
     def test_restore_rejects_wrong_version(self, model):
-        with pytest.raises(ValueError):
-            InferenceEngine.restore(model, {"version": 2, "requests": []})
+        """Schema-version gate: v2 engines restore v2 only — a v1
+        snapshot predates per-request extraction/merge and a v3 one is
+        from the future; half-applying either silently would be worse
+        than refusing loudly."""
+        for bad in (1, 3, None):
+            with pytest.raises(ValueError, match="version"):
+                InferenceEngine.restore(model, {"version": bad,
+                                                "requests": []})
 
     def test_inexact_records_close_failed(self, model):
         eng = make_engine(model)
-        snap = {"version": 1, "requests": [
+        snap = {"version": 2, "requests": [
             {"uid": 5, "tokens": None, "generated": [3], "exact": False},
             {"uid": 6, "tokens": [1, 2], "generated": [], "exact": True},
         ]}
@@ -346,6 +352,121 @@ class TestSnapshotRestore:
 
     def test_terminal_statuses_contains_failed(self):
         assert "failed" in TERMINAL_STATUSES
+
+    def test_terminal_statuses_contains_migrated(self):
+        assert "migrated" in TERMINAL_STATUSES
+
+    def test_snapshot_requests_extracts_subset(self, model):
+        eng = make_engine(model)
+        for uid in (0, 1, 2):
+            eng.put(uid, [1 + uid, 2, 3, 4])
+        eng.step()
+        part = eng.snapshot_requests([1, 2, 777])   # 777: never seen
+        assert part["version"] == 2 and part["partial"]
+        assert [r["uid"] for r in part["requests"]] == [1, 2]
+        # pure extraction: nothing closed, nothing released
+        assert eng.query(1)["status"] in ("running", "queued")
+        full = {r["uid"]: r for r in eng.snapshot()["requests"]}
+        for r in part["requests"]:
+            assert r == full[r["uid"]]
+
+    def test_load_snapshot_refuses_nonfresh_without_merge(self, model):
+        src = make_engine(model)
+        src.put(0, [1, 2, 3])
+        snap = src.snapshot()
+        dst = make_engine(model)
+        dst.put(5, [9, 8, 7])                # dst is already serving
+        with pytest.raises(ValueError, match="merge=True"):
+            dst.load_snapshot(snap)
+        dst.load_snapshot(snap, merge=True)  # the migration mode
+        assert dst.query(0)["status"] == "queued"
+        assert dst.query(5)["status"] == "queued"
+
+    def test_merge_rejects_uid_collision(self, model):
+        src = make_engine(model)
+        src.put(0, [1, 2, 3])
+        snap = src.snapshot()
+        dst = make_engine(model)
+        dst.put(0, [4, 5, 6])                # same uid already open
+        with pytest.raises(ValueError, match="already open"):
+            dst.load_snapshot(snap, merge=True)
+        # a duplicate uid WITHIN one payload is the same double-run
+        # hazard (both modes) — and snapshot_requests dedups its list
+        rec = snap["requests"][0]
+        dst2 = make_engine(model)
+        with pytest.raises(ValueError, match="repeats"):
+            dst2.load_snapshot({"version": 2,
+                                "requests": [rec, dict(rec)]},
+                               merge=True)
+        assert len(src.snapshot_requests([0, 0, 0])["requests"]) == 1
+        # rejection is ATOMIC: a payload refused on its second record
+        # must not leave its first record half-applied — the caller's
+        # retry on another replica would double-run it
+        src.put(7, [9, 9, 9])
+        two = src.snapshot_requests([7, 0])
+        dst3 = make_engine(model)
+        dst3.put(0, [4, 5, 6])               # collides with record #2
+        with pytest.raises(ValueError, match="already open"):
+            dst3.load_snapshot(two, merge=True)
+        assert dst3.query(7)["status"] == "unknown"
+
+    def test_migrate_out_skips_non_replayable_streams(self, model):
+        """A voluntary migration must never destroy a healthy request:
+        a non-resumable stream (broken chain — device-side tokens the
+        host never saw) is SKIPPED, not extracted-and-closed (the
+        destination could only close it 'failed')."""
+        eng = make_engine(model)
+        eng.put(0, [1, 2, 3, 4])
+        eng.step()
+        eng.state.seqs[0].chain_broken = True   # e.g. a decode burst
+        part = eng.migrate_out([0])
+        assert part["requests"] == []
+        assert eng.query(0)["status"] == "running"   # left in place
+
+    def test_migrate_out_moves_open_work_token_identically(self, model):
+        """Live subset migration: migrate_out() extracts + closes
+        ``migrated`` on the source, load_snapshot(merge=True) re-opens
+        on a NON-EMPTY destination, and the moved request's continued
+        stream is token-identical to an unmigrated run (the
+        (uid, position)-folded keys, as for restore)."""
+        rng = jax.random.PRNGKey(7)
+        sp = SamplingParams(temperature=0.8, top_k=40,
+                            max_new_tokens=1 << 30)
+        prompts = {0: [3, 1, 4, 1, 5, 9, 2, 6], 1: [2, 7, 1, 8]}
+        ref, _ = drive(make_engine(model), dict(prompts), n_tok=6,
+                       sampling=sp, rng=rng)
+        src = make_engine(model)
+        dst = make_engine(model)
+        dst.put(1, list(prompts[1]))         # dst is already serving
+        done = {0: [], 1: []}
+        src.put(0, list(prompts[0]))
+        for _ in range(3):                   # partway through uid 0
+            for u, t in src.step(rng=rng, sampling=sp).items():
+                done[u].append(t)
+                src.put(u, [t])
+        part = src.migrate_out([0])
+        assert [r["uid"] for r in part["requests"]] == [0]
+        assert src.query(0)["status"] == "migrated"
+        assert 0 in src._drain_reaped()
+        al = src.state.allocator
+        al.assert_invariants()
+        assert al.free_blocks == al.total_blocks   # KV released on src
+        dst.load_snapshot(part, merge=True)
+        n = 0
+        active = {0, 1}
+        while active:
+            n += 1
+            assert n < 200, "migrated drive wedged"
+            for u, t in dst.step(rng=rng, sampling=sp).items():
+                if u not in active:
+                    continue
+                done[u].append(t)
+                if len(done[u]) >= 6:
+                    active.discard(u)
+                    dst.flush(u)
+                else:
+                    dst.put(u, [t])
+        assert done == ref, "migration changed a token stream"
 
 
 # --------------------------------------------------------------------------
@@ -391,6 +512,11 @@ class TestHealthDrain:
         # status — the replacement replica restores the snapshot
         assert eng.health()["state"] == "draining"
         assert {r["uid"] for r in snap["requests"]} == {0, 1}
+        # the drain reports its outcome split: everything still open
+        # closed "shed" (the set the router re-places), nothing
+        # completed through another exit on this trace
+        assert snap["shed_uids"] == [0, 1]
+        assert snap["completed_uids"] == []
         assert all(eng.query(u)["status"] == "shed" for u in (0, 1))
         assert eng.request_metrics()["aggregate"]["open"] == 0
         v = eng.put(9, [1])
@@ -408,6 +534,67 @@ class TestHealthDrain:
         assert eng.query(0)["status"] == "shed"
         recs = {r["uid"]: r for r in snap["requests"]}
         assert recs[0]["exact"]              # still fully replayable
+        assert snap["shed_uids"] == [0]
+
+    def test_drain_splits_completed_from_shed(self, model):
+        """A request that reaches a NON-shed terminal during the drain
+        (here: an already-expired deadline reaped by the drain's first
+        scheduler round) lands in ``completed_uids``, not in the
+        re-place set."""
+        eng = make_engine(model)
+        eng.put(0, [1, 2, 3, 4])
+        eng.put(1, [5, 6, 7], deadline_ms=0.0)   # expires immediately
+        snap = eng.drain(deadline_ms=30_000.0)
+        assert snap["shed_uids"] == [0]
+        assert snap["completed_uids"] == [1]
+        assert eng.query(1)["status"] == "deadline_exceeded"
+        assert {r["uid"] for r in snap["requests"]} == {0}
+
+    def test_replaced_drained_requests_keep_token_parity(self, model):
+        """The router's scale-down drill: drain a replica mid-decode,
+        re-place exactly its ``shed_uids`` records onto another LIVE
+        replica (merge=True), and the finished streams are token-
+        identical to an undisturbed single-engine run — greedy and
+        seeded."""
+        prompts = {0: [11, 12, 13, 14, 15], 1: [21, 22, 23]}
+        for sp, rng in ((SamplingParams(max_new_tokens=1 << 30), None),
+                        (SamplingParams(temperature=0.8, top_k=40,
+                                        max_new_tokens=1 << 30),
+                         jax.random.PRNGKey(13))):
+            ref, _ = drive(make_engine(model), dict(prompts), n_tok=5,
+                           sampling=sp, rng=rng)
+            src = make_engine(model)
+            done = {0: [], 1: []}
+            for u, p in prompts.items():
+                src.put(u, list(p))
+            for _ in range(3):               # partway through both
+                for u, t in src.step(rng=rng, sampling=sp).items():
+                    done[u].append(t)
+                    src.put(u, [t])
+            snap = src.drain(deadline_ms=30_000.0)
+            assert set(snap["shed_uids"]) == {0, 1}
+            dst = make_engine(model)
+            dst.put(9, [1, 2, 3])            # dst already has traffic
+            recs = {r["uid"]: r for r in snap["requests"]}
+            dst.load_snapshot(
+                {"version": 2,
+                 "requests": [recs[u] for u in snap["shed_uids"]]},
+                merge=True)
+            active = {0, 1}
+            n = 0
+            while active:
+                n += 1
+                assert n < 200, "re-placed drive wedged"
+                for u, t in dst.step(rng=rng, sampling=sp).items():
+                    if u not in active:
+                        continue
+                    done[u].append(t)
+                    if len(done[u]) >= 5:
+                        active.discard(u)
+                        dst.flush(u)
+                    else:
+                        dst.put(u, [t])
+            assert done == ref, "re-placed drained stream diverged"
 
 
 # --------------------------------------------------------------------------

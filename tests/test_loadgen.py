@@ -16,8 +16,8 @@ import json
 import pytest
 
 from tools.loadgen import (Fault, Request, build_engine, chaos_smoke,
-                           default_faults, make_trace, replay, run_sweep,
-                           smoke, summarize)
+                           default_faults, fleet_chaos_smoke, make_trace,
+                           replay, run_sweep, smoke, summarize)
 
 
 def test_make_trace_deterministic():
@@ -161,6 +161,49 @@ def test_chaos_anomaly_leg_hits_the_acceptance_bar(chaos_out):
     assert out["anomaly"]["summary"]["by_signal"].get(
         "step_interval_ms", 0) >= 1
     json.dumps(out["anomaly"])
+
+
+@pytest.fixture(scope="module")
+def fleet_chaos_out():
+    """One fleet chaos run shared by the assertions below (4 variants x
+    3 replica engines + 1 reference per sampler is the expensive
+    part)."""
+    return fleet_chaos_smoke(seed=0)
+
+
+def test_fleet_chaos_smoke_is_the_acceptance_check(fleet_chaos_out):
+    """The replica-fleet chaos bar (docs/SERVING.md "Fleet: routing,
+    failover, migration"), identical to
+    ``python -m tools.loadgen --fleet-chaos``: a 3-replica router runs
+    one seeded shared-prefix trace while a replica is quarantined
+    (circuit breaker), a request is live-migrated, and a replica is
+    KILLED mid-traffic — under greedy/seeded x prefix cache on/off.
+    Zero requests lost (every request exactly one fleet-terminal
+    status), unaffected AND migrated requests keep exact token parity
+    with a fault-free single-engine run, and the quarantined replica
+    is re-admitted after a clean probe."""
+    out = fleet_chaos_out
+    assert out["ok"] and all(out["checks"].values())
+    for name, var in out["variants"].items():
+        assert var["failovers"] == 1, name
+        assert var["migrations"] >= 2, name
+        assert var["quarantines"] >= 1, name
+        assert var["readmissions"] >= 1, name
+        # zero lost: every request finished exactly once
+        assert var["statuses"] == {"finished": 10}, name
+        # placement actually spread the fleet (not one hot replica)
+        assert len([p for p in var["placements"] if p]) >= 2, name
+    json.dumps(out)
+
+
+def test_fleet_chaos_covers_all_variants(fleet_chaos_out):
+    assert set(fleet_chaos_out["variants"]) == {
+        "greedy_cache_on", "greedy_cache_off",
+        "seeded_cache_on", "seeded_cache_off"}
+    # the cache-on variants actually exercised prefix hits (the
+    # shared-prefix trace is doing its job)
+    assert fleet_chaos_out["checks"]["greedy_cache_on_cache_hit"]
+    assert fleet_chaos_out["checks"]["seeded_cache_on_cache_hit"]
 
 
 def test_replay_restart_needs_factory():

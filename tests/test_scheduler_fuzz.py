@@ -487,6 +487,145 @@ def test_failure_fuzz_invariants(model, seed):
     assert not eng.requests.open, "open records after full drain"
 
 
+@pytest.mark.parametrize("seed", range(3))
+def test_fleet_fuzz_invariants(model, seed):
+    """Fleet-op fuzz (docs/SERVING.md "Fleet: routing, failover,
+    migration"), host-only like the other seeds: random puts routed by
+    affinity over 3 tiny replicas interleaved with per-replica
+    scheduler rounds, replica KILLS (host-marked dead -> failover
+    migration) answered by fresh scale-ups, targeted live MIGRATIONS,
+    breaker QUARANTINE/probe walks, flushes and cancels — asserting
+    after EVERY op that each live replica's allocator partition and
+    refcounts hold, no lifecycle record leaks, and every fleet-open
+    request is owned by exactly ONE live replica (migration can never
+    double-run a request).  At the end everything closes through a
+    real exit path: no request the fleet admitted is ever lost."""
+    from deepspeed_tpu.serving import FleetConfig, FleetRouter
+    from tools.loadgen import check_fleet_invariants
+
+    r = np.random.RandomState(1700 + seed)
+
+    def build():
+        return InferenceEngine(model, InferenceConfig(
+            token_budget=16, max_seqs=3, kv_block_size=8,
+            num_kv_blocks=10, max_seq_len=48, prefix_cache="on"))
+
+    router = FleetRouter({f"r{i}": build() for i in range(3)},
+                         FleetConfig(failure_threshold=2,
+                                     probe_interval_steps=2,
+                                     max_migration_retries=4))
+    prefixes = [list(r.randint(1, 128, n)) for n in (8, 16, 24)]
+    next_uid = 0
+    spawned = 3
+    kills = migrations = 0
+    admitted: set = set()
+
+    def live_reps():
+        return [n for n in router.replica_names
+                if not router.replica(n).dead]
+
+    def check():
+        # the shared fleet chaos bar (ownership uniqueness, no record
+        # leaks, allocator partition, owner map never dead) ...
+        check_fleet_invariants(router)
+        # ... plus this fuzz's deeper per-engine accounting
+        for name in live_reps():
+            _check_pool_accounting(router.replica(name).engine)
+
+    for _ in range(250):
+        op = r.randint(10)
+        router._steps += 1        # host-only: advance the step clock
+        if op in (0, 1):                     # routed put (shared/unique)
+            p = prefixes[r.randint(len(prefixes))] if r.randint(2) \
+                else list(r.randint(1, 128, r.randint(1, 30)))
+            v = router.put(next_uid, list(p),
+                           priority=int(r.randint(0, 3)))
+            if v.admitted:
+                admitted.add(next_uid)
+            next_uid += 1
+        elif op == 2 and router._owner:      # decode continuation
+            uid = sorted(router._owner)[r.randint(len(router._owner))]
+            owner = router._owner[uid]
+            if not router.replica(owner).engine._pending.get(uid):
+                router.put(uid, [int(r.randint(1, 128))])
+        elif op == 3 and router._owner:      # flush a random open req
+            uid = sorted(router._owner)[r.randint(len(router._owner))]
+            router.flush(uid)
+        elif op == 4 and next_uid:           # cancel, any state
+            router.cancel(int(r.randint(next_uid)))
+        elif op == 5 and len(live_reps()) > 1 and kills < 4:
+            # KILL: host-marked dead (no dispatch in this fuzz), the
+            # router fails over its open work, a fresh replica joins
+            name = live_reps()[r.randint(len(live_reps()))]
+            router.replica(name).engine._health = "dead"
+            router._failover(name)
+            kills += 1
+            router.add_replica(f"s{spawned}", build())
+            spawned += 1
+        elif op == 6 and router._owner:      # targeted live migration
+            uid = sorted(router._owner)[r.randint(len(router._owner))]
+            owner = router._owner[uid]
+            eng = router.replica(owner).engine
+            if uid in eng.state.seqs:
+                migrations += router.migrate([uid], owner)
+        elif op == 7:                        # breaker quarantine walk
+            name = live_reps()[r.randint(len(live_reps()))]
+            b = router.replica(name).breaker
+            for _ in range(b.threshold):
+                b.record_failure(router._steps)
+            assert not b.routable
+        else:                                # scheduler round, 1 replica
+            name = live_reps()[r.randint(len(live_reps()))]
+            eng = router.replica(name).engine
+            sched = eng._schedule()
+            _check_invariants(eng, sched)
+            if sched:
+                eng.state.build_batch(sched, eng.icfg.token_budget,
+                                      stager=eng._stager)
+        # probe/re-admit pass + migration pump ride the step clock
+        for name in live_reps():
+            b = router.replica(name).breaker
+            b.tick(router._steps)
+            if b.state == "half_open" and r.randint(2):
+                b.record_success()           # a clean probe
+        router._pump_migrations()
+        check()
+    assert kills > 0, "fuzz never killed a replica"
+    assert migrations > 0, "fuzz never live-migrated a request"
+    # close out: every open request finishes through a real exit path,
+    # and every admitted request reached exactly one terminal status
+    for uid in list(router._owner):
+        router.flush(uid)
+    deadline = 0
+    while router._migrations:
+        deadline += 1
+        assert deadline < 200, "migration queue never drained"
+        router._steps += 1
+        for name in live_reps():
+            b = router.replica(name).breaker
+            b.tick(router._steps)
+            if b.state == "half_open":
+                b.record_success()
+        router._pump_migrations()
+    for uid in list(router._owner):
+        router.flush(uid)
+    router.drain_reaped()
+    for name in live_reps():
+        eng = router.replica(name).engine
+        for uid in list(eng.requests.open):
+            eng.flush(uid)
+        al = eng.state.allocator
+        al.assert_invariants()
+        assert al.referenced_blocks == 0
+        assert al.free_blocks == al.total_blocks
+    for uid in admitted:
+        s = router.query(uid)["status"]
+        assert s in ("finished", "shed", "cancelled", "released",
+                     "failed", "deadline_exceeded",
+                     "context_exhausted", "forgotten"), \
+            f"uid {uid} lost with status {s!r}"
+
+
 def test_preempt_resume_prefix_cache_parity(model):
     """Seeded-sampling parity across preemption-by-eviction WITH the
     prefix cache doing the resume: the victim's evicted blocks retire

@@ -22,6 +22,8 @@ CLI::
 
     python -m tools.loadgen --smoke              # tier-1 deterministic leg
     python -m tools.loadgen --chaos              # failure-domain leg
+    python -m tools.loadgen --fleet-chaos        # replica-fleet chaos leg
+    python -m tools.loadgen --fleet-bench        # 1-vs-3-replica sweep
     python -m tools.loadgen --qps 0.5,2,8 --requests 64 --arrival bursty \
         --shed-policy evict-lowest --out slo.json
 
@@ -79,13 +81,25 @@ class Fault:
     to engine-dead when repeated), ``poison`` (EVERY batch containing
     ``uid`` crashes until the quarantine isolates it to terminal
     status ``failed``), and ``restart`` (``snapshot()`` the engine and
-    resume the work on a fresh one — the warm-restart drill)."""
+    resume the work on a fresh one — the warm-restart drill).
+
+    Fleet kinds (docs/SERVING.md "Fleet: routing, failover,
+    migration"; ``replay_fleet`` only — ``replica`` names the target,
+    None picks the busiest routable one): ``kill`` (the replica's next
+    dispatch is fatal — the router must fail over and migrate its open
+    work), ``quarantine`` (``failure_threshold`` consecutive transient
+    step failures — the circuit breaker must trip and later re-admit
+    after a clean probe), ``migrate`` (live-migrate the oldest live
+    request off the busiest replica), ``scale_down`` (drain the
+    replica and re-place its shed set), and ``scale_up`` (add a fresh
+    replica from the factory)."""
     kind: str
     step: int
     duration: int = 4
     frac: float = 0.75
     ms: float = 0.0
     uid: Optional[int] = None        # poison target (None: oldest live)
+    replica: Optional[str] = None    # fleet-fault target (None: busiest)
 
 
 def make_trace(seed: int = 0, n_requests: int = 32, qps: float = 2.0,
@@ -711,6 +725,437 @@ def chaos_smoke(seed: int = 0) -> Dict:
 
 
 # --------------------------------------------------------------------------
+# fleet: multi-replica routing, failover, migration
+# --------------------------------------------------------------------------
+
+def build_fleet(n_replicas: int = 3, model=None, fleet_cfg=None,
+                **engine_kw):
+    """A :class:`~deepspeed_tpu.serving.FleetRouter` over ``n_replicas``
+    tiny engines sharing one model (names ``r0..``); engine keywords
+    ride through :func:`build_engine`, fleet knobs through
+    ``fleet_cfg`` (a :class:`FleetConfig` — None takes the defaults)."""
+    from deepspeed_tpu.serving import FleetRouter
+
+    engines = {}
+    for i in range(n_replicas):
+        eng, model = build_engine(model=model, **engine_kw)
+        engines[f"r{i}"] = eng
+    return FleetRouter(engines, fleet_cfg), model
+
+
+def check_fleet_invariants(router) -> None:
+    """The fleet chaos bar, shared by ``replay_fleet`` and the
+    scheduler-fuzz fleet seeds (ONE implementation — a new invariant
+    added here guards both harnesses): per live replica the allocator
+    partition holds and no lifecycle record leaks; fleet-wide, every
+    open request is owned by exactly ONE live replica (migration can
+    never double-run a request) and the owner map never points at a
+    dead replica."""
+    owned: Dict[int, str] = {}
+    for name in router.replica_names:
+        rep = router.replica(name)
+        if rep.dead:
+            continue
+        eng = rep.engine
+        eng.state.allocator.assert_invariants()
+        for uid in eng.requests.open:
+            assert uid in eng.state.seqs or eng._pending.get(uid) \
+                or uid in eng._meta, \
+                f"leaked open record for uid {uid} on {name}"
+            assert uid not in owned, \
+                f"uid {uid} open on BOTH {owned[uid]} and {name} — " \
+                "a migrated request double-runs"
+            owned[uid] = name
+    for uid, name in router._owner.items():
+        assert not router.replica(name).dead, \
+            f"uid {uid} owned by dead replica {name}"
+
+
+def _busiest_routable(router) -> Optional[str]:
+    """Deterministic fleet-fault target: the routable replica with the
+    most live+queued work (ties break by name)."""
+    best = None
+    for name in router.replica_names:
+        rep = router.replica(name)
+        if not rep.routable():
+            continue
+        key = (-rep.load(), name)
+        if best is None or key < best[0]:
+            best = (key, name)
+    return None if best is None else best[1]
+
+
+def replay_fleet(router, trace: List[Request],
+                 faults: Optional[List[Fault]] = None,
+                 sampling=None, rng=None, max_steps: int = 5000,
+                 check_invariants: bool = False,
+                 replica_factory=None) -> Dict:
+    """Drive a :class:`FleetRouter` through ``trace`` exactly the way
+    :func:`replay` drives one engine — the router IS engine-shaped —
+    with the fleet fault kinds (``kill`` / ``quarantine`` /
+    ``migrate`` / ``scale_down`` / ``scale_up``) plus ``cancel`` /
+    ``latency_spike`` applied at their step indices.
+
+    ``check_invariants`` asserts the fleet chaos bar after EVERY step:
+    each live replica's allocator partition holds, no lifecycle record
+    leaks, and every open request is owned by exactly ONE live replica
+    (migration can never double-run a request).
+
+    Returns the same bookkeeping as :func:`replay` plus wall-clock
+    ``ttft_ms`` per uid and the router itself under ``"router"``."""
+    from deepspeed_tpu.inference import SamplingParams
+
+    sampling = sampling or SamplingParams(max_new_tokens=1 << 30)
+    faults = faults or []
+    arrivals: Dict[int, List[Request]] = {}
+    for q in trace:
+        arrivals.setdefault(q.step, []).append(q)
+    by_uid = {q.uid: q for q in trace}
+    fault_at: Dict[int, List[Fault]] = {}
+    for f in faults:
+        fault_at.setdefault(f.step, []).append(f)
+    last_arrival = max(arrivals) if arrivals else 0
+    remaining: Dict[int, int] = {}
+    verdicts: Dict[int, str] = {}
+    placements: Dict[int, Optional[str]] = {}
+    ttft_steps: Dict[int, int] = {}
+    ttft_ms: Dict[int, float] = {}
+    t_arrive: Dict[int, float] = {}
+    tokens: Dict[int, List[int]] = {}
+    faults_fired = 0
+    scale_ups = 0
+
+    def pick(f: Fault) -> Optional[str]:
+        return f.replica if f.replica is not None \
+            else _busiest_routable(router)
+
+    step = 0
+    while step <= last_arrival or remaining:
+        for q in arrivals.get(step, ()):
+            t_arrive[q.uid] = time.perf_counter()
+            v = router.put(q.uid, q.prompt, priority=q.priority,
+                           deadline_ms=q.deadline_ms)
+            verdicts[q.uid] = v.status
+            placements[q.uid] = v.replica
+            if v.admitted:
+                remaining[q.uid] = q.max_new
+        for f in fault_at.get(step, ()):
+            faults_fired += 1
+            if f.kind == "kill":
+                name = pick(f)
+                if name is not None:
+                    router.replica(name).engine.failures.inject("fatal")
+            elif f.kind == "quarantine":
+                name = pick(f)
+                if name is not None:
+                    router.replica(name).engine.failures.inject(
+                        "transient", n=router.cfg.failure_threshold)
+            elif f.kind == "migrate":
+                name = pick(f)
+                if name is not None:
+                    live = sorted(
+                        router.replica(name).engine.state.seqs)
+                    if live:
+                        router.migrate([live[0]], name)
+            elif f.kind == "scale_down":
+                name = pick(f)
+                if name is not None:
+                    router.scale_down(name, deadline_ms=30_000.0,
+                                      sampling=sampling, rng=rng)
+            elif f.kind == "scale_up":
+                if replica_factory is None:
+                    raise ValueError(
+                        "scale_up fault needs a replica_factory")
+                scale_ups += 1
+                router.add_replica(f"up{scale_ups}", replica_factory())
+            elif f.kind == "latency_spike":
+                time.sleep(f.ms / 1e3)
+            elif f.kind == "cancel":
+                live = sorted(u for u in remaining
+                              if router.query(u)["status"] in
+                              ("running", "queued", "migrating"))
+                if live:
+                    router.cancel(live[0])
+                    remaining.pop(live[0], None)
+            else:
+                raise ValueError(
+                    f"unknown fleet fault kind {f.kind!r}")
+        outs = router.step(rng=rng, sampling=sampling)
+        for uid in router.drain_reaped():
+            remaining.pop(uid, None)
+        for uid, tok in outs.items():
+            tokens.setdefault(uid, []).append(int(tok))
+            if uid not in remaining:
+                continue
+            ttft_steps.setdefault(uid, step - by_uid[uid].step)
+            ttft_ms.setdefault(
+                uid, (time.perf_counter() - t_arrive[uid]) * 1e3)
+            remaining[uid] -= 1
+            if remaining[uid] <= 0:
+                del remaining[uid]
+                router.flush(uid)
+            else:
+                router.put(uid, [tok])
+        if check_invariants:
+            check_fleet_invariants(router)
+        step += 1
+        if step > max_steps:
+            raise RuntimeError(
+                f"fleet replay did not drain in {max_steps} steps "
+                f"({len(remaining)} requests still owed tokens)")
+    return {
+        "steps": step,
+        "verdicts": verdicts,
+        "placements": placements,
+        "ttft_steps": ttft_steps,
+        "ttft_ms": ttft_ms,
+        "tokens": tokens,
+        "faults_fired": faults_fired,
+        "status": {q.uid: router.query(q.uid)["status"] for q in trace},
+        "router": router,
+    }
+
+
+def fleet_chaos_smoke(seed: int = 0) -> Dict:
+    """The replica-fleet acceptance bar (docs/SERVING.md "Fleet:
+    routing, failover, migration"): one seeded shared-prefix trace
+    through a 3-replica router while a replica is QUARANTINED
+    (consecutive transient failures -> circuit breaker), one request is
+    LIVE-MIGRATED between replicas, and a replica is KILLED mid-traffic
+    — under greedy/seeded sampling x prefix cache on/off.  Asserts:
+
+    * zero requests lost: every request reaches exactly ONE fleet-level
+      terminal status (all ``finished`` here — the fleet never sheds
+      while a routable replica has room, and every record is exact);
+    * unaffected AND migrated requests keep EXACT token parity with a
+      fault-free single-engine run (the (uid, position)-folded keys
+      make placement, quarantine detours, migration, and failover all
+      invisible in the output);
+    * the quarantined replica is re-admitted after a clean probe
+      (breaker walks open -> half_open -> closed; counted);
+    * per-step: allocator partition per live replica, no record leaks,
+      and single-ownership of every open request."""
+    import jax
+
+    from deepspeed_tpu.inference import FailureConfig, SamplingParams
+    from deepspeed_tpu.serving import FleetConfig
+
+    r = np.random.RandomState(seed + 11)
+    shared = [int(x) for x in r.randint(1, 120, 16)]
+    trace = make_trace(seed=seed, n_requests=10, qps=20.0,
+                       arrival="bursty", prompt_lens=(4, 18),
+                       out_lens=(3, 5), tiers=(0, 1))
+    for i, q in enumerate(trace):
+        if i % 2 == 0:
+            # a shared 2-block prefix: cache-on variants get real hits
+            # and affinity placement has something to score
+            q.prompt = shared + q.prompt[:6]
+    last = max(q.step for q in trace)
+    mid = last // 2 + 1
+    faults = [Fault("quarantine", step=1),
+              Fault("migrate", step=mid),
+              Fault("kill", step=mid + 1)]
+    model_box: list = []
+
+    def eng_factory(cache):
+        eng, m = build_engine(
+            None, model=model_box[0] if model_box else None,
+            prefix_cache=cache,
+            failure=FailureConfig(dispatch_timeout_ms=None))
+        if not model_box:
+            model_box.append(m)
+        return eng
+
+    samplers = {
+        "greedy": (SamplingParams(max_new_tokens=1 << 30), None),
+        "seeded": (SamplingParams(temperature=0.8, top_k=40,
+                                  max_new_tokens=1 << 30),
+                   jax.random.PRNGKey(17)),
+    }
+    # fault-free SINGLE-ENGINE reference per sampler: fleet placement,
+    # migration, and failover must all be invisible in the streams
+    refs = {}
+    for mode, (sp, rng) in samplers.items():
+        refs[mode] = replay(eng_factory("on"), trace, [], sampling=sp,
+                            rng=rng)["tokens"]
+    out = {"variants": {}}
+    checks: Dict[str, bool] = {}
+    for mode, cache in [("greedy", "on"), ("greedy", "off"),
+                        ("seeded", "on"), ("seeded", "off")]:
+        sp, rng = samplers[mode]
+        name = f"{mode}_cache_{cache}"
+        router, _ = build_fleet(
+            3, model=model_box[0],
+            fleet_cfg=FleetConfig(failure_threshold=2,
+                                  probe_interval_steps=3),
+            prefix_cache=cache,
+            failure=FailureConfig(dispatch_timeout_ms=None))
+        res = replay_fleet(router, trace, list(faults), sampling=sp,
+                           rng=rng, check_invariants=True)
+        h = router.health()
+        # zero lost: every request exactly one terminal status, and —
+        # every record being exact on this trace — all finished
+        checks[f"{name}_all_terminal"] = all(
+            s == "finished" for s in res["status"].values())
+        checks[f"{name}_parity"] = all(
+            res["tokens"].get(q.uid, []) == refs[mode].get(q.uid, [])
+            for q in trace)
+        checks[f"{name}_failover"] = h["failovers"] == 1
+        checks[f"{name}_migrated"] = h["migrations"] >= 2
+        # the breaker walked open -> half_open -> closed on a probe
+        readmitted = any(
+            router.replica(n).breaker.readmissions >= 1
+            and router.replica(n).breaker.state == "closed"
+            for n in router.replica_names)
+        checks[f"{name}_quarantine_readmitted"] = readmitted \
+            and h["routable"] >= 1 \
+            and int(router.metrics.get(
+                "serving_fleet_quarantines_total").value()) >= 1
+        # survivors fully reclaimed their pools
+        clean = True
+        for n in router.replica_names:
+            rep = router.replica(n)
+            if rep.dead:
+                continue
+            al = rep.engine.state.allocator
+            al.assert_invariants()
+            clean &= al.free_blocks == al.total_blocks
+        checks[f"{name}_no_leak"] = clean
+        if cache == "on":
+            hits = sum(int(router.replica(n).engine.timings["prefix_hits"])
+                       for n in router.replica_names)
+            checks[f"{name}_cache_hit"] = hits > 0
+        out["variants"][name] = {
+            "steps": res["steps"],
+            "statuses": {s: list(res["status"].values()).count(s)
+                         for s in set(res["status"].values())},
+            "placements": {p: list(res["placements"].values()).count(p)
+                           for p in set(res["placements"].values())},
+            "failovers": h["failovers"],
+            "migrations": h["migrations"],
+            "quarantines": int(router.metrics.get(
+                "serving_fleet_quarantines_total").value()),
+            "readmissions": int(router.metrics.get(
+                "serving_fleet_readmissions_total").value()),
+        }
+    out["checks"] = checks
+    out["ok"] = all(checks.values())
+    if not out["ok"]:
+        raise AssertionError(
+            "fleet chaos smoke failed: "
+            f"{json.dumps({k: v for k, v in checks.items() if not v})}")
+    return out
+
+
+def _fleet_prefix_trace(seed: int, n_requests: int, n_families: int = 3,
+                        prefix_blocks: int = 4, block: int = 8,
+                        max_new: int = 4) -> List[Request]:
+    """Shared-prefix fleet workload: requests cycle through
+    ``n_families`` long common prefixes (each ``prefix_blocks`` KV
+    blocks) with unique tails, arriving ONE PER STEP so a family's
+    first prefill registers its blocks before the next family member
+    is placed — the regime cache-affinity routing exists for."""
+    r = np.random.RandomState(seed + 23)
+    fams = [[int(x) for x in r.randint(1, 120, prefix_blocks * block)]
+            for _ in range(n_families)]
+    out = []
+    for i in range(n_requests):
+        # family choice is RANDOM (seeded), not cyclic: a deterministic
+        # family cycle can alias with a round-robin cursor of the same
+        # period and hand the baseline accidental perfect affinity
+        fam = fams[i % n_families if i < n_families
+                   else int(r.randint(n_families))]
+        tail = [int(x) for x in r.randint(1, 120, 2 + i % 3)]
+        out.append(Request(uid=i, step=i, prompt=list(fam) + tail,
+                           max_new=max_new))
+    return out
+
+
+def fleet_bench(seed: int = 0, n_requests: int = 18) -> Dict:
+    """The BENCH fleet leg (docs/SERVING.md "Fleet: routing, failover,
+    migration"): one shared-prefix workload through (a) a single
+    replica, (b) a 3-replica fleet under cache-affinity placement with
+    a mid-sweep replica kill, and (c) the same fleet under round-robin
+    placement — the affinity bar's baseline.  Records goodput (emitted
+    tok/s of wall), the measured prefix hit rate (cached / prompt
+    tokens summed over replicas — engine truth, not placement-time
+    guesses), failover/migration counts, and p95 TTFT for requests
+    arriving before vs after the kill."""
+    from deepspeed_tpu.inference import FailureConfig, SamplingParams
+
+    sp = SamplingParams(max_new_tokens=1 << 30)
+    trace = _fleet_prefix_trace(seed, n_requests)
+    kill_step = n_requests // 2
+
+    model_box: list = []
+
+    warm_uid = [90_000]
+
+    def eng_factory():
+        eng, m = build_engine(
+            None, model=model_box[0] if model_box else None,
+            prefix_cache="on", num_kv_blocks=48, max_seq_len=96,
+            failure=FailureConfig(dispatch_timeout_ms=None))
+        if not model_box:
+            model_box.append(m)
+        # warm the serving programs OUTSIDE the timed window (a unique
+        # prompt at the workload's context bucket), then reset the
+        # engine's metrics so goodput/TTFT/hit-rate measure steady
+        # state — the same warmup-then-reset discipline as the other
+        # bench legs
+        warm_uid[0] += 1
+        r = np.random.RandomState(warm_uid[0])
+        replay(eng, [Request(uid=warm_uid[0], step=0,
+                             prompt=[int(x) for x in r.randint(1, 120, 36)],
+                             max_new=2)], [], sampling=sp)
+        eng.reset_metrics()
+        return eng
+
+    def run(n_replicas, placement, with_kill):
+        from deepspeed_tpu.serving import FleetConfig, FleetRouter
+        router = FleetRouter(
+            {f"r{i}": eng_factory() for i in range(n_replicas)},
+            FleetConfig(placement=placement))
+        faults = [Fault("kill", step=kill_step)] if with_kill else []
+        t0 = time.perf_counter()
+        res = replay_fleet(router, trace, faults, sampling=sp)
+        wall = time.perf_counter() - t0
+        n_tok = sum(len(t) for t in res["tokens"].values())
+        prompt = sum(int(router.replica(n).engine.timings["prompt_tokens"])
+                     for n in router.replica_names)
+        cached = sum(int(router.replica(n).engine.timings["cached_tokens"])
+                     for n in router.replica_names)
+        arrive = {q.uid: q.step for q in trace}
+        pre = [ms for u, ms in res["ttft_ms"].items()
+               if arrive[u] < kill_step]
+        post = [ms for u, ms in res["ttft_ms"].items()
+                if arrive[u] >= kill_step]
+        h = router.health()
+        return {
+            "replicas": n_replicas,
+            "placement": placement,
+            "goodput_tok_s": round(n_tok / max(wall, 1e-9), 2),
+            "finished": sum(1 for s in res["status"].values()
+                            if s == "finished"),
+            "hit_rate": round(cached / prompt, 4) if prompt else 0.0,
+            "failovers": h["failovers"],
+            "migrations": h["migrations"],
+            "ttft_p95_prekill_ms": _pct(pre, 95),
+            "ttft_p95_postkill_ms": _pct(post, 95),
+            "placement_hit_rate": router.metrics.snapshot().get(
+                "serving_fleet_placement_hit_rate"),
+        }
+
+    single = run(1, "affinity", with_kill=False)
+    affinity = run(3, "affinity", with_kill=True)
+    rr = run(3, "round_robin", with_kill=True)
+    return {"seed": seed, "requests": n_requests,
+            "kill_step": kill_step,
+            "single": single, "affinity": affinity,
+            "round_robin": rr}
+
+
+# --------------------------------------------------------------------------
 
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
@@ -721,6 +1166,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--chaos", action="store_true",
                     help="chaos acceptance leg: crash/hang/poison/"
                     "restart faults, parity vs a fault-free run")
+    ap.add_argument("--fleet-chaos", action="store_true",
+                    help="replica-fleet chaos leg: quarantine + live "
+                    "migration + mid-traffic replica kill, parity vs a "
+                    "fault-free single-engine run")
+    ap.add_argument("--fleet-bench", action="store_true",
+                    help="fleet bench sweep: 1 vs 3 replicas with a "
+                    "mid-sweep kill, affinity vs round-robin")
     ap.add_argument("--qps", default="0.5,2,8",
                     help="comma-separated offered rates to sweep")
     ap.add_argument("--requests", type=int, default=32)
@@ -733,7 +1185,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--out", default=None, metavar="OUT.json")
     args = ap.parse_args(argv)
 
-    if args.chaos:
+    if args.fleet_chaos:
+        result = fleet_chaos_smoke(args.seed)
+    elif args.fleet_bench:
+        result = fleet_bench(args.seed)
+    elif args.chaos:
         result = chaos_smoke(args.seed)
     elif args.smoke:
         result = smoke(args.seed)
