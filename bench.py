@@ -379,7 +379,13 @@ def fleet_serving_bench(on_tpu: bool):
             "fleet_ttft_p95_prekill_ms":
                 out["affinity"]["ttft_p95_prekill_ms"],
             "fleet_ttft_p95_postkill_ms":
-                out["affinity"]["ttft_p95_postkill_ms"]}
+                out["affinity"]["ttft_p95_postkill_ms"],
+            # fleet observability diagnostics (docs/OBSERVABILITY.md
+            # "Fleet observability"): fleet + per-replica anomaly
+            # tallies (benchdiff REPORTS their deltas, never gates)
+            # and the aggregated fleet device metrics
+            "fleet_serving_anomalies": out["affinity"]["anomalies"],
+            "fleet_device_metrics": out["affinity"]["device_metrics"]}
 
 
 def moe_train_bench(on_tpu: bool, peak: float):

@@ -9,6 +9,13 @@ replicas as restore()-compatible per-request records
 (``engine.snapshot_requests`` / ``migrate_out`` /
 ``load_snapshot(merge=True)``)."""
 
+from .fleet_telemetry import (FLEET_DUMP_VERSION, FleetRegistry,
+                              FleetTelemetry, FleetTelemetryConfig,
+                              default_fleet_detectors,
+                              fleet_request_metrics,
+                              fleet_request_records,
+                              reconciled_terminal_statuses,
+                              validate_fleet_dump)
 from .placement import (PLACEMENT_POLICIES, affinity_chain_len,
                         prompt_digests, rank_replicas)
 from .replica import CircuitBreaker, ReplicaHandle
@@ -16,4 +23,8 @@ from .router import FleetConfig, FleetRouter
 
 __all__ = ["FleetConfig", "FleetRouter", "ReplicaHandle",
            "CircuitBreaker", "PLACEMENT_POLICIES", "prompt_digests",
-           "affinity_chain_len", "rank_replicas"]
+           "affinity_chain_len", "rank_replicas",
+           "FleetTelemetry", "FleetTelemetryConfig", "FleetRegistry",
+           "default_fleet_detectors", "fleet_request_metrics",
+           "fleet_request_records", "reconciled_terminal_statuses",
+           "validate_fleet_dump", "FLEET_DUMP_VERSION"]

@@ -45,6 +45,8 @@ chaos replays stay machine-independent (the same discipline tpulint's
 from __future__ import annotations
 
 import dataclasses
+import os
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..inference import SamplingParams
@@ -52,8 +54,11 @@ from ..inference.engine import InferenceEngine
 from ..inference.failures import EngineDeadError
 from ..inference.overload import AdmissionVerdict
 from ..inference.ragged.state import iter_prefix_chain_digests
-from ..telemetry import MetricsRegistry
+from ..telemetry import FlightRecorder, MetricsRegistry, config_fingerprint
 from ..utils.logging import logger
+from .fleet_telemetry import (FLEET_DUMP_VERSION, NOOP_CTX, FleetRegistry,
+                              FleetTelemetry, FleetTelemetryConfig,
+                              fleet_request_metrics)
 from .placement import PLACEMENT_POLICIES, rank_replicas
 from .replica import ReplicaHandle
 
@@ -81,11 +86,30 @@ class FleetConfig:
     # fleet level rather than parking it forever
     max_migration_retries: int = 8
     migration_backoff_steps: int = 1
+    # fleet observability plane (docs/OBSERVABILITY.md "Fleet
+    # observability"): "on" constructs the FleetTelemetry object
+    # (journeys, router spans, fleet anomaly detectors, capture
+    # budget); "off" constructs NOTHING and adds zero clock reads per
+    # router step (the counted PR-10 bar).  "auto" resolves OFF today
+    # — ROADMAP item 3's signal-driven autoscaler is the intended
+    # flipper, exactly like the engines' anomaly/device_telemetry
+    telemetry: str = "auto"
+    telemetry_cfg: Optional[FleetTelemetryConfig] = None
+    # fleet post-mortem bundles: router.debug_dump() target for the
+    # failover/quarantine/fleet-shed auto-dumps (None = no auto-dumps),
+    # bounded by max_autodumps per router generation
+    flight_dir: Optional[str] = None
+    max_autodumps: int = 8
 
     def __post_init__(self):
         if self.placement not in PLACEMENT_POLICIES:
             raise ValueError(f"placement={self.placement!r}: expected "
                              f"one of {PLACEMENT_POLICIES}")
+        if self.telemetry not in ("auto", "on", "off"):
+            raise ValueError(f"telemetry={self.telemetry!r}: expected "
+                             "'auto', 'on', or 'off'")
+        if self.max_autodumps < 0:
+            raise ValueError("max_autodumps must be >= 0")
         if self.failure_threshold < 1:
             raise ValueError("failure_threshold must be >= 1")
         if self.probe_interval_steps < 1:
@@ -123,7 +147,31 @@ class FleetRouter:
         self._migrations: List[_Migration] = []
         self._steps = 0
         self._rr = 0                          # round-robin cursor
+        # reconciliation ledgers (docs/OBSERVABILITY.md "Fleet
+        # observability"): per-(uid, replica) phantom-shed counts
+        # (engine shed closures that were fleet routing retries —
+        # bounded FIFO), fleet-level closures that left NO engine
+        # terminal, and record-gap closures that left NO engine record
+        # at all (fleet_request_metrics adds them to its tally)
+        self._phantoms: Dict[Tuple[int, str], int] = {}
+        self._fleet_closures: Dict[str, int] = {}
+        self._record_gaps: Dict[str, int] = {}
         self._setup_metrics()
+        # the black box is ALWAYS constructed (engine discipline: the
+        # happy path never touches it; the failure path's breadcrumbs
+        # must exist before the incident someone debugs).  Placement
+        # decisions are noted only when the telemetry plane is on
+        self.flight = FlightRecorder()
+        self._autodumps = 0
+        tmode = self.cfg.telemetry
+        # "auto" resolves OFF today — the signal consumer (ROADMAP
+        # item 3's autoscaler) is the flipper, like the engines' gates
+        self._ftel: Optional[FleetTelemetry] = FleetTelemetry(
+            self.cfg.telemetry_cfg, self.metrics) \
+            if tmode == "on" else None
+        # the fleet-wide exposition view; pull-only, so constructing
+        # it costs nothing on the serving path
+        self.fleet_registry = FleetRegistry(self)
         items = replicas.items() if isinstance(replicas, dict) \
             else ((f"r{i}", e) for i, e in enumerate(replicas))
         for name, eng in items:
@@ -175,6 +223,11 @@ class FleetRouter:
             "requests closed 'failed' at the fleet level (inexact "
             "records whose device-side tokens died with a replica)",
             int_valued=True)
+        self._c_phantom = reg.counter(
+            "serving_fleet_replica_shed_retries_total",
+            "engine-level shed closures that were fleet routing "
+            "retries — phantom terminals the reconciled fleet rollups "
+            "subtract back out", int_valued=True)
         self._g_replicas = reg.gauge(
             "serving_fleet_replicas", "replicas registered (incl. dead)")
         self._g_routable = reg.gauge(
@@ -297,41 +350,129 @@ class FleetRouter:
                 m.rec["tokens"].extend(int(t) for t in tokens)
                 return AdmissionVerdict(True, "continued",
                                         reason="joined migration record")
-        order, scores = self._rank(tokens)
-        if self.cfg.placement == "round_robin" and order:
-            # the rotation cursor advances per ARRIVAL, here only —
-            # migration placements also rank (in _place_record) and
-            # must not skew the baseline's rotation over new requests
-            self._rr += 1
-        for name in order:
-            v = self._reps[name].engine.put(uid, tokens,
-                                            priority=priority,
-                                            deadline_ms=deadline_ms)
-            for eu in v.evicted_uids:
-                # evict-lowest backpressure shed a queued request on
-                # that replica: terminal at the fleet level too
-                self._closed[eu] = "shed"
-                self._owner.pop(eu, None)
-                self._reaped.add(eu)
-            if v.admitted:
-                self._owner[uid] = name
-                # a terminal uid that returns lives a full new life —
-                # the engine's own reuse semantics, mirrored.  The
-                # stale reaped entry goes too: a driver draining later
-                # must not drop the now-live request as closed
-                self._closed.pop(uid, None)
-                self._reaped.discard(uid)
-                self._c_placements.inc(policy=self.cfg.placement)
-                if scores.get(name, 0) > 0:
-                    self._c_place_hits.inc()
-                return v._replace(replica=name)
+        ft = self._ftel
+        if ft is not None:
+            # a revived uid (fleet-shed then re-admitted) gets a FRESH
+            # journey — the dead life's story must not leak into it
+            ft.begin_journey(uid)
+        with (ft.span("placement", uid=int(uid)) if ft is not None
+              else NOOP_CTX):
+            order, scores = self._rank(tokens)
+            if self.cfg.placement == "round_robin" and order:
+                # the rotation cursor advances per ARRIVAL, here only —
+                # migration placements also rank (in _place_record) and
+                # must not skew the baseline's rotation over new requests
+                self._rr += 1
+            for name in order:
+                v = self._reps[name].engine.put(uid, tokens,
+                                                priority=priority,
+                                                deadline_ms=deadline_ms)
+                for eu in v.evicted_uids:
+                    # evict-lowest backpressure shed a queued request on
+                    # that replica: terminal at the fleet level too
+                    self._closed[eu] = "shed"
+                    self._owner.pop(eu, None)
+                    self._reaped.add(eu)
+                    if ft is not None:
+                        ft.journey_event(eu, "closed", self._steps,
+                                         replica=name, status="shed",
+                                         reason="evicted by backpressure")
+                if v.admitted:
+                    self._owner[uid] = name
+                    # a terminal uid that returns lives a full new life —
+                    # the engine's own reuse semantics, mirrored.  The
+                    # stale reaped entry goes too: a driver draining later
+                    # must not drop the now-live request as closed
+                    self._closed.pop(uid, None)
+                    self._reaped.discard(uid)
+                    self._c_placements.inc(policy=self.cfg.placement)
+                    if scores.get(name, 0) > 0:
+                        self._c_place_hits.inc()
+                    if ft is not None:
+                        ft.last_placed = name
+                        ft.journey_event(
+                            uid, "placed", self._steps, replica=name,
+                            via="arrival", policy=self.cfg.placement,
+                            score=int(scores.get(name, 0)))
+                    return v._replace(replica=name)
+                # this replica shed a put the fleet will retry
+                # elsewhere: its engine-side terminal is a PHANTOM the
+                # reconciled fleet accounting subtracts back out
+                self._note_phantom(uid, name)
+                if ft is not None:
+                    ft.journey_event(uid, "replica_shed", self._steps,
+                                     replica=name, reason=v.reason)
         self._c_shed.inc()
+        self._fleet_closures["shed"] = \
+            self._fleet_closures.get("shed", 0) + 1
+        # a saturation shed leaves NO fleet-visible record (every
+        # engine record was a phantom): the record view adds it back
+        self._note_record_gap(uid, "shed")
         self._closed[uid] = "shed"
         self._reaped.add(uid)
+        self.flight.note("fleet_shed", uid=int(uid),
+                         routable=len(order))
+        if ft is not None:
+            ft.journey_event(uid, "closed", self._steps, status="shed",
+                             reason="fleet saturated" if order
+                             else "no routable replica")
+        self._autodump("fleet_shed")
         return AdmissionVerdict(
             False, "shed",
             reason="fleet saturated: every routable replica shed the "
                    "request" if order else "no routable replica")
+
+    def _life_has_hop(self, uid: int) -> bool:
+        """Whether ``uid``'s CURRENT fleet life still ends in a hop
+        record somewhere — a ``migrated`` close or a dead replica's
+        open record that the merged-record view will resolve with the
+        fleet status.  A fleet-level closure of a life WITH a hop is
+        already visible to ``fleet_request_metrics``; one WITHOUT (all
+        its engine records were phantom routing-retry sheds, or it
+        never held one) must be tallied in the record-gap ledger or
+        the record view undercounts.  Walks the same phantom-dropped
+        record chain the merged view builds (failure-path only — never
+        per step)."""
+        items = []
+        for name, rep in self._reps.items():
+            dead = rep.dead
+            for rec in rep.engine.requests.records():
+                if rec.uid == uid:
+                    items.append((rec.t_arrival, name, rec, dead))
+        items.sort(key=lambda e: e[0])
+        budget = {k: v for k, v in self._phantoms.items()
+                  if k[0] == uid}
+        last = None
+        for t, name, rec, dead in items:
+            if rec.status == "shed" and budget.get((uid, name), 0) > 0:
+                budget[(uid, name)] -= 1
+                continue
+            last = (rec, dead)
+        if last is None:
+            return False
+        rec, dead = last
+        return rec.status == "migrated" \
+            or (dead and rec.status == "open")
+
+    def _note_record_gap(self, uid: int, status: str) -> None:
+        """Tally a fleet-level closure the merged-record view cannot
+        see (no surviving record for the life)."""
+        if not self._life_has_hop(uid):
+            self._record_gaps[status] = \
+                self._record_gaps.get(status, 0) + 1
+
+    def _note_phantom(self, uid: int, name: str) -> None:
+        """One engine-level shed closure that was a fleet routing
+        retry, not a fleet terminal (put retried the next candidate, or
+        scale-down re-placed the drain's shed set).  Counted for the
+        reconciled rollups and remembered per (uid, replica) so the
+        merged record view can drop exactly those records; the map is
+        FIFO-bounded like the lifecycle tracker's forgotten set."""
+        self._c_phantom.inc()
+        key = (int(uid), name)
+        self._phantoms[key] = self._phantoms.get(key, 0) + 1
+        while len(self._phantoms) > 8192:
+            self._phantoms.pop(next(iter(self._phantoms)))
 
     def step(self, rng=None,
              sampling: SamplingParams = SamplingParams()
@@ -359,12 +500,27 @@ class FleetRouter:
             ev = rep.observe(self._steps)
             if ev == "opened":
                 self._c_quarantines.inc()
+                self.flight.note("quarantine", replica=name,
+                                 failures=rep.breaker.failures,
+                                 step=self._steps)
+                if self._ftel is not None:
+                    # every request riding the quarantined replica
+                    # carries the detour in its journey
+                    with self._ftel.span("quarantine", replica=name):
+                        for juid, own in self._owner.items():
+                            if own == name:
+                                self._ftel.journey_event(
+                                    juid, "quarantined", self._steps,
+                                    replica=name)
                 logger.warning(
                     "fleet: replica %s quarantined after %d consecutive "
                     "failing steps (probe in %d steps)", name,
                     rep.breaker.failures, self.cfg.probe_interval_steps)
+                self._autodump("quarantine")
             elif ev == "readmitted":
                 self._c_readmissions.inc()
+                self.flight.note("readmitted", replica=name,
+                                 step=self._steps)
                 logger.warning(
                     "fleet: replica %s re-admitted after a clean probe",
                     name)
@@ -373,6 +529,10 @@ class FleetRouter:
             outs.update(o)
         self._pump_migrations()
         self._refresh_gauges()
+        if self._ftel is not None:
+            # fleet anomaly signals ride the counters and integer
+            # loads this step already produced — no added clock reads
+            self._ftel.feed_step(self)
         return outs
 
     def flush(self, uid: int) -> None:
@@ -384,13 +544,31 @@ class FleetRouter:
         for i, m in enumerate(self._migrations):
             if m.rec["uid"] == uid:
                 del self._migrations[i]
-                self._closed[uid] = "finished"
+                self._close_queued(m, "finished")
                 return
         owner = self._owner.pop(uid, None)
         if owner is None:
             return
         self._reps[owner].engine.flush(uid)
         self._closed[uid] = "finished"
+        if self._ftel is not None:
+            self._ftel.journey_event(uid, "closed", self._steps,
+                                     replica=owner, status="finished")
+
+    def _close_queued(self, m: _Migration, status: str) -> None:
+        """A record settled while waiting in the migration queue: the
+        fleet closure has no engine terminal (the source closed it
+        ``migrated`` — or, for a scale-down record, a reconciled-away
+        ``shed``), so both reconciliation ledgers take it here."""
+        uid = int(m.rec["uid"])
+        self._closed[uid] = status
+        self._fleet_closures[status] = \
+            self._fleet_closures.get(status, 0) + 1
+        self._note_record_gap(uid, status)
+        if self._ftel is not None:
+            self._ftel.journey_event(uid, "closed", self._steps,
+                                     status=status,
+                                     reason="settled in migration queue")
 
     def cancel(self, uid: int) -> None:
         """Client abort, wherever the request is: owned by a replica,
@@ -398,7 +576,7 @@ class FleetRouter:
         for i, m in enumerate(self._migrations):
             if m.rec["uid"] == uid:
                 del self._migrations[i]
-                self._closed[uid] = "cancelled"
+                self._close_queued(m, "cancelled")
                 self._reaped.add(uid)
                 return
         owner = self._owner.pop(uid, None)
@@ -411,11 +589,25 @@ class FleetRouter:
                 self._note_engine_close(rep, ru)
         self._closed[uid] = "cancelled"
         self._reaped.add(uid)
+        if self._ftel is not None:
+            self._ftel.journey_event(uid, "closed", self._steps,
+                                     replica=owner, status="cancelled")
 
     def query(self, uid: int) -> Dict:
         """Fleet-level request status: the owning replica's ``query()``
         plus ``replica``; ``migrating`` while a record waits for
-        re-placement; the fleet-terminal status after closure."""
+        re-placement; the fleet-terminal status after closure.  With
+        the telemetry plane on, the request's JOURNEY (its placed /
+        quarantined / migrated / failed-over hops) rides along under
+        ``"journey"``."""
+        d = self._query_status(uid)
+        if self._ftel is not None:
+            j = self._ftel.journey(uid)
+            if j is not None:
+                d["journey"] = j
+        return d
+
+    def _query_status(self, uid: int) -> Dict:
         if uid in self._closed:
             return {"status": self._closed[uid], "replica": None}
         for m in self._migrations:
@@ -428,6 +620,25 @@ class FleetRouter:
             d["replica"] = owner
             return d
         return {"status": "unknown", "replica": None}
+
+    def request_journey(self, uid: int) -> Optional[List[Dict]]:
+        """The request's fleet journey — placed → (quarantined |
+        migrated | failed-over)* → terminal, step-counter timestamps
+        and reasons (docs/OBSERVABILITY.md "Fleet observability").
+        None when the telemetry plane is off or the uid is unknown."""
+        if self._ftel is None:
+            return None
+        return self._ftel.journey(uid)
+
+    def _fleet_status_of(self, uid: int) -> str:
+        """Where a record with no live engine tail ended up, fleet-
+        side: queued for re-placement, fleet-closed, or (conservative
+        fallback) still open — the merged-record view's trailing-hop
+        resolver."""
+        for m in self._migrations:
+            if m.rec["uid"] == uid:
+                return "migrating"
+        return self._closed.get(uid, "open")
 
     def drain_reaped(self) -> set:
         """Uids the FLEET terminally closed since the last call
@@ -461,6 +672,9 @@ class FleetRouter:
         self._closed[uid] = s
         self._owner.pop(uid, None)
         self._reaped.add(uid)
+        if self._ftel is not None:
+            self._ftel.journey_event(uid, "closed", self._steps,
+                                     replica=rep.name, status=s)
 
     # ------------------------------------------------------------------
     # failover, migration, scale-down
@@ -474,6 +688,7 @@ class FleetRouter:
         rep = self._reps[name]
         rep.breaker.kill()
         self._c_failovers.inc()
+        self.flight.note("failover", replica=name, step=self._steps)
         # closures the engine staged in its dying step (deadline
         # reaps, sheds) must still surface as fleet closures — the
         # step that would have delivered them raised instead
@@ -481,13 +696,22 @@ class FleetRouter:
             self._note_engine_close(rep, uid)
         snap = rep.engine.snapshot()
         n = 0
-        for rec in snap["requests"]:
-            self._owner.pop(int(rec["uid"]), None)
-            n += self._enqueue_migration(rec, source=name)
+        with (self._ftel.span("failover", replica=name)
+              if self._ftel is not None else NOOP_CTX):
+            for rec in snap["requests"]:
+                uid = int(rec["uid"])
+                self._owner.pop(uid, None)
+                if self._ftel is not None:
+                    self._ftel.journey_event(uid, "failed_over",
+                                             self._steps, replica=name)
+                n += self._enqueue_migration(rec, source=name)
+        self.flight.note("failover_migrations", replica=name,
+                         queued=n, failed=len(snap["requests"]) - n)
         logger.warning(
             "fleet: replica %s died; %d open request(s) queued for "
             "migration, %d inexact record(s) closed failed", name, n,
             len(snap["requests"]) - n)
+        self._autodump("failover")
 
     def _enqueue_migration(self, rec: Dict, source: str) -> int:
         uid = int(rec["uid"])
@@ -495,6 +719,14 @@ class FleetRouter:
             self._closed[uid] = "failed"
             self._reaped.add(uid)
             self._c_failed.inc()
+            self._fleet_closures["failed"] = \
+                self._fleet_closures.get("failed", 0) + 1
+            self._note_record_gap(uid, "failed")
+            if self._ftel is not None:
+                self._ftel.journey_event(
+                    uid, "closed", self._steps, status="failed",
+                    reason="record not replayable (device-side tokens "
+                           "lost)")
             return 0
         self._migrations.append(
             _Migration(rec=rec, source=source, next_step=self._steps))
@@ -510,16 +742,26 @@ class FleetRouter:
             return
         still: List[_Migration] = []
         for m in self._migrations:
+            uid = int(m.rec["uid"])
             if m.next_step > self._steps:
                 still.append(m)
                 continue
             name = self._place_record(m.rec, exclude=m.source)
             if name is not None:
-                self._owner[m.rec["uid"]] = name
+                self._owner[uid] = name
                 self._c_migrations.inc()
+                if self._ftel is not None:
+                    self._ftel.last_migration_dest = name
+                    self._ftel.journey_event(uid, "placed", self._steps,
+                                             replica=name,
+                                             via="migration")
                 continue
             m.attempts += 1
             self._c_migration_retries.inc()
+            if self._ftel is not None:
+                self._ftel.journey_event(uid, "migration_retry",
+                                         self._steps,
+                                         attempts=m.attempts)
             if m.attempts > self.cfg.max_migration_retries:
                 # last resort before destroying the work: going HOME
                 # beats shedding — the source may be alive again (a
@@ -527,16 +769,33 @@ class FleetRouter:
                 # with nowhere at all left sheds
                 name = self._place_record(m.rec)
                 if name is not None:
-                    self._owner[m.rec["uid"]] = name
+                    self._owner[uid] = name
                     self._c_migrations.inc()
+                    if self._ftel is not None:
+                        self._ftel.last_migration_dest = name
+                        self._ftel.journey_event(uid, "placed",
+                                                 self._steps,
+                                                 replica=name,
+                                                 via="home")
                     continue
-                self._closed[m.rec["uid"]] = "shed"
-                self._reaped.add(m.rec["uid"])
+                self._closed[uid] = "shed"
+                self._reaped.add(uid)
                 self._c_shed.inc()
+                self._fleet_closures["shed"] = \
+                    self._fleet_closures.get("shed", 0) + 1
+                self._note_record_gap(uid, "shed")
+                self.flight.note("migration_exhausted", uid=uid,
+                                 attempts=m.attempts - 1)
+                if self._ftel is not None:
+                    self._ftel.journey_event(
+                        uid, "closed", self._steps, status="shed",
+                        reason=f"migration exhausted after "
+                               f"{m.attempts - 1} retries")
                 logger.warning(
                     "fleet: migration of uid %d exhausted %d retries "
                     "with no routable replica — shed",
-                    m.rec["uid"], m.attempts - 1)
+                    uid, m.attempts - 1)
+                self._autodump("fleet_shed")
                 continue
             m.next_step = self._steps + self.cfg.migration_backoff_steps \
                 * (1 << min(m.attempts - 1, 6))
@@ -583,11 +842,19 @@ class FleetRouter:
                    if rep.name != source):
             return 0
         rep = self._reps[source]
-        part = rep.engine.migrate_out(uids)
-        n = 0
-        for rec in part["requests"]:
-            self._owner.pop(int(rec["uid"]), None)
-            n += self._enqueue_migration(rec, source=source)
+        with (self._ftel.span("migrate", replica=source)
+              if self._ftel is not None else NOOP_CTX):
+            part = rep.engine.migrate_out(uids)
+            n = 0
+            for rec in part["requests"]:
+                uid = int(rec["uid"])
+                self._owner.pop(uid, None)
+                if self._ftel is not None:
+                    self._ftel.journey_event(uid, "migrated",
+                                             self._steps,
+                                             replica=source,
+                                             via="migrate")
+                n += self._enqueue_migration(rec, source=source)
         for uid in rep.engine._drain_reaped():
             self._note_engine_close(rep, uid)  # "migrated" returns early
         self._pump_migrations()
@@ -611,6 +878,14 @@ class FleetRouter:
         for uid in snap["shed_uids"]:
             if uid in recs:
                 self._owner.pop(uid, None)
+                # the drain closed this request "shed" on the replica,
+                # but the fleet is RE-PLACING it: that engine terminal
+                # is a phantom the reconciled accounting subtracts out
+                self._note_phantom(uid, name)
+                if self._ftel is not None:
+                    self._ftel.journey_event(uid, "migrated",
+                                             self._steps, replica=name,
+                                             via="scale_down")
                 self._enqueue_migration(recs[uid], source=name)
         for uid in rep.engine._drain_reaped():
             if uid in shed:
@@ -660,15 +935,169 @@ class FleetRouter:
         }
 
     def metrics_snapshot(self) -> Dict:
-        """JSON-able snapshot of the fleet gauges/counters (the
-        replicas' own registries are separate — scrape them per
-        replica)."""
+        """JSON-able snapshot of the fleet gauges/counters; the whole
+        fleet's series (every replica's registry under ``replica=``
+        labels, plus rollups) live on ``router.fleet_registry``."""
         return self.metrics.snapshot()
 
     def request_metrics(self) -> Dict:
-        """Fleet-wide per-request aggregate: each replica's lifecycle
-        aggregate keyed by replica name (a migrated request has one
-        open record fleet-wide; its prior replicas hold closed
-        ``migrated``/``shed`` records by design)."""
-        return {name: rep.engine.request_metrics()["aggregate"]
-                for name, rep in self._reps.items()}
+        """Fleet-level request metrics, migration-deduped (docs/
+        OBSERVABILITY.md "Fleet observability"): ``{"aggregate": the
+        exact fleet tally, "replicas": {name: per-replica aggregate},
+        "requests": [merged records]}`` — a migrated uid yields ONE
+        record attributed to its finishing replica, with token sums
+        equal to the sum of the per-replica engine counters."""
+        return fleet_request_metrics(self)
+
+    def anomaly_summary(self) -> Optional[Dict]:
+        """Fleet anomaly tally + anomaly-armed capture records; None
+        while the telemetry plane is off."""
+        if self._ftel is None:
+            return None
+        return self._ftel.summary()
+
+    def reset_metrics(self) -> None:
+        """Reset the ROUTER-side telemetry: fleet counters/gauges, the
+        reconciliation ledgers that ride them, the flight-event ring,
+        journeys, detector baselines, and the capture budget.  The
+        replicas' own registries are theirs to reset — reconciled
+        views are only exact when both sides reset together (the bench
+        legs reset engines before building the router)."""
+        self.metrics.reset()
+        self._phantoms.clear()
+        self._fleet_closures.clear()
+        self._record_gaps.clear()
+        self.flight.clear()
+        if self._ftel is not None:
+            self._ftel.reset()
+
+    def request_journeys(self) -> Dict[int, List[Dict]]:
+        """Every live journey (uid -> event list); empty when the
+        telemetry plane is off."""
+        if self._ftel is None:
+            return {}
+        return {uid: list(j)
+                for uid, j in self._ftel._journeys.items()}
+
+    def capture(self, steps: Optional[int] = None, replicas=None,
+                out_dir: Optional[str] = None,
+                reason: str = "manual") -> Dict[str, Optional[str]]:
+        """Arm a deep-capture window on the given replicas (default:
+        every live one) through the engines' existing ProfilerCapture
+        seam; windows begin at each engine's next step boundary and the
+        artifacts land under ``<dir>/captures/<replica>/``.  Returns
+        {replica: capture dir or None (refused)}.  Raises without a
+        resolvable directory — an explicit capture with nowhere to
+        write is a caller error (the ANOMALY path degrades instead)."""
+        tcfg = self._ftel.cfg if self._ftel is not None \
+            else FleetTelemetryConfig()
+        d = out_dir or tcfg.capture_dir or self.cfg.flight_dir
+        if not d:
+            raise ValueError(
+                "no capture directory: pass out_dir=, or set "
+                "FleetTelemetryConfig.capture_dir / "
+                "FleetConfig.flight_dir")
+        names = list(replicas) if replicas is not None else \
+            [n for n, r in self._reps.items() if not r.dead]
+        out = {}
+        for n in names:
+            out[n] = self._reps[n].engine.capture(
+                steps or tcfg.capture_steps, reason=f"fleet_{reason}",
+                out_dir=os.path.join(d, "captures", n))
+        return out
+
+    # ------------------------------------------------------------------
+    # fleet post-mortems
+    # ------------------------------------------------------------------
+    def debug_dump(self, path: str, reason: str = "debug") -> Dict:
+        """Write the fleet post-mortem BUNDLE (docs/OBSERVABILITY.md
+        "Fleet observability") into directory ``path``::
+
+            path/
+                fleet.json            router events + journeys + fleet
+                                      metrics/rollups + deduped request
+                                      metrics (validate_fleet_dump)
+                router_trace.json     router span ring (telemetry on)
+                replicas/<name>/flight.json   each replica's own
+                                      debug_dump (valid on a DEAD one)
+
+        Returns the fleet dump dict.  ``tools/tracemerge.py --fleet``
+        merges the bundle (router trace + each replica's capture
+        artifacts) onto one Perfetto timeline."""
+        try:
+            os.makedirs(path, exist_ok=True)
+        except OSError as e:
+            logger.warning("fleet dump dir %r unusable (%s)", path, e)
+        replicas: Dict[str, Dict] = {}
+        for name, rep in self._reps.items():
+            rdir = os.path.join(path, "replicas", name)
+            try:
+                os.makedirs(rdir, exist_ok=True)
+            except OSError as e:
+                logger.warning("fleet dump: replica dir %r unusable "
+                               "(%s)", rdir, e)
+            rep.engine.debug_dump(os.path.join(rdir, "flight.json"),
+                                  reason=f"fleet_{reason}")
+            replicas[name] = {
+                "flight": os.path.join("replicas", name, "flight.json"),
+                "captures": list(rep.engine.capture_dirs),
+                "breaker": rep.breaker.state,
+                "dead": rep.dead,
+            }
+        router_trace = None
+        if self._ftel is not None and len(self._ftel.tracer):
+            router_trace = "router_trace.json"
+            try:
+                self._ftel.tracer.export_chrome_trace(
+                    os.path.join(path, router_trace),
+                    process_name="fleet_router")
+            except OSError as e:
+                logger.warning("fleet dump: cannot write router trace "
+                               "(%s)", e)
+                router_trace = None
+        dump = {
+            "version": FLEET_DUMP_VERSION,
+            "reason": reason,
+            "time": time.time(),
+            "fingerprint": config_fingerprint(),
+            "steps": self._steps,
+            "health": self.health(),
+            "metrics": self.metrics.snapshot(),
+            "rollups": self.fleet_registry.rollup_snapshot(),
+            "journeys": {str(u): j
+                         for u, j in self.request_journeys().items()},
+            "anomalies": self.anomaly_summary(),
+            "request_metrics": self.request_metrics(),
+            "events": self.flight.events(),
+            "replicas": replicas,
+            "router_trace": router_trace,
+        }
+        self.flight.dump(os.path.join(path, "fleet.json"), reason,
+                         snap=dump)
+        return dump
+
+    def _autodump(self, reason: str) -> Optional[str]:
+        """One budgeted fleet post-mortem bundle into ``FleetConfig.
+        flight_dir`` (no-op unset): failover, quarantine, and fleet-
+        shed each leave a bundle, at most ``max_autodumps`` per router
+        generation, with collision-safe directory names across
+        generations sharing one flight_dir (the PR-9 engine-dump
+        discipline)."""
+        d = self.cfg.flight_dir
+        if not d or self._autodumps >= self.cfg.max_autodumps:
+            return None
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError as e:
+            logger.warning("fleet flight_dir %r unusable (%s)", d, e)
+            return None
+        n = self._autodumps
+        while True:
+            path = os.path.join(d, f"fleet_{reason}_s{self._steps}_{n}")
+            if not os.path.exists(path):
+                break
+            n += 1
+        self._autodumps += 1
+        self.flight.note("dump", reason=reason, path=path)
+        self.debug_dump(path, reason=reason)
+        return path

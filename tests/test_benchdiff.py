@@ -20,7 +20,7 @@ from tools.benchdiff import (compare, diff_files, main,  # noqa: E402
 
 def test_smoke_is_the_acceptance_check():
     out = smoke()
-    assert out["ok"] and len(out["checks"]) == 7
+    assert out["ok"] and len(out["checks"]) == 8
     assert "anomaly_delta_reports_not_gates" in out["checks"]
 
 
@@ -39,6 +39,30 @@ def test_anomaly_deltas_report_only():
     # a leg whose anomaly subtree is None (anomaly off) stays silent
     off = dict(base, pipe2_anomalies=None)
     assert compare(off, off)["anomaly_deltas"] == []
+
+
+def test_fleet_anomaly_deltas_report_only():
+    """``fleet_*_anomalies`` subtrees (PR 14: {"fleet": ...,
+    "replicas": {name: ...}}) report fleet-total and per-replica
+    deltas under ``fleet_anomaly_deltas`` but can never fail a run —
+    even under a matching fingerprint."""
+    base = {"engine_version": "1.0", "config_hash": "aaaa",
+            "value": 100.0,
+            "fleet_serving_anomalies": {
+                "fleet": {"total": 0, "by_signal": {}},
+                "replicas": {"r0": {"total": 0}}}}
+    stormy = dict(base, fleet_serving_anomalies={
+        "fleet": {"total": 9, "by_signal": {"failover_migration_storm": 9}},
+        "replicas": {"r0": {"total": 9}}})
+    v = compare(base, stormy)
+    assert v["ok"], "fleet anomaly deltas must never gate"
+    assert v["fleet_anomaly_deltas"] == [
+        {"metric": "fleet_serving_anomalies.fleet", "old": 0, "new": 9},
+        {"metric": "fleet_serving_anomalies.replicas.r0",
+         "old": 0, "new": 9}]
+    # not double-counted into the flat anomaly deltas
+    assert v["anomaly_deltas"] == []
+    assert compare(base, base)["fleet_anomaly_deltas"] == []
 
 
 def test_metric_direction_classification():

@@ -1,0 +1,766 @@
+"""Fleet observability plane (deepspeed_tpu/serving/fleet_telemetry.py
+— docs/OBSERVABILITY.md "Fleet observability"): request journeys under
+the nasty PR-13 races (revived uids, migrate-home round trips, journey
+vs engine status-ladder agreement), the FleetRegistry one-exposition
+view (replica= labels, rollups, staleness, reconciled terminal rollup),
+migration-deduped fleet request metrics, fleet post-mortem bundles, the
+fleet anomaly catalog, and the PR-10-style zero-cost-off bar (telemetry
+off constructs no monitor and adds ZERO perf_counter reads per router
+step — counted).
+
+End-to-end chaos coverage (kill + quarantine + migrate with auto-dumps,
+anomaly-armed captures, and the merged --fleet timeline) lives in
+tools/loadgen.fleet_chaos_smoke, asserted tier-1 via
+tests/test_loadgen.py."""
+
+import json
+import time
+
+import jax
+import pytest
+
+from deepspeed_tpu.inference import (FailureConfig, InferenceConfig,
+                                     InferenceEngine, OverloadConfig,
+                                     SamplingParams)
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.serving import (FleetConfig, FleetRouter,
+                                   FleetTelemetryConfig,
+                                   default_fleet_detectors,
+                                   reconciled_terminal_statuses,
+                                   validate_fleet_dump)
+from deepspeed_tpu.serving.fleet_telemetry import FleetTelemetry
+from deepspeed_tpu.telemetry import (AnomalyMonitor, MetricsRegistry,
+                                     parse_prometheus_text)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return build_model("llama-tiny", vocab_size=128, num_layers=2,
+                       d_model=64, num_heads=4, num_kv_heads=2, d_ff=128,
+                       max_seq_len=256)
+
+
+def make_engine(model, **kw):
+    icfg = dict(token_budget=32, max_seqs=4, kv_block_size=8,
+                num_kv_blocks=32, max_seq_len=96, prefix_cache="on",
+                failure=FailureConfig(dispatch_timeout_ms=None))
+    icfg.update(kw)
+    return InferenceEngine(model, InferenceConfig(**icfg))
+
+
+def make_router(model, n=2, tcfg=None, **cfg_kw):
+    cfg_kw.setdefault("telemetry", "on")
+    return FleetRouter({f"r{i}": make_engine(model) for i in range(n)},
+                       FleetConfig(telemetry_cfg=tcfg, **cfg_kw))
+
+
+def drive(router, prompts, n_tok=4, sampling=None, rng=None,
+          on_step=None, max_steps=300):
+    sampling = sampling or SamplingParams(max_new_tokens=1 << 30)
+    done = {u: [] for u in prompts}
+    for u, p in prompts.items():
+        router.put(u, list(p))
+    active = set(prompts)
+    n = 0
+    while active:
+        n += 1
+        assert n < max_steps, f"fleet drive wedged with {active}"
+        if on_step is not None:
+            on_step(router, n)
+        outs = router.step(rng=rng, sampling=sampling)
+        active -= router.drain_reaped()
+        for u, t in outs.items():
+            if u not in active:
+                continue
+            done[u].append(t)
+            if len(done[u]) >= n_tok:
+                active.discard(u)
+                router.flush(u)
+            else:
+                router.put(u, [t])
+    return done
+
+
+def events(journey):
+    return [e["event"] for e in journey]
+
+
+# --------------------------------------------------------------------------
+# journeys
+# --------------------------------------------------------------------------
+
+class TestJourneys:
+    def test_placed_and_closed_with_step_stamps(self, model):
+        router = make_router(model, n=2)
+        drive(router, {0: [1, 2, 3, 4]})
+        j = router.request_journey(0)
+        assert events(j) == ["placed", "closed"]
+        assert j[0]["replica"] in ("r0", "r1")
+        assert j[0]["via"] == "arrival" and "score" in j[0]
+        assert j[-1]["status"] == "finished"
+        # step-counter timestamps, monotone — the router's only clock
+        assert all(isinstance(e["step"], int) for e in j)
+        assert j[0]["step"] <= j[-1]["step"]
+        # query() folds the journey in
+        q = router.query(0)
+        assert q["status"] == "finished" and q["journey"] == j
+
+    def test_revived_uid_gets_a_fresh_journey(self, model):
+        """The PR-13 revival race, journey-side: a uid fleet-shed (here
+        evicted by backpressure) and later re-admitted must START OVER
+        — inheriting the dead life's closed journey would make the new
+        life look already-terminal."""
+        bound = OverloadConfig(max_queued_requests=2,
+                               shed_policy="evict-lowest")
+        router = FleetRouter(
+            {"r0": InferenceEngine(model, InferenceConfig(
+                token_budget=32, max_seqs=4, kv_block_size=8,
+                num_kv_blocks=32, max_seq_len=96, overload=bound))},
+            FleetConfig(telemetry="on"))
+        router.put(5, [1, 2, 3], priority=5)
+        router.put(7, [4, 5, 6], priority=5)
+        v = router.put(6, [7, 8, 9], priority=0)
+        assert v.admitted and v.evicted_uids
+        eu = v.evicted_uids[0]
+        j_dead = router.request_journey(eu)
+        assert events(j_dead)[-1] == "closed"
+        assert j_dead[-1]["status"] == "shed"
+        v2 = router.put(eu, [1, 2, 3], priority=0)   # revived
+        assert v2.admitted
+        j_new = router.request_journey(eu)
+        assert events(j_new) == ["placed"], \
+            "revived uid inherited its dead life's journey"
+        router.step()
+        assert eu not in router.drain_reaped()
+
+    def test_fleet_shed_closes_journey_and_revives_fresh(self, model):
+        router = FleetRouter(
+            {"r0": make_engine(model)},
+            FleetConfig(telemetry="on", probe_interval_steps=1000))
+        b = router.replica("r0").breaker
+        b.record_failure(1)
+        b.record_failure(2)          # nothing routable
+        v = router.put(0, [1, 2, 3])
+        assert not v.admitted
+        j = router.request_journey(0)
+        assert events(j) == ["closed"]
+        assert j[0]["status"] == "shed" \
+            and "no routable" in j[0]["reason"]
+
+    def test_migrate_round_trip_journey(self, model):
+        """The migrate-home round trip: a request migrated OFF its
+        replica whose destination then dies comes back — the journey
+        shows placed(r0) -> migrated -> placed(r1) -> failed_over ->
+        placed(r0), and the stream stays token-identical to an
+        undisturbed run."""
+        router = make_router(model, n=2)
+        ref = drive(FleetRouter({"solo": make_engine(model)}),
+                    {0: [1, 2, 3, 4, 5]}, n_tok=6)
+
+        def ops(rt, n):
+            if n == 2:
+                owner = rt._owner[0]
+                assert rt.migrate([0], owner) == 1
+            if n == 3:
+                owner = rt._owner[0]
+                rt.replica(owner).engine.failures.inject("fatal")
+
+        done = drive(make_router(model, n=2), {0: [1, 2, 3, 4, 5]},
+                     n_tok=6, on_step=ops)
+        assert done == ref
+        # rebuild the journey story on a fresh router for determinism
+        router = make_router(model, n=2)
+        done = drive(router, {0: [1, 2, 3, 4, 5]}, n_tok=6, on_step=ops)
+        assert done == ref
+        j = router.request_journey(0)
+        ev = events(j)
+        placed = [e["replica"] for e in j if e["event"] == "placed"]
+        assert len(placed) == 3
+        assert placed[0] == placed[2] != placed[1], \
+            f"not a round trip: {placed}"
+        assert "migrated" in ev and "failed_over" in ev
+        assert ev.index("migrated") < ev.index("failed_over")
+        assert j[-1]["event"] == "closed" \
+            and j[-1]["status"] == "finished"
+
+    def test_home_on_exhaustion_journey(self, model):
+        """The exhaustion-home branch: a migration record whose
+        exclusion set leaves nowhere to go retries, exhausts, and goes
+        HOME instead of shedding — the journey records the retries and
+        the via='home' placement."""
+        router = FleetRouter(
+            {"r0": make_engine(model), "r1": make_engine(model)},
+            FleetConfig(telemetry="on", max_migration_retries=1,
+                        migration_backoff_steps=1,
+                        probe_interval_steps=1000))
+        router.put(0, [1, 2, 3, 4])
+        outs = router.step()
+        router.put(0, [outs[0]])
+        # r1 leaves the routable set; then a record sourced at r0
+        # enters the queue (the failover shape, driven directly — the
+        # public migrate() refuses extraction with no destination)
+        b = router.replica("r1").breaker
+        b.record_failure(1)
+        b.record_failure(2)
+        part = router.replica("r0").engine.migrate_out([0])
+        router._owner.pop(0)
+        router.replica("r0").engine._drain_reaped()
+        assert router._enqueue_migration(part["requests"][0],
+                                         source="r0") == 1
+        for _ in range(6):
+            router.step()
+        assert router.query(0)["status"] in ("queued", "running")
+        assert router._owner[0] == "r0"          # came home
+        j = router.request_journey(0)
+        assert "migration_retry" in events(j)
+        assert j[-1]["event"] == "placed" and j[-1]["via"] == "home" \
+            and j[-1]["replica"] == "r0"
+        router.flush(0)
+
+    @pytest.mark.parametrize("mode,cache", [("greedy", "on"),
+                                            ("greedy", "off"),
+                                            ("seeded", "on"),
+                                            ("seeded", "off")])
+    def test_journey_agrees_with_engine_status_ladder(self, model,
+                                                      mode, cache):
+        """router.query()'s journey info must agree with the engine-
+        side status ladder at EVERY step: a live status means an open
+        journey whose last hop is a placement-shaped event, a terminal
+        status means a closed journey with the same status."""
+        sp = SamplingParams(max_new_tokens=1 << 30) if mode == "greedy" \
+            else SamplingParams(temperature=0.8, top_k=40,
+                                max_new_tokens=1 << 30)
+        rng = None if mode == "greedy" else jax.random.PRNGKey(7)
+        router = FleetRouter(
+            {f"r{i}": make_engine(model, prefix_cache=cache)
+             for i in range(2)},
+            FleetConfig(telemetry="on"))
+        prompts = {u: [20 + u, 21, 22, 23] for u in range(3)}
+
+        def check(rt, n):
+            if n == 2:
+                owner = rt._owner.get(0)
+                if owner is not None:
+                    rt.migrate([0], owner)
+            for u in prompts:
+                q = rt.query(u)
+                j = q.get("journey")
+                if q["status"] in ("queued", "running", "migrating"):
+                    assert j and j[-1]["event"] != "closed", (u, q)
+                elif q["status"] in ("finished", "cancelled", "shed",
+                                     "failed"):
+                    assert j and j[-1]["event"] == "closed" \
+                        and j[-1]["status"] == q["status"], (u, q)
+
+        drive(router, prompts, n_tok=4, sampling=sp, rng=rng,
+              on_step=check)
+        for u in prompts:
+            q = router.query(u)
+            assert q["status"] == "finished"
+            assert q["journey"][-1]["status"] == "finished"
+
+    def test_quarantine_rides_owned_journeys(self, model):
+        router = FleetRouter(
+            {"r0": make_engine(model)},
+            FleetConfig(telemetry="on", failure_threshold=2,
+                        probe_interval_steps=3))
+        router.put(0, [1, 2, 3, 4])
+        outs = router.step()
+        router.put(0, [outs[0]])     # keep it decoding through the
+        router.replica("r0").engine.failures.inject("transient", n=2)
+        for _ in range(8):           # quarantine window
+            outs = router.step()
+            if 0 in outs:
+                router.put(0, [outs[0]])
+        assert "quarantined" in events(router.request_journey(0))
+        router.flush(0)
+
+    def test_journeys_off_when_telemetry_off(self, model):
+        router = FleetRouter({"r0": make_engine(model)}, FleetConfig())
+        assert router._ftel is None
+        router.put(0, [1, 2, 3])
+        assert router.request_journey(0) is None
+        assert "journey" not in router.query(0)
+        assert router.anomaly_summary() is None
+        router.flush(0)
+
+    def test_journey_table_bounded(self, model):
+        router = FleetRouter(
+            {"r0": make_engine(model)},
+            FleetConfig(telemetry="on",
+                        telemetry_cfg=FleetTelemetryConfig(
+                            max_journeys=4)))
+        for u in range(8):
+            router.put(u, [1, 2, 3])
+            router.flush(u)
+        assert len(router.request_journeys()) <= 4
+        assert router.request_journey(7) is not None   # newest kept
+
+
+# --------------------------------------------------------------------------
+# migration-deduped fleet request metrics
+# --------------------------------------------------------------------------
+
+class TestFleetRequestMetrics:
+    def test_migrated_uid_yields_one_record(self, model):
+        router = make_router(model, n=2)
+
+        def ops(rt, n):
+            if n == 2:
+                owner = rt._owner[0]
+                rt.migrate([0], owner)
+
+        drive(router, {0: [1, 2, 3, 4, 5], 1: [9, 8, 7]}, n_tok=4,
+              on_step=ops)
+        rm = router.request_metrics()
+        recs = [r for r in rm["requests"] if r["uid"] == 0]
+        assert len(recs) == 1, "migrated uid forked into two records"
+        rec = recs[0]
+        assert rec["status"] == "finished"
+        assert len(rec["hops"]) == 2
+        assert rec["hops"][0]["status"] == "migrated"
+        assert rec["replica"] == rec["hops"][-1]["replica"]
+        # attribution: the finishing replica
+        fin_eng = router.replica(rec["replica"]).engine
+        assert fin_eng.query(0)["status"] == "finished"
+        # sums stay exact fleet-wide (the reconciliation bar)
+        for key in ("prompt_tokens", "generated_tokens"):
+            ctr = sum(int(router.replica(n).engine.timings[key])
+                      for n in router.replica_names)
+            assert rm["aggregate"][key] == ctr
+
+    def test_routing_retry_sheds_are_phantoms(self, model):
+        """A put shed by one replica and admitted by the next leaves an
+        engine-side shed record on the first — a PHANTOM the deduped
+        view drops and the reconciled rollup subtracts (the PR-13
+        known-but-unfixed double counting, fixed)."""
+        bound = OverloadConfig(max_queued_requests=0,
+                               shed_policy="reject")
+        full = InferenceEngine(model, InferenceConfig(
+            token_budget=32, max_seqs=4, kv_block_size=8,
+            num_kv_blocks=32, max_seq_len=96, overload=bound))
+        router = FleetRouter(
+            {"r0": full, "r1": make_engine(model)},
+            FleetConfig(telemetry="on", placement="least_loaded"))
+        # r0 is least-loaded-first (name tiebreak) and sheds instantly;
+        # r1 admits — fleet truth: ONE life, zero sheds
+        v = router.put(0, [1, 2, 3, 4])
+        assert v.admitted and v.replica == "r1"
+        assert int(router.metrics.get(
+            "serving_fleet_replica_shed_retries_total").value()) == 1
+        drive_done = {0: []}
+        for _ in range(8):
+            outs = router.step()
+            if 0 in outs:
+                drive_done[0].append(outs[0])
+                if len(drive_done[0]) >= 2:
+                    router.flush(0)
+                    break
+                router.put(0, [outs[0]])
+        rm = router.request_metrics()
+        assert rm["aggregate"]["statuses"] == {"finished": 1}
+        assert [r["status"] for r in rm["requests"]] == ["finished"]
+        assert reconciled_terminal_statuses(router) == {"finished": 1}
+        # the engine-side truth still shows the shed (raw, per replica)
+        assert rm["replicas"]["r0"]["statuses"].get("shed") == 1
+
+    def test_queue_settle_after_prior_migration_counts_once(self, model):
+        """Review regression: a request that already MIGRATED once (a
+        'migrated' hop survives on its first replica) and later parks
+        in the migration queue (scale-down with no routable
+        destination) must count exactly ONCE when the client flushes
+        it — the surviving hop record makes it visible to the merged
+        view, so no record-gap entry may be added on top."""
+        from tools.loadgen import check_fleet_invariants
+
+        router = FleetRouter(
+            {"r0": make_engine(model), "r1": make_engine(model)},
+            FleetConfig(telemetry="on", probe_interval_steps=1000))
+        router.put(0, [1, 2, 3, 4])
+        outs = router.step()
+        router.put(0, [outs[0]])
+        src = router._owner[0]
+        assert router.migrate([0], src) == 1     # hop record on src
+        dst = router._owner[0]
+        # quarantine the original source so the scale-down record has
+        # nowhere to go and parks in the queue
+        b = router.replica(src).breaker
+        b.record_failure(1)
+        b.record_failure(2)
+        router.scale_down(dst, deadline_ms=0.0)
+        assert router.query(0)["status"] == "migrating"
+        router.flush(0)                           # settles in the queue
+        assert router.query(0)["status"] == "finished"
+        rm = router.request_metrics()
+        assert rm["aggregate"]["statuses"] == {"finished": 1}
+        assert reconciled_terminal_statuses(router) == {"finished": 1}
+        check_fleet_invariants(router)
+
+    def test_fleet_saturation_shed_counts_once(self, model):
+        bound = OverloadConfig(max_queued_requests=0,
+                               shed_policy="reject")
+
+        def bounded():
+            return InferenceEngine(model, InferenceConfig(
+                token_budget=32, max_seqs=4, kv_block_size=8,
+                num_kv_blocks=32, max_seq_len=96, overload=bound))
+
+        router = FleetRouter({"r0": bounded(), "r1": bounded()},
+                             FleetConfig(telemetry="on"))
+        v = router.put(0, [1, 2, 3])
+        assert not v.admitted
+        # two engine shed records + one fleet shed == ONE fleet terminal
+        rm = router.request_metrics()
+        assert rm["aggregate"]["statuses"] == {"shed": 1}
+        assert reconciled_terminal_statuses(router) == {"shed": 1}
+        assert rm["aggregate"]["fleet_shed"] == 1
+
+
+# --------------------------------------------------------------------------
+# FleetRegistry: one exposition
+# --------------------------------------------------------------------------
+
+class TestFleetRegistry:
+    def test_replica_labels_and_rollups(self, model):
+        router = make_router(model, n=2)
+        drive(router, {0: [1, 2, 3, 4], 1: [5, 6, 7]})
+        text = router.fleet_registry.prometheus_text()
+        parsed = parse_prometheus_text(text)
+        # every replica's series under replica= labels
+        steps = parsed["serving_steps_total"]["samples"]
+        assert {dict(k[1])["replica"] for k in steps} == {"r0", "r1"}
+        # rollup == sum over replicas == engine counter truth
+        gen = parsed["serving_fleet_generated_tokens_total"]["samples"]
+        ctr = sum(int(router.replica(n).engine.timings
+                      ["generated_tokens"])
+                  for n in router.replica_names)
+        assert int(sum(gen.values())) == ctr
+        # pull gauges stay pull: scraped at export, never cached —
+        # the pool gauge reads live allocator truth (all blocks free
+        # after the drive)
+        free = parsed["serving_kv_blocks_free"]["samples"]
+        total = parsed["serving_kv_blocks_total"]["samples"]
+        assert sum(free.values()) == sum(total.values())
+        # rates never roll up (a summed ratio is a lie)
+        assert "serving_fleet_prefix_hit_rate" not in parsed
+        # histograms re-export per replica AND roll up
+        assert "serving_ttft_ms" in parsed
+        assert "serving_fleet_ttft_ms" in parsed
+        # the router's own fleet series ride the same exposition
+        assert "serving_fleet_placements_total" in parsed
+        # and the exposition round-trips through the shared parser
+        assert parsed  # parse_prometheus_text raised on no line
+
+    def test_reconciled_terminal_rollup(self, model):
+        router = make_router(model, n=2)
+
+        def ops(rt, n):
+            if n == 2:
+                owner = rt._owner[0]
+                rt.migrate([0], owner)
+
+        drive(router, {0: [1, 2, 3, 4, 5]}, n_tok=4, on_step=ops)
+        parsed = parse_prometheus_text(
+            router.fleet_registry.prometheus_text())
+        rec = parsed["serving_fleet_requests_terminal_total"]["samples"]
+        by_status = {dict(k[1])["status"]: int(v)
+                     for k, v in rec.items()}
+        # the naive per-replica sum would count the migrated hop too
+        assert by_status == {"finished": 1}
+        raw = parsed["serving_requests_terminal_total"]["samples"]
+        raw_statuses = {dict(k[1])["status"] for k in raw}
+        assert "migrated" in raw_statuses   # raw truth still exported
+
+    def test_dead_replica_exports_with_staleness_marker(self, model):
+        router = make_router(model, n=2)
+        drive(router, {0: [1, 2, 3, 4]})
+        victim = next(iter(router.replica_names))
+        router.replica(victim).engine._health = "dead"
+        router._failover(victim)
+        parsed = parse_prometheus_text(
+            router.fleet_registry.prometheus_text())
+        stale = {dict(k[1])["replica"]: v for k, v in
+                 parsed["serving_fleet_replica_stale"]["samples"].items()}
+        assert stale[victim] == 1.0
+        assert all(v == 0.0 for n, v in stale.items() if n != victim)
+        # the dead replica's series did NOT vanish
+        steps = parsed["serving_steps_total"]["samples"]
+        assert victim in {dict(k[1])["replica"] for k in steps}
+
+    def test_fleet_scope_registration_delegates(self, model):
+        router = make_router(model, n=1)
+        fleet_registry = router.fleet_registry
+        c = fleet_registry.counter("serving_fleet_custom_total",
+                                   "fleet-scope test counter",
+                                   int_valued=True)
+        c.inc(3, replica="r0")
+        parsed = parse_prometheus_text(
+            fleet_registry.prometheus_text())
+        samples = parsed["serving_fleet_custom_total"]["samples"]
+        assert {dict(k[1])["replica"]: v
+                for k, v in samples.items()} == {"r0": 3.0}
+
+    def test_snapshot_json_able(self, model):
+        router = make_router(model, n=2)
+        drive(router, {0: [1, 2, 3, 4]})
+        snap = router.fleet_registry.snapshot()
+        json.dumps(snap)
+        assert set(snap["replicas"]) == {"r0", "r1"}
+        assert "serving_fleet_generated_tokens_total" in snap["rollups"]
+        assert snap["stale"] == {"r0": False, "r1": False}
+
+
+# --------------------------------------------------------------------------
+# fleet post-mortem bundle
+# --------------------------------------------------------------------------
+
+class TestFleetDump:
+    def test_debug_dump_bundle_validates(self, model, tmp_path):
+        router = make_router(model, n=2)
+        drive(router, {0: [1, 2, 3, 4]})
+        bdir = tmp_path / "bundle"
+        dump = router.debug_dump(str(bdir), reason="test")
+        assert validate_fleet_dump(dump, base_dir=str(bdir)) == []
+        on_disk = json.loads((bdir / "fleet.json").read_text())
+        assert validate_fleet_dump(on_disk, base_dir=str(bdir)) == []
+        assert set(on_disk["replicas"]) == {"r0", "r1"}
+        assert on_disk["journeys"], "journeys missing from the bundle"
+        assert (bdir / "router_trace.json").exists()
+        assert (bdir / "replicas" / "r0" / "flight.json").exists()
+        # the bundle's request metrics are the deduped fleet view
+        assert on_disk["request_metrics"]["aggregate"]["statuses"] \
+            == {"finished": 1}
+
+    def test_validator_catches_breakage(self, model, tmp_path):
+        router = make_router(model, n=1)
+        dump = router.debug_dump(str(tmp_path / "b"), reason="test")
+        bad = dict(dump)
+        bad.pop("journeys")
+        bad["version"] = 99
+        problems = validate_fleet_dump(bad)
+        assert any("journeys" in p for p in problems)
+        assert any("version" in p for p in problems)
+        missing = dict(dump)
+        missing["replicas"] = {"r0": {"flight": "nope/flight.json"}}
+        assert any("flight dump missing" in p for p in
+                   validate_fleet_dump(missing, base_dir=str(tmp_path)))
+
+    def test_autodump_budget_and_collision_safety(self, model,
+                                                  tmp_path):
+        d = str(tmp_path / "flight")
+        router = FleetRouter(
+            {"r0": make_engine(model)},
+            FleetConfig(telemetry="on", flight_dir=d, max_autodumps=2,
+                        probe_interval_steps=1000))
+        b = router.replica("r0").breaker
+        b.record_failure(1)
+        b.record_failure(2)          # nothing routable: every put sheds
+        import os
+        for u in range(4):
+            router.put(u, [1, 2, 3])
+        bundles = [p for p in os.listdir(d)
+                   if p.startswith("fleet_fleet_shed")]
+        assert len(bundles) == 2     # budgeted
+        # a second router generation sharing the dir must not overwrite
+        router2 = FleetRouter(
+            {"r0": make_engine(model)},
+            FleetConfig(telemetry="on", flight_dir=d, max_autodumps=2,
+                        probe_interval_steps=1000))
+        b2 = router2.replica("r0").breaker
+        b2.record_failure(1)
+        b2.record_failure(2)
+        router2.put(0, [1, 2, 3])
+        now = [p for p in os.listdir(d)
+               if p.startswith("fleet_fleet_shed")]
+        assert len(now) == 3, "generation collision destroyed a bundle"
+
+
+# --------------------------------------------------------------------------
+# fleet anomaly catalog
+# --------------------------------------------------------------------------
+
+class TestFleetAnomalies:
+    def _monitor(self, cfg=None):
+        reg = MetricsRegistry()
+        cfg = cfg or FleetTelemetryConfig()
+        mon = AnomalyMonitor(cfg.anomaly, reg, prefix="serving_fleet")
+        mon.watch_all(default_fleet_detectors(cfg))
+        return mon, reg
+
+    def test_catalog_signals(self):
+        mon, _ = self._monitor()
+        assert set(mon.signals) == {
+            "placement_imbalance", "affinity_hit_rate",
+            "failover_migration_storm", "ttft_divergence"}
+
+    def test_storm_detector_fires_on_burst_not_single(self):
+        mon, reg = self._monitor(FleetTelemetryConfig(storm_limit=3.0))
+        # a single clean failover (1-2 windowed events) is an incident,
+        # not a storm
+        assert mon.observe("failover_migration_storm", 2.0, 1) is None
+        ev = mon.observe("failover_migration_storm", 6.0, 2)
+        assert ev is not None and ev.signal == "failover_migration_storm"
+        c = reg.get("serving_fleet_anomalies_total")
+        assert c.value(signal="failover_migration_storm") == 1
+
+    def test_ttft_divergence_threshold(self):
+        mon, _ = self._monitor(
+            FleetTelemetryConfig(ttft_divergence_ratio=4.0))
+        assert mon.observe("ttft_divergence", 2.0, 1) is None
+        assert mon.observe("ttft_divergence", 9.0, 2) is not None
+
+    def test_kill_fires_storm_and_arms_capture(self, model, tmp_path):
+        """The end-to-end wiring on real engines: a mid-traffic kill
+        (failover + migrations in one step) fires the storm signal,
+        bumps serving_fleet_anomalies_total, breadcrumbs the flight
+        ring, and arms a budgeted capture on the implicated replica."""
+        router = FleetRouter(
+            {f"r{i}": make_engine(model) for i in range(3)},
+            FleetConfig(telemetry="on", flight_dir=str(tmp_path),
+                        telemetry_cfg=FleetTelemetryConfig(
+                            storm_limit=1.0, capture_steps=2)))
+
+        def ops(rt, n):
+            if n == 3:
+                name = max((rt.replica(n2).load(), n2)
+                           for n2 in rt.replica_names
+                           if not rt.replica(n2).dead)[1]
+                rt.replica(name).engine.failures.inject("fatal")
+
+        drive(router, {u: [30 + u, 31, 32, 33] for u in range(5)},
+              n_tok=6, on_step=ops)
+        for n in router.replica_names:
+            if not router.replica(n).dead:
+                router.replica(n).engine.finish_capture()
+        asum = router.anomaly_summary()
+        assert asum["by_signal"].get("failover_migration_storm", 0) >= 1
+        c = router.metrics.get("serving_fleet_anomalies_total")
+        assert c.value(signal="failover_migration_storm") >= 1
+        assert any(e.get("kind") == "fleet_anomaly"
+                   and e.get("signal") == "failover_migration_storm"
+                   for e in router.flight.events())
+        assert asum["captures"], "no capture armed for the anomaly"
+        cap = asum["captures"][0]
+        assert not router.replica(cap["replica"]).dead
+        assert cap["dir"] in \
+            router.replica(cap["replica"]).engine.capture_dirs
+
+    def test_capture_budget_bounds_armed_windows(self, model,
+                                                 tmp_path):
+        router = FleetRouter(
+            {"r0": make_engine(model), "r1": make_engine(model)},
+            FleetConfig(telemetry="on", flight_dir=str(tmp_path),
+                        telemetry_cfg=FleetTelemetryConfig(
+                            max_captures=0, storm_limit=0.0)))
+        router.put(0, [1, 2, 3, 4])
+        router.step()
+        owner = router._owner[0]
+        router.migrate([0], owner)   # storm_limit=0: any event fires
+        router.step()
+        asum = router.anomaly_summary()
+        assert asum["total"] >= 1
+        assert asum["captures"] == []   # budget 0: fired, not armed
+
+
+# --------------------------------------------------------------------------
+# the zero-cost-off bar (counted, PR-10 style)
+# --------------------------------------------------------------------------
+
+class _StubState:
+    def __init__(self):
+        self.seqs = {}
+        self._hash_index = {}
+
+    def prefix_digests(self):
+        return frozenset()
+
+
+class _StubICfg:
+    kv_block_size = 8
+
+
+class _StubEngine:
+    """The minimal engine surface the router's hot path touches — no
+    clocks anywhere, so any perf_counter read counted during a router
+    step is the ROUTER's own."""
+
+    max_blocks_per_seq = 4
+
+    def __init__(self):
+        from deepspeed_tpu.inference.overload import AdmissionVerdict
+        self._verdict = AdmissionVerdict(True, "queued")
+        self.icfg = _StubICfg()
+        self.state = _StubState()
+        self._pending = {}
+        self._meta = {}
+        self._draining = False
+        self._health = "healthy"
+        self.metrics = MetricsRegistry()
+        self.timings = {"step_retries": 0, "steps": 0}
+
+    def put(self, uid, tokens, priority=0, deadline_ms=None):
+        self._pending[uid] = list(tokens)
+        return self._verdict
+
+    def step(self, rng=None, sampling=None):
+        self.timings = dict(self.timings, steps=self.timings["steps"] + 1)
+        return {}
+
+    def _drain_reaped(self):
+        return set()
+
+    def health_state(self):
+        return "healthy"
+
+
+class TestZeroCostOff:
+    def _drive(self, router, steps=8):
+        for u in range(3):
+            router.put(u, [1, 2, 3])
+        for _ in range(steps):
+            router.step()
+
+    def test_off_constructs_no_monitor_and_no_tracer(self, monkeypatch):
+        def boom(*a, **k):
+            raise AssertionError("constructed with telemetry off")
+
+        monkeypatch.setattr(FleetTelemetry, "__init__", boom)
+        router = FleetRouter({"r0": _StubEngine(), "r1": _StubEngine()})
+        assert router.cfg.telemetry == "auto"    # auto resolves OFF
+        assert router._ftel is None
+        monkeypatch.setattr(AnomalyMonitor, "observe", boom)
+        self._drive(router)                      # no detector touched
+
+    def test_off_adds_zero_perf_counter_reads_per_step(self,
+                                                       monkeypatch):
+        """THE counted bar: with stub replicas (no clocks of their
+        own), a router step with fleet telemetry off performs ZERO
+        perf_counter/perf_counter_ns reads — the router's only clock
+        stays its step counter.  Telemetry ON reads clocks (the span
+        ring), proving the counter instrumentation sees them."""
+        reads = [0]
+        real_pc, real_ns = time.perf_counter, time.perf_counter_ns
+
+        def pc():
+            reads[0] += 1
+            return real_pc()
+
+        def ns():
+            reads[0] += 1
+            return real_ns()
+
+        router_off = FleetRouter({"r0": _StubEngine(),
+                                  "r1": _StubEngine()},
+                                 FleetConfig(telemetry="off"))
+        router_on = FleetRouter({"r0": _StubEngine(),
+                                 "r1": _StubEngine()},
+                                FleetConfig(telemetry="on"))
+        monkeypatch.setattr(time, "perf_counter", pc)
+        monkeypatch.setattr(time, "perf_counter_ns", ns)
+        self._drive(router_off)
+        assert reads[0] == 0, \
+            f"telemetry off added {reads[0]} clock reads"
+        self._drive(router_on)
+        assert reads[0] > 0, \
+            "the counter instrumentation saw no reads even with " \
+            "telemetry on — the bar test is vacuous"

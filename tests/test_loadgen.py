@@ -206,6 +206,31 @@ def test_fleet_chaos_covers_all_variants(fleet_chaos_out):
     assert fleet_chaos_out["checks"]["seeded_cache_on_cache_hit"]
 
 
+def test_fleet_chaos_observability_plane(fleet_chaos_out):
+    """PR 14 acceptance (docs/OBSERVABILITY.md "Fleet observability"):
+    the mid-traffic kill produces a VALIDATING fleet post-mortem
+    bundle, a journey for every migrated uid whose hops match the
+    router's actual decisions (the dead replica's requests show
+    failed_over -> placed on a survivor), one Prometheus exposition
+    carrying every replica's series under replica= labels with EXACT
+    migration-deduped fleet token accounting, a fired fleet anomaly
+    whose budgeted capture window completed on the implicated replica,
+    and (first variant) a validating multi-replica merged --fleet
+    Perfetto timeline."""
+    out = fleet_chaos_out
+    for name in out["variants"]:
+        for suffix in ("fleet_dump_valid", "journeys_match_decisions",
+                       "dead_replica_journeys_show_failover",
+                       "exposition_all_replicas", "fleet_tokens_exact",
+                       "terminal_reconciled", "fleet_anomaly_fired",
+                       "anomaly_capture_on_implicated"):
+            assert out["checks"][f"{name}_{suffix}"], f"{name}_{suffix}"
+        assert out["variants"][name]["fleet_anomalies"]["total"] >= 1
+        assert out["variants"][name]["fleet_dumps"] >= 1
+    assert out["checks"]["fleet_timeline_valid"]
+    json.dumps(out)
+
+
 def test_replay_restart_needs_factory():
     eng, _ = build_engine()
     trace = [Request(uid=0, step=0, prompt=[1, 2, 3], max_new=2)]

@@ -624,6 +624,14 @@ def test_fleet_fuzz_invariants(model, seed):
                      "failed", "deadline_exceeded",
                      "context_exhausted", "forgotten"), \
             f"uid {uid} lost with status {s!r}"
+    # the fleet observability reconciliation bar, one last time after
+    # the full drain (check() held it after every op too): the
+    # migration-deduped request_metrics token sums equal the
+    # per-replica counter sums and the record-derived terminal
+    # statuses equal the counter-derived reconciled rollup — the
+    # shed/migrated double counting PR 13 documented stays reconciled
+    # out through every kill/migrate/quarantine interleaving
+    check_fleet_invariants(router)
 
 
 def test_preempt_resume_prefix_cache_parity(model):

@@ -94,6 +94,26 @@ def test_draft_window_key_fixtures():
     assert good == [], [f.human() for f in good]
 
 
+def test_fleet_metric_label_fixtures():
+    """Fleet re-export label hygiene (PR 14): the metric-name rule's
+    registration-site check extends to FleetRegistry receivers
+    (``fleet_registry`` / ``freg``), where an f-string metric NAME is
+    always a finding — per-replica identity is the ``replica=`` label
+    from the handle, never part of the name.  Named off-rule
+    (``*_fleet_metric_label``) so the per-rule parametrized fixtures
+    keep their one-bad-one-good pairing; this pair is scenario
+    coverage for metric-name."""
+    bad = FIXTURES / "bad_fleet_metric_label.py"
+    findings = _lint(bad)
+    assert findings, "metric-name missed the fleet f-string names"
+    assert {f.rule for f in findings} == {"metric-name"}
+    n_bad = sum("# BAD" in line for line in bad.read_text().splitlines())
+    assert len(findings) >= n_bad
+    assert all("replica= label" in f.message for f in findings)
+    good = _lint(FIXTURES / "good_fleet_metric_label.py")
+    assert good == [], [f.human() for f in good]
+
+
 def test_whole_tree_is_clean_fast_and_jax_free():
     """The enforced gate, all three invariants in ONE whole-tree run
     (the two-pass analyzer costs ~9 s — running it once keeps the gate

@@ -1,0 +1,12 @@
+"""Known-bad fixture for the metric-name rule's fleet re-export label
+hygiene: per-replica identity interpolated into the metric NAME on a
+FleetRegistry receiver — the replica belongs in the ``replica=`` label
+(from the handle), never the name, or the re-export forks one series
+per replica that dashboards and rollups can never join back up."""
+
+
+def reexport(fleet_registry, freg, replica):
+    fleet_registry.counter(f"serving_tokens_{replica}_total")  # BAD: replica in the NAME
+    fleet_registry.gauge(f"serving_{replica}_kv_blocks_free")  # BAD: even with the family prefix first
+    freg.histogram(f"serving_ttft_{replica}_ms", (1.0,))       # BAD: same via the freg spelling
+    return fleet_registry

@@ -1,0 +1,16 @@
+"""Known-good twin for the fleet re-export label-hygiene check: ONE
+literal series name per metric, the replica carried as a label VALUE
+from the handle — and non-fleet registries keep their existing
+f-string-with-constant-head allowance."""
+
+
+def reexport(fleet_registry, registry, handle):
+    c = fleet_registry.counter("serving_fleet_tokens_labeled_total")
+    c.inc(5, replica=handle.name)
+    g = fleet_registry.gauge("serving_fleet_replica_lag")
+    g.set(0, replica=handle.name)
+    # an ordinary (non-fleet) registry may still build names from a
+    # constant serving_/training_ head
+    for k in ("schedule", "stage"):
+        registry.counter(f"serving_{k}_ms_total")
+    return c, g

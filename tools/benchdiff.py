@@ -111,10 +111,38 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
     # sensitive, but a leg that suddenly fires 40 latency anomalies is
     # exactly what a reviewer should look at next to a green diff
     anomaly_deltas: List[Dict[str, Any]] = []
+    # fleet anomaly subtrees ({"fleet": {...}, "replicas": {name:
+    # {...}}}, PR 14) report fleet-total AND per-replica deltas —
+    # REPORTED like the flat anomaly deltas, never gated (detector
+    # fires are workload/rig-noise sensitive; a replica suddenly
+    # firing 40 latency anomalies is reviewer material, not a gate)
+    fleet_anomaly_deltas: List[Dict[str, Any]] = []
     for k in sorted(set(old) | set(new)):
         if not k.endswith("_anomalies"):
             continue
         ov, nv = old.get(k), new.get(k)
+        if any(isinstance(v, dict) and "fleet" in v for v in (ov, nv)):
+            of = (ov or {}).get("fleet") if isinstance(ov, dict) else None
+            nf = (nv or {}).get("fleet") if isinstance(nv, dict) else None
+            o = of.get("total") if isinstance(of, dict) else None
+            n = nf.get("total") if isinstance(nf, dict) else None
+            if (o or 0) != (n or 0):
+                fleet_anomaly_deltas.append(
+                    {"metric": f"{k}.fleet", "old": o, "new": n})
+            oreps = (ov or {}).get("replicas") \
+                if isinstance(ov, dict) else None
+            nreps = (nv or {}).get("replicas") \
+                if isinstance(nv, dict) else None
+            oreps = oreps if isinstance(oreps, dict) else {}
+            nreps = nreps if isinstance(nreps, dict) else {}
+            for rep in sorted(set(oreps) | set(nreps)):
+                ro = (oreps.get(rep) or {}).get("total")
+                rn = (nreps.get(rep) or {}).get("total")
+                if (ro or 0) != (rn or 0):
+                    fleet_anomaly_deltas.append(
+                        {"metric": f"{k}.replicas.{rep}",
+                         "old": ro, "new": rn})
+            continue
         o = ov.get("total") if isinstance(ov, dict) else None
         n = nv.get("total") if isinstance(nv, dict) else None
         if o is None and n is None:
@@ -135,6 +163,7 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
         "only_old": only_old,
         "only_new": only_new,
         "anomaly_deltas": anomaly_deltas,
+        "fleet_anomaly_deltas": fleet_anomaly_deltas,
         "ok": match is False or not regressions,
     }
 
@@ -166,6 +195,9 @@ def _render(v: Dict[str, Any]) -> str:
                      f"{e['new']} ({e['rel_change']:+.1%})")
     for e in v.get("anomaly_deltas", []):
         lines.append(f"  anomalies  {e['metric']}: {e['old']} -> "
+                     f"{e['new']} (report-only, never gates)")
+    for e in v.get("fleet_anomaly_deltas", []):
+        lines.append(f"  fleet-anom {e['metric']}: {e['old']} -> "
                      f"{e['new']} (report-only, never gates)")
     lines.append(f"  unchanged: {v['unchanged']}, "
                  f"new-only legs: {len(v['only_new'])}")
@@ -234,6 +266,24 @@ def smoke() -> Dict[str, Any]:
         {"metric": "pipe2_anomalies", "old": 1, "new": 40}], v_an
     assert compare(noisy_base, noisy_base)["anomaly_deltas"] == []
 
+    # fleet anomaly subtrees (PR 14): fleet-total and per-replica
+    # deltas REPORT under fleet_anomaly_deltas and CANNOT fail a run
+    # even under a matching fingerprint
+    fl_base = dict(base, fleet_serving_anomalies={
+        "fleet": {"total": 0, "by_signal": {}},
+        "replicas": {"r0": {"total": 0}, "r1": {"total": 1}}})
+    fl_new = dict(base, fleet_serving_anomalies={
+        "fleet": {"total": 7, "by_signal": {"storm": 7}},
+        "replicas": {"r0": {"total": 40}, "r1": {"total": 1}}})
+    v_fl = compare(fl_base, fl_new)
+    assert v_fl["ok"], v_fl                    # reports, never gates
+    assert v_fl["fleet_anomaly_deltas"] == [
+        {"metric": "fleet_serving_anomalies.fleet", "old": 0, "new": 7},
+        {"metric": "fleet_serving_anomalies.replicas.r0",
+         "old": 0, "new": 40}], v_fl
+    assert v_fl["anomaly_deltas"] == [], v_fl  # not double-reported
+    assert compare(fl_base, fl_base)["fleet_anomaly_deltas"] == []
+
     return {"ok": True,
             "checks": ["enforced_regression_fails",
                        "latency_regression_fails",
@@ -241,7 +291,8 @@ def smoke() -> Dict[str, Any]:
                        "improvement_passes",
                        "dropped_leg_fails",
                        "within_threshold_passes",
-                       "anomaly_delta_reports_not_gates"]}
+                       "anomaly_delta_reports_not_gates",
+                       "fleet_anomaly_delta_reports_not_gates"]}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -750,7 +750,17 @@ def check_fleet_invariants(router) -> None:
     partition holds and no lifecycle record leaks; fleet-wide, every
     open request is owned by exactly ONE live replica (migration can
     never double-run a request) and the owner map never points at a
-    dead replica."""
+    dead replica.
+
+    Plus the fleet observability RECONCILIATION bar (docs/
+    OBSERVABILITY.md "Fleet observability"): the migration-deduped
+    ``request_metrics()`` token sums equal the per-replica engine
+    counter sums EXACTLY, and its record-derived terminal statuses
+    equal the counter-derived reconciled rollup — the shed/migrated
+    double counting PR 13 documented must stay reconciled out on
+    every op."""
+    from deepspeed_tpu.serving import reconciled_terminal_statuses
+
     owned: Dict[int, str] = {}
     for name in router.replica_names:
         rep = router.replica(name)
@@ -769,6 +779,17 @@ def check_fleet_invariants(router) -> None:
     for uid, name in router._owner.items():
         assert not router.replica(name).dead, \
             f"uid {uid} owned by dead replica {name}"
+    agg = router.request_metrics()["aggregate"]
+    for key in ("prompt_tokens", "cached_tokens", "generated_tokens"):
+        ctr = sum(int(router.replica(n).engine.timings[key])
+                  for n in router.replica_names)
+        assert agg[key] == ctr, \
+            f"fleet {key} dedup drifted: records {agg[key]} != " \
+            f"counters {ctr}"
+    reconciled = reconciled_terminal_statuses(router)
+    assert agg["statuses"] == reconciled, \
+        f"fleet terminal statuses diverged: records " \
+        f"{agg['statuses']} != reconciled counters {reconciled}"
 
 
 def _busiest_routable(router) -> Optional[str]:
@@ -934,11 +955,36 @@ def fleet_chaos_smoke(seed: int = 0) -> Dict:
     * the quarantined replica is re-admitted after a clean probe
       (breaker walks open -> half_open -> closed; counted);
     * per-step: allocator partition per live replica, no record leaks,
-      and single-ownership of every open request."""
+      and single-ownership of every open request.
+
+    The fleet OBSERVABILITY plane (docs/OBSERVABILITY.md "Fleet
+    observability") rides every variant end-to-end:
+
+    * the kill leaves a validating fleet post-mortem BUNDLE
+      (auto-dumped on failover: fleet.json + per-replica flight dumps,
+      ``validate_fleet_dump`` clean);
+    * every request's JOURNEY matches the router's actual decisions —
+      first ``placed`` hop == the admission verdict's replica, the
+      dead replica's open requests show ``failed_over`` -> ``placed``
+      on a survivor, and every journey closes;
+    * ONE Prometheus exposition (``router.fleet_registry``) carries
+      every replica's series under ``replica=`` labels with EXACT
+      fleet-wide token accounting: the migration-deduped
+      ``request_metrics()`` sums equal both the per-replica counter
+      sums and the ``serving_fleet_*`` rollups, and the reconciled
+      terminal rollup equals the record-derived statuses;
+    * the kill fires a fleet anomaly (failover/migration storm) whose
+      budgeted capture window COMPLETES on the implicated replica, and
+      (first variant) the merged ``--fleet`` timeline validates with
+      >= 2 replica process groups."""
     import jax
 
     from deepspeed_tpu.inference import FailureConfig, SamplingParams
-    from deepspeed_tpu.serving import FleetConfig
+    from deepspeed_tpu.serving import (FleetConfig, FleetTelemetryConfig,
+                                       reconciled_terminal_statuses,
+                                       validate_fleet_dump)
+    from deepspeed_tpu.telemetry import parse_prometheus_text
+    from tools.tracemerge import merge_fleet, validate_merged_trace
 
     r = np.random.RandomState(seed + 11)
     shared = [int(x) for x in r.randint(1, 120, 16)]
@@ -974,24 +1020,48 @@ def fleet_chaos_smoke(seed: int = 0) -> Dict:
     }
     # fault-free SINGLE-ENGINE reference per sampler: fleet placement,
     # migration, and failover must all be invisible in the streams
+    import os
+    import tempfile
+
     refs = {}
     for mode, (sp, rng) in samplers.items():
         refs[mode] = replay(eng_factory("on"), trace, [], sampling=sp,
                             rng=rng)["tokens"]
+    flight_root = tempfile.mkdtemp(prefix="fleet_chaos_flight_")
     out = {"variants": {}}
     checks: Dict[str, bool] = {}
-    for mode, cache in [("greedy", "on"), ("greedy", "off"),
-                        ("seeded", "on"), ("seeded", "off")]:
+    variants = [("greedy", "on"), ("greedy", "off"),
+                ("seeded", "on"), ("seeded", "off")]
+    for vi, (mode, cache) in enumerate(variants):
         sp, rng = samplers[mode]
         name = f"{mode}_cache_{cache}"
+        fdir = os.path.join(flight_root, name)
+        # the observability plane rides every variant: storm_limit=1
+        # makes the kill's failover+migration burst a deterministic
+        # fleet-anomaly fire, whose budgeted capture lands on the
+        # implicated replica through the engine's ProfilerCapture seam
         router, _ = build_fleet(
             3, model=model_box[0],
-            fleet_cfg=FleetConfig(failure_threshold=2,
-                                  probe_interval_steps=3),
+            fleet_cfg=FleetConfig(
+                failure_threshold=2, probe_interval_steps=3,
+                telemetry="on", flight_dir=fdir,
+                telemetry_cfg=FleetTelemetryConfig(storm_limit=1.0,
+                                                   capture_steps=2)),
             prefix_cache=cache,
             failure=FailureConfig(dispatch_timeout_ms=None))
+        if vi == 0:
+            # first variant also proves the merged FLEET timeline:
+            # explicit windows on two replicas (one wins the process-
+            # wide jax profiler session, the other degrades loudly to
+            # host-only — still its own process group) plus the
+            # anomaly-armed one post-kill
+            router.capture(steps=2, replicas=["r0", "r1"],
+                           reason="chaos")
         res = replay_fleet(router, trace, list(faults), sampling=sp,
                            rng=rng, check_invariants=True)
+        for rn in router.replica_names:
+            if not router.replica(rn).dead:
+                router.replica(rn).engine.finish_capture()
         h = router.health()
         # zero lost: every request exactly one terminal status, and —
         # every record being exact on this trace — all finished
@@ -1025,6 +1095,92 @@ def fleet_chaos_smoke(seed: int = 0) -> Dict:
             hits = sum(int(router.replica(n).engine.timings["prefix_hits"])
                        for n in router.replica_names)
             checks[f"{name}_cache_hit"] = hits > 0
+
+        # ---- the fleet observability plane, end-to-end per variant
+        # (docs/OBSERVABILITY.md "Fleet observability") ----
+        dead = [n for n in router.replica_names
+                if router.replica(n).dead]
+        # (1) the kill auto-dumped a validating post-mortem bundle
+        bundles = sorted(p for p in os.listdir(fdir)
+                         if p.startswith("fleet_failover")) \
+            if os.path.isdir(fdir) else []
+        dump_ok = bool(bundles)
+        for b in bundles:
+            bdir = os.path.join(fdir, b)
+            with open(os.path.join(bdir, "fleet.json")) as f:
+                dump_ok = dump_ok and not validate_fleet_dump(
+                    json.load(f), base_dir=bdir)
+        checks[f"{name}_fleet_dump_valid"] = dump_ok
+        # (2) journeys match the router's actual decisions: the first
+        # placed hop is the admission verdict's replica, the dead
+        # replica's requests show failed_over -> placed on a survivor,
+        # and every journey closed
+        journeys_ok = True
+        failed_over_seen = 0
+        for q in trace:
+            j = router.request_journey(q.uid) or []
+            placed = [e for e in j if e["event"] == "placed"]
+            journeys_ok = journeys_ok and bool(placed) \
+                and placed[0]["replica"] == res["placements"][q.uid] \
+                and j[-1]["event"] == "closed"
+            hops = [e["event"] for e in j]
+            if "failed_over" in hops:
+                failed_over_seen += 1
+                k = hops.index("failed_over")
+                journeys_ok = journeys_ok \
+                    and j[k].get("replica") in dead \
+                    and "placed" in hops[k:]
+        checks[f"{name}_journeys_match_decisions"] = journeys_ok
+        checks[f"{name}_dead_replica_journeys_show_failover"] = \
+            failed_over_seen >= 1
+        # (3) ONE exposition, every replica's series under replica=
+        # labels, fleet token accounting EXACT (migration-deduped):
+        # deduped record sums == per-replica counter sums == rollup,
+        # and the reconciled terminal rollup == record statuses
+        parsed = parse_prometheus_text(
+            router.fleet_registry.prometheus_text())
+        steps_samples = parsed["serving_steps_total"]["samples"]
+        replicas_seen = {dict(k[1]).get("replica")
+                         for k in steps_samples}
+        checks[f"{name}_exposition_all_replicas"] = \
+            replicas_seen == set(router.replica_names)
+        rm = router.request_metrics()
+        agg = rm["aggregate"]
+        tokens_exact = True
+        for key in ("prompt_tokens", "cached_tokens",
+                    "generated_tokens"):
+            ctr_sum = sum(int(router.replica(n).engine.timings[key])
+                          for n in router.replica_names)
+            roll = parsed[f"serving_fleet_{key}_total"]["samples"]
+            tokens_exact = tokens_exact and agg[key] == ctr_sum \
+                and int(sum(roll.values())) == ctr_sum
+        checks[f"{name}_fleet_tokens_exact"] = tokens_exact
+        rec_statuses = dict(agg["statuses"])
+        checks[f"{name}_terminal_reconciled"] = \
+            rec_statuses == reconciled_terminal_statuses(router)
+        # (4) the kill fired a fleet anomaly whose budgeted capture
+        # window COMPLETED on the implicated replica
+        asum = router.anomaly_summary()
+        checks[f"{name}_fleet_anomaly_fired"] = \
+            asum["by_signal"].get("failover_migration_storm", 0) >= 1
+        cap_ok = False
+        for cap in asum["captures"]:
+            eng_caps = router.replica(cap["replica"]).engine.capture_dirs
+            cap_ok = cap_ok or cap["dir"] in eng_caps
+        checks[f"{name}_anomaly_capture_on_implicated"] = cap_ok
+        # (5) first variant: the merged --fleet timeline validates
+        # with >= 2 replica process groups
+        if vi == 0:
+            bdir = os.path.join(fdir, bundles[-1]) if bundles else fdir
+            merged_ok = False
+            if bundles:
+                # re-dump AFTER the replay so the bundle's capture
+                # list includes the completed windows
+                router.debug_dump(bdir, reason="failover")
+                with open(merge_fleet(bdir)) as f:
+                    merged_ok = not validate_merged_trace(
+                        json.load(f), require_replicas=2)
+            checks["fleet_timeline_valid"] = merged_ok
         out["variants"][name] = {
             "steps": res["steps"],
             "statuses": {s: list(res["status"].values()).count(s)
@@ -1037,6 +1193,9 @@ def fleet_chaos_smoke(seed: int = 0) -> Dict:
                 "serving_fleet_quarantines_total").value()),
             "readmissions": int(router.metrics.get(
                 "serving_fleet_readmissions_total").value()),
+            "fleet_anomalies": {"total": asum["total"],
+                                "by_signal": asum["by_signal"]},
+            "fleet_dumps": len(bundles),
         }
     out["checks"] = checks
     out["ok"] = all(checks.values())
@@ -1080,7 +1239,13 @@ def fleet_bench(seed: int = 0, n_requests: int = 18) -> Dict:
     tok/s of wall), the measured prefix hit rate (cached / prompt
     tokens summed over replicas — engine truth, not placement-time
     guesses), failover/migration counts, and p95 TTFT for requests
-    arriving before vs after the kill."""
+    arriving before vs after the kill.
+
+    Every leg runs the fleet telemetry plane AND per-engine anomaly +
+    device telemetry on (symmetric across the affinity/round-robin
+    comparison), so the BENCH JSON carries fleet anomaly summaries and
+    aggregated fleet device metrics next to the headline numbers
+    (docs/OBSERVABILITY.md "Fleet observability")."""
     from deepspeed_tpu.inference import FailureConfig, SamplingParams
 
     sp = SamplingParams(max_new_tokens=1 << 30)
@@ -1095,6 +1260,7 @@ def fleet_bench(seed: int = 0, n_requests: int = 18) -> Dict:
         eng, m = build_engine(
             None, model=model_box[0] if model_box else None,
             prefix_cache="on", num_kv_blocks=48, max_seq_len=96,
+            anomaly="on", device_telemetry="on",
             failure=FailureConfig(dispatch_timeout_ms=None))
         if not model_box:
             model_box.append(m)
@@ -1115,7 +1281,7 @@ def fleet_bench(seed: int = 0, n_requests: int = 18) -> Dict:
         from deepspeed_tpu.serving import FleetConfig, FleetRouter
         router = FleetRouter(
             {f"r{i}": eng_factory() for i in range(n_replicas)},
-            FleetConfig(placement=placement))
+            FleetConfig(placement=placement, telemetry="on"))
         faults = [Fault("kill", step=kill_step)] if with_kill else []
         t0 = time.perf_counter()
         res = replay_fleet(router, trace, faults, sampling=sp)
@@ -1131,6 +1297,22 @@ def fleet_bench(seed: int = 0, n_requests: int = 18) -> Dict:
         post = [ms for u, ms in res["ttft_ms"].items()
                 if arrive[u] >= kill_step]
         h = router.health()
+        asum = router.anomaly_summary()
+        # fleet + per-replica anomaly tallies, and the device-metric
+        # aggregate (per-program costs live per replica; the fleet
+        # sums carry the headline totals)
+        dev_reps = {}
+        flops = hbm = 0.0
+        for n in router.replica_names:
+            snap = router.replica(n).engine.device_snapshot()
+            dev_reps[n] = snap
+            if snap:
+                flops += snap.get("model_flops_total") or 0.0
+                hbm += snap.get("hbm_bytes_total") or 0.0
+        eng_anoms = {
+            n: (router.replica(n).engine.anomaly_summary() or
+                {"total": 0, "by_signal": {}})
+            for n in router.replica_names}
         return {
             "replicas": n_replicas,
             "placement": placement,
@@ -1144,6 +1326,18 @@ def fleet_bench(seed: int = 0, n_requests: int = 18) -> Dict:
             "ttft_p95_postkill_ms": _pct(post, 95),
             "placement_hit_rate": router.metrics.snapshot().get(
                 "serving_fleet_placement_hit_rate"),
+            "anomalies": {
+                "fleet": {"total": asum["total"],
+                          "by_signal": asum["by_signal"]},
+                "replicas": {n: {"total": a["total"],
+                                 "by_signal": a["by_signal"]}
+                             for n, a in eng_anoms.items()},
+            },
+            "device_metrics": {
+                "fleet": {"model_flops_total": flops,
+                          "hbm_bytes_total": hbm},
+                "replicas": dev_reps,
+            },
         }
 
     single = run(1, "affinity", with_kill=False)

@@ -922,8 +922,13 @@ _METRIC_REG_ATTRS = {"counter", "gauge", "gauge_fn", "histogram"}
 # receiver segments that identify a metrics registry (the conventional
 # spellings: ``reg`` / ``registry`` locals, ``self.metrics`` /
 # ``engine.metrics`` attributes) — whole-segment matched, like
-# telemetry-hotpath's receiver check
-_REGISTRY_SEGMENTS = {"reg", "registry", "metrics"}
+# telemetry-hotpath's receiver check.  The FleetRegistry re-export
+# view (serving/fleet_telemetry.py: ``router.fleet_registry`` / a
+# ``freg`` local) is a registration site too — its delegating
+# counter/gauge/gauge_fn/histogram land in the fleet exposition
+_FLEET_REGISTRY_SEGMENTS = {"fleet_registry", "freg"}
+_REGISTRY_SEGMENTS = {"reg", "registry", "metrics"} \
+    | _FLEET_REGISTRY_SEGMENTS
 
 
 def _metric_name_literal(arg: ast.AST):
@@ -960,8 +965,23 @@ def check_metric_name(program) -> Iterator[Finding]:
                     and node.args):
                 continue
             recv = dotted(node.func.value) or ""
-            if not set(recv.split(".")) & _REGISTRY_SEGMENTS:
+            segs = set(recv.split("."))
+            if not segs & _REGISTRY_SEGMENTS:
                 continue          # not a metrics-registry receiver
+            is_fleet = bool(segs & _FLEET_REGISTRY_SEGMENTS)
+            if is_fleet and isinstance(node.args[0], ast.JoinedStr):
+                # fleet re-export label hygiene: per-replica identity
+                # is the `replica=` label (from the handle) — an
+                # f-string metric NAME forks one series per replica,
+                # and dashboards/rollups never join them back up
+                yield Finding(
+                    "metric-name", ctx.path, node.lineno,
+                    node.col_offset,
+                    "f-string metric name on a FleetRegistry receiver "
+                    "— fleet re-export names are ONE literal per "
+                    "series; put the replica in the replica= label "
+                    "(from the handle), never the metric name")
+                continue
             name, prefix = _metric_name_literal(node.args[0])
             if name is not None:
                 if not _METRIC_NAME_RE.match(name):

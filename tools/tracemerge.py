@@ -362,12 +362,16 @@ def merge_events(host_events: List[Dict[str, Any]],
     return out
 
 
-def merge_capture(capture_dir: str,
-                  out_path: Optional[str] = None) -> str:
-    """Merge one capture window's artifacts
-    (telemetry/profiler.py layout: ``meta.json`` + ``host_trace.json``
-    + ``device/``) into a single Perfetto-loadable Chrome trace;
-    returns the written path (default ``<capture_dir>/merged.json``)."""
+def _capture_events(capture_dir: str
+                    ) -> Tuple[List[Dict[str, Any]], Dict[str, Any],
+                               int, bool]:
+    """One capture window's events, already merged onto the host
+    ``perf_counter`` timeline (µs): host spans as recorded, device
+    events shifted by the capture's OWN clock anchor.  Returns
+    ``(events, meta, n_host_events, device_absent)`` — the shared core
+    of :func:`merge_capture` and :func:`merge_fleet` (each capture is
+    clock-anchored per artifact, so a fleet merge aligns N windows
+    from N replicas on one timeline)."""
     with open(os.path.join(capture_dir, "meta.json")) as f:
         meta = json.load(f)
     host: Dict[str, Any] = {"traceEvents": []}
@@ -382,20 +386,31 @@ def merge_capture(capture_dir: str,
             device_events = load_device_events(
                 ddir, meta.get("t_start_epoch_ns", 0))
             device_absent = not device_events
+    host_events = host.get("traceEvents", [])
+    return (merge_events(host_events, device_events,
+                         meta["t_start_perf_ns"]),
+            meta, len(host_events), device_absent)
+
+
+def merge_capture(capture_dir: str,
+                  out_path: Optional[str] = None) -> str:
+    """Merge one capture window's artifacts
+    (telemetry/profiler.py layout: ``meta.json`` + ``host_trace.json``
+    + ``device/``) into a single Perfetto-loadable Chrome trace;
+    returns the written path (default ``<capture_dir>/merged.json``)."""
+    events, meta, n_host, device_absent = _capture_events(capture_dir)
     if device_absent:
         print(f"tracemerge: NO device events under {capture_dir} — "  # tpulint: disable=print — CLI/loud-degradation output
               "emitting a host-only timeline (profiler absent or "
               "unsupported on this backend/build)")
     merged = {
         "displayTimeUnit": "ms",
-        "traceEvents": merge_events(host.get("traceEvents", []),
-                                    device_events,
-                                    meta["t_start_perf_ns"]),
+        "traceEvents": events,
         "otherData": {
             "merged_by": "tools/tracemerge",
             "capture": meta,
-            "host_events": len(host.get("traceEvents", [])),
-            "device_events": len(device_events),
+            "host_events": n_host,
+            "device_events": len(events) - n_host,
             "device_absent": device_absent,
         },
     }
@@ -405,17 +420,113 @@ def merge_capture(capture_dir: str,
     return out_path
 
 
+# --------------------------------------------------------------------------
+# fleet merge: router trace + N replica capture artifacts
+# --------------------------------------------------------------------------
+
+# per-replica pid stride in a --fleet merge: replica i's events (host
+# AND device — the capture's own +10000 device bump rides inside) are
+# shifted by (i+1) * stride, so each replica renders as its own
+# Perfetto process group while the router trace keeps the base pids
+_FLEET_PID_STRIDE = 100_000
+
+
+def merge_fleet(fleet_dir: str, out_path: Optional[str] = None) -> str:
+    """Merge a fleet post-mortem bundle (``FleetRouter.debug_dump``
+    layout: ``fleet.json`` + ``router_trace.json`` + per-replica
+    capture artifacts) onto ONE Perfetto timeline
+    (docs/OBSERVABILITY.md "Fleet observability").
+
+    The router's span ring — placement / migrate / failover spans and
+    journey instants, each carrying ``uid`` + ``replica`` args — stays
+    at the base pids; every replica's capture windows merge through
+    their OWN clock anchors (all replicas share the in-process
+    ``perf_counter`` clock) and are shifted into a per-replica pid
+    range, so one request's journey is flow-connectable across the
+    router track and the replica process groups by its shared ``uid``
+    arg.  Replicas whose captures are missing are reported loudly and
+    skipped — the merge still completes."""
+    with open(os.path.join(fleet_dir, "fleet.json")) as f:
+        dump = json.load(f)
+    events: List[Dict[str, Any]] = []
+    if dump.get("router_trace"):
+        with open(os.path.join(fleet_dir, dump["router_trace"])) as f:
+            events.extend(json.load(f).get("traceEvents", []))
+    else:
+        print(f"tracemerge: fleet bundle {fleet_dir} carries no "  # tpulint: disable=print — CLI/loud-degradation output
+              "router trace (telemetry plane off?) — replica tracks "
+              "only")
+    per_replica: Dict[str, int] = {}
+    device_absent = True
+    for i, name in enumerate(sorted(dump.get("replicas", {}))):
+        info = dump["replicas"][name]
+        offset = (i + 1) * _FLEET_PID_STRIDE
+        n_ev = 0
+        for cdir in info.get("captures", ()):
+            if not os.path.isdir(cdir):
+                rel = os.path.join(fleet_dir, cdir)
+                if os.path.isdir(rel):
+                    cdir = rel
+                else:
+                    print(f"tracemerge: replica {name} capture "  # tpulint: disable=print — CLI/loud-degradation output
+                          f"{cdir} missing — skipped")
+                    continue
+            try:
+                evs, _, n_host, absent = _capture_events(cdir)
+            except (OSError, ValueError, KeyError) as e:
+                print(f"tracemerge: replica {name} capture {cdir} "  # tpulint: disable=print — CLI/loud-degradation output
+                      f"unreadable ({type(e).__name__}: {e}) — skipped")
+                continue
+            device_absent = device_absent and absent
+            for ev in evs:
+                if not isinstance(ev, dict):
+                    continue
+                ev = dict(ev)
+                ev["pid"] = ev.get("pid", 0) + offset
+                if ev.get("name") == "process_name" \
+                        and isinstance(ev.get("args"), dict):
+                    ev["args"] = {**ev["args"],
+                                  "name": f"replica {name}: "
+                                          f"{ev['args'].get('name', '')}"}
+                events.append(ev)
+                n_ev += 1
+        per_replica[name] = n_ev
+    merged = {
+        "displayTimeUnit": "ms",
+        "traceEvents": events,
+        "otherData": {
+            "merged_by": "tools/tracemerge --fleet",
+            "fleet": {"reason": dump.get("reason"),
+                      "steps": dump.get("steps")},
+            "replica_events": per_replica,
+            "replica_groups": sum(1 for n in per_replica.values() if n),
+            "device_absent": device_absent,
+        },
+    }
+    out_path = out_path or os.path.join(fleet_dir, "merged_fleet.json")
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    return out_path
+
+
 def validate_merged_trace(obj: Dict[str, Any],
                           require_device: bool = True,
-                          require_scopes: Sequence[str] = ()) -> List[str]:
+                          require_scopes: Sequence[str] = (),
+                          require_replicas: int = 0) -> List[str]:
     """Schema check for a merged timeline: returns violations (empty
     when valid).  Valid means Chrome-trace-shaped (``traceEvents`` list
     of dicts with ``ph``), containing at least one host SpanTracer
     track (pid 1 thread_name metadata) and — unless ``require_device``
-    is off — at least one device-derived duration event (pid >=
-    10000).  ``require_scopes``: substrings that must each match some
-    device event's name or scoped ``args.op_name`` — how a test pins
-    the T3 tile-comm scopes to actual device activity."""
+    is off — at least one device-derived duration event (pid whose
+    in-group offset is >= 10000; in a ``--fleet`` merge each replica's
+    events live in their own pid group of stride 100000, the device
+    bump riding inside).  ``require_scopes``: substrings that must
+    each match some device event's name or scoped ``args.op_name`` —
+    how a test pins the T3 tile-comm scopes to actual device activity.
+    ``require_replicas``: minimum number of distinct replica process
+    groups a ``--fleet`` merge must carry (the multi-replica presence
+    bar — a fleet timeline with one replica track explains nothing
+    about the fleet)."""
     problems: List[str] = []
     evs = obj.get("traceEvents")
     if not isinstance(evs, list) or not evs:
@@ -435,10 +546,18 @@ def validate_merged_trace(obj: Dict[str, Any],
                   and e.get("ph") == "X"]
     if not host_spans:
         problems.append("no host span events")
-    dev = [e for e in evs if e.get("pid", 0) >= 10_000
+    dev = [e for e in evs
+           if e.get("pid", 0) % _FLEET_PID_STRIDE >= 10_000
            and e.get("ph") == "X"]
     if require_device and not dev:
         problems.append("no device-derived events (pid >= 10000)")
+    if require_replicas:
+        groups = {e.get("pid", 0) // _FLEET_PID_STRIDE for e in evs
+                  if e.get("pid", 0) >= _FLEET_PID_STRIDE}
+        if len(groups) < require_replicas:
+            problems.append(
+                f"{len(groups)} replica process group(s) < required "
+                f"{require_replicas} (pid stride {_FLEET_PID_STRIDE})")
     for scope in require_scopes:
         if not any(scope in e.get("name", "")
                    or (isinstance(e.get("args"), dict)
@@ -456,19 +575,32 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("capture_dir",
                     help="capture window directory "
-                    "(telemetry/profiler.py layout)")
+                    "(telemetry/profiler.py layout), or with --fleet "
+                    "a fleet post-mortem bundle "
+                    "(FleetRouter.debug_dump layout)")
     ap.add_argument("-o", "--out", default=None,
                     help="output path (default: "
-                    "<capture_dir>/merged.json)")
+                    "<capture_dir>/merged.json, or "
+                    "<bundle>/merged_fleet.json with --fleet)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="merge a fleet bundle: router trace + every "
+                    "replica's capture artifacts as per-replica "
+                    "process groups")
     ap.add_argument("--validate", action="store_true",
                     help="schema-check the merged file and exit "
-                    "nonzero on violations")
+                    "nonzero on violations (with --fleet, also "
+                    "requires >= 2 replica process groups)")
     args = ap.parse_args(argv)
-    path = merge_capture(args.capture_dir, args.out)
+    if args.fleet:
+        path = merge_fleet(args.capture_dir, args.out)
+    else:
+        path = merge_capture(args.capture_dir, args.out)
     print(path)  # tpulint: disable=print — the CLI's one output line
     if args.validate:
         with open(path) as f:
-            problems = validate_merged_trace(json.load(f))
+            problems = validate_merged_trace(
+                json.load(f),
+                require_replicas=2 if args.fleet else 0)
         if problems:
             print("\n".join(problems))  # tpulint: disable=print — CLI output
             return 1
