@@ -246,6 +246,7 @@ def main(trace_path=None, profile_dir=None):
     overload = leg(overload_serving_bench, on_tpu)
     chaos = leg(chaos_serving_bench, on_tpu)
     fleet = leg(fleet_serving_bench, on_tpu)
+    http = leg(http_serving_bench, on_tpu)
     llama_train = leg(llama_train_bench, on_tpu, peak)
     llama_serve = leg(llama8b_serving_bench, on_tpu)
     moe = leg(moe_train_bench, on_tpu, peak)
@@ -269,8 +270,8 @@ def main(trace_path=None, profile_dir=None):
     }
     out.update(serve)
     print(json.dumps({**out, **pipe, **prefix, **spec, **overload,  # tpulint: disable=print — the bench's one JSON output line
-                      **chaos, **fleet, **llama_train, **llama_serve,
-                      **moe, **comm}))
+                      **chaos, **fleet, **http, **llama_train,
+                      **llama_serve, **moe, **comm}))
 
 
 def bench_fingerprint():
@@ -386,6 +387,30 @@ def fleet_serving_bench(on_tpu: bool):
             # and the aggregated fleet device metrics
             "fleet_serving_anomalies": out["affinity"]["anomalies"],
             "fleet_device_metrics": out["affinity"]["device_metrics"]}
+
+
+def http_serving_bench(on_tpu: bool):
+    """Sockets-to-tokens leg (docs/SERVING.md "Network gateway"): the
+    same seeded bursty trace through the in-process ``replay`` driver
+    and through real loopback sockets against a spawned gateway, with
+    token parity asserted inside before anything is recorded.  The
+    headline metrics land top-level so ``tools/benchdiff.py``'s
+    existing direction rules gate them: ``http_goodput_tok_s`` /
+    ``inproc_goodput_tok_s`` up-is-better, ``http_ttft_p95_ms`` /
+    ``inproc_ttft_p95_ms`` down-is-better, and the measured wire
+    overhead ``http_ttft_overhead_ratio`` (client-wall p95 over
+    in-process engine-record p95) is gated down-is-better too — a PR
+    that makes the gateway slower relative to the engine fails the
+    same-config compare even when both got faster in absolute terms."""
+    from tools.loadgen import http_bench
+
+    out = http_bench(seed=0)
+    return {"http_serving": out,
+            "http_goodput_tok_s": out["http_goodput_tok_s"],
+            "inproc_goodput_tok_s": out["inproc_goodput_tok_s"],
+            "http_ttft_p95_ms": out["http_ttft_p95_ms"],
+            "inproc_ttft_p95_ms": out["inproc_ttft_p95_ms"],
+            "http_ttft_overhead_ratio": out["http_ttft_overhead_ratio"]}
 
 
 def moe_train_bench(on_tpu: bool, peak: float):

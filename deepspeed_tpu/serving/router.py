@@ -910,6 +910,24 @@ class FleetRouter:
                 code = _HEALTH_CODE.get(rep.engine.health_state(), 3)
             self._g_rep_health.set(code, replica=name)
 
+    def health_state(self) -> str:
+        """The fleet's cheap health-LADDER read, mirroring
+        ``engine.health_state()`` so a front-end (the network gateway)
+        probes either backend shape through one seam: ``dead`` when no
+        replica is alive, ``degraded`` when nothing is routable or any
+        live replica is degraded/quarantined, else ``healthy``.  No
+        gauge writes, no memory polls — :meth:`health` is the
+        phase-boundary probe."""
+        live = [rep for rep in self._reps.values() if not rep.dead]
+        if not live:
+            return "dead"
+        if not self._routable():
+            return "degraded"
+        if any(rep.engine.health_state() != "healthy"
+               or rep.breaker.state != "closed" for rep in live):
+            return "degraded"
+        return "healthy"
+
     def health(self) -> Dict:
         """Fleet health summary — the gateway's ``/healthz`` payload:
         per-replica engine state + breaker state + load, and the

@@ -100,6 +100,30 @@ def test_fleet_leg_metrics_are_gated():
                for r in v["regressions"])
 
 
+def test_http_leg_metrics_are_gated():
+    """The http_serving_bench leg (PR 15, the network gateway): its
+    headline metrics land top-level under names the EXISTING direction
+    rules gate — goodput up-is-better for both columns, TTFT ms
+    down-is-better, and the wire-overhead ratio (client-wall TTFT p95
+    over in-process engine-record p95) is gated down-is-better via its
+    ``ttft`` stem, so a gateway that gets relatively slower fails a
+    same-fingerprint compare even when both legs improved."""
+    assert metric_direction("http_goodput_tok_s") == 1
+    assert metric_direction("inproc_goodput_tok_s") == 1
+    assert metric_direction("http_ttft_p95_ms") == -1
+    assert metric_direction("inproc_ttft_p95_ms") == -1
+    assert metric_direction("http_ttft_overhead_ratio") == -1
+    # and an overhead regression actually trips the gate
+    base = {"engine_version": "1", "config_hash": "aaaa",
+            "value": 100.0, "http_goodput_tok_s": 50.0,
+            "http_ttft_overhead_ratio": 1.1}
+    worse = dict(base, http_ttft_overhead_ratio=1.6)
+    v = compare(base, worse)
+    assert not v["ok"]
+    assert any(r["metric"] == "http_ttft_overhead_ratio"
+               for r in v["regressions"])
+
+
 def test_matching_fingerprint_enforces_and_exits_nonzero(tmp_path):
     old = {"engine_version": "1", "config_hash": "aaaa",
            "value": 100.0, "serving_decode_tok_s": 700.0}

@@ -16,7 +16,8 @@ import json
 import pytest
 
 from tools.loadgen import (Fault, Request, build_engine, chaos_smoke,
-                           default_faults, fleet_chaos_smoke, make_trace,
+                           default_faults, fleet_chaos_smoke,
+                           http_chaos_smoke, http_smoke, make_trace,
                            replay, run_sweep, smoke, summarize)
 
 
@@ -248,3 +249,79 @@ def test_fifo_baseline_sees_head_of_line_blowup(smoke_out):
     fifo_p95 = out["fifo"]["ttft_steps_p95"]
     assert hi is not None and fifo_p95 is not None
     assert hi <= fifo_p95
+
+
+# --------------------------------------------------------------------------
+# over-HTTP: the sockets legs (docs/SERVING.md "Network gateway")
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def http_smoke_out():
+    """One sockets-parity run shared by the assertions below (two
+    spawned gateways + two reference engines of compile is the
+    expensive part) — identical to ``python -m tools.loadgen --http``."""
+    return http_smoke(seed=0)
+
+
+def test_http_smoke_is_the_wire_acceptance_check(http_smoke_out):
+    """Greedy AND seeded streams over real loopback sockets are
+    token-identical to the in-process replay, every request reaches a
+    terminal wire status, nothing leaks (per-pump allocator checks
+    armed), and /healthz + /metrics round-trip through the existing
+    Prometheus parser."""
+    out = http_smoke_out
+    assert out["ok"] and all(out["checks"].values()), out["checks"]
+    for mode in ("greedy", "seeded"):
+        leg = out["variants"][mode]
+        assert leg["statuses"] == {"finished": leg["requests"]}
+        # the SLO-curve shape matches the in-process summaries: the
+        # two legs are directly comparable columns
+        for key in ("goodput_tok_s", "ttft_ms_p50", "ttft_ms_p95",
+                    "tpot_ms_p50", "tpot_ms_p95", "wall_s"):
+            assert key in leg
+    json.dumps(out)                          # BENCH-JSON serializable
+
+
+@pytest.fixture(scope="module")
+def http_chaos_out():
+    """One wire-chaos run shared below — identical to
+    ``python -m tools.loadgen --http-chaos``."""
+    return http_chaos_smoke(seed=0)
+
+
+def test_http_chaos_disconnects_cancel_exactly(http_chaos_out):
+    """Mid-stream client disconnects at seeded token offsets ride the
+    engine's cancel() path: terminal status ``cancelled`` for exactly
+    the abandoned uids, zero record/block leaks with invariants
+    asserted after every pump, and every unaffected stream
+    token-identical to a fault-free in-process run — greedy and
+    seeded."""
+    out = http_chaos_out
+    assert out["ok"] and all(out["checks"].values()), out["checks"]
+    assert len(out["disconnects"]) == 2
+    for mode in ("greedy", "seeded"):
+        v = out["variants"][mode]
+        assert all(s == "cancelled" for s in v["engine_status"].values())
+        assert v["statuses"]["disconnected"] == 2
+        # the wire journey recorded the disconnect before the close
+        for j in v["wire_journeys"].values():
+            phases = [s["phase"] for s in j]
+            assert "disconnect" in phases
+            assert phases.index("disconnect") < phases.index("closed")
+    json.dumps(out)
+
+
+def test_http_chaos_drain_contract(http_chaos_out):
+    """The SIGTERM-drain variant: in-flight streams run to completion
+    (full token budgets, finish_reason ``length``), a late arrival
+    gets 503 + Retry-After, the gateway exits clean holding the
+    backend's final drain snapshot, and the drained engine leaks
+    nothing."""
+    out = http_chaos_out
+    assert out["checks"]["drain_late_503"]
+    assert out["checks"]["drain_inflight_complete"]
+    assert out["checks"]["drain_exit_clean"]
+    assert out["checks"]["drain_no_leak"]
+    assert out["checks"]["drain_backend_drained"]
+    assert out["drain"]["late"]["code"] == 503
+    assert all(r == "length" for r in out["drain"]["inflight"].values())

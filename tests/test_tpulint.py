@@ -474,3 +474,20 @@ def test_cli_exit_codes():
         cwd=REPO, capture_output=True, text=True)
     assert r.returncode == 0, \
         f"tpulint flagged the clean fixture:\n{r.stdout}\n{r.stderr}"
+
+
+def test_async_blocking_nested_coroutine_no_duplicates():
+    """A coroutine nested inside another coroutine is its OWN scope:
+    ast.walk visits both, so the outer walk must not descend into the
+    inner AsyncFunctionDef or its calls get reported twice (and
+    misattributed to the outer function).  Exact-count check — the
+    shared fixture test only asserts >= BAD markers, which duplicates
+    would satisfy."""
+    bad = FIXTURES / "bad_async_blocking.py"
+    findings = _lint(bad)
+    n_bad = sum("# BAD" in line for line in bad.read_text().splitlines())
+    assert len(findings) == n_bad, \
+        [f.human() for f in findings]
+    nested = [f for f in findings if "backend.step" in f.message]
+    assert len(nested) == 1
+    assert "async def inner" in nested[0].message

@@ -1,0 +1,43 @@
+"""Known-bad: synchronous blocking work directly inside ``async def``
+(tpulint: async-blocking — one blocked coroutine stalls the whole
+event loop: every open SSE stream, every health probe, every metrics
+scrape behind one engine step)."""
+import asyncio
+import time
+
+
+async def drive(engine):
+    out = engine.step()                      # BAD: engine step on the loop
+    return out
+
+
+async def finish(backend):
+    backend.drain(1000.0)                    # BAD: drain blocks for seconds
+
+
+async def admit(backend, uid, tokens):
+    verdict = backend.put(uid, tokens)       # BAD: engine put on the loop
+    return verdict
+
+
+async def throttle():
+    time.sleep(0.5)                          # BAD: blocking sleep
+    asyncio.sleep(0.5)                       # BAD: un-awaited -> no-op
+
+
+async def proxy(sock):
+    data = sock.recv(4096)                   # BAD: blocking socket read
+    sock.sendall(data)                       # BAD: blocking socket write
+    return data
+
+
+async def probe(router):
+    return router.health()                   # BAD: fleet probe on the loop
+
+
+async def outer(backend):
+    # a NESTED coroutine is its own scope: its blocking call is
+    # reported exactly once, attributed to `inner`
+    async def inner():
+        return backend.step()                # BAD: inner coroutine blocks
+    return await inner()

@@ -1,0 +1,41 @@
+"""Known-good twin of bad_async_blocking (no findings): every blocking
+call routes through the executor/to_thread seam, awaits are awaited,
+and sync helpers keep their direct engine calls (they run ON the
+engine thread)."""
+import asyncio
+from functools import partial
+
+
+async def drive(engine, executor):
+    loop = asyncio.get_running_loop()
+    # the executor pattern: the engine call is an ARGUMENT, not a call
+    out = await loop.run_in_executor(executor, engine.step)
+    return out
+
+
+async def finish(backend, executor):
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(
+        executor, partial(backend.drain, 1000.0))
+
+
+async def admit(backend, uid, tokens):
+    # to_thread hands the thunk off the loop; the lambda's body is the
+    # deferred sync context, not this coroutine's
+    return await asyncio.to_thread(lambda: backend.put(uid, tokens))
+
+
+async def throttle():
+    await asyncio.sleep(0.5)
+
+
+async def pump(queue, watcher):
+    item = await queue.get()
+    queue.put_nowait(item)          # non-blocking queue op
+    watcher.cancel()                # asyncio.Task.cancel: not an engine
+    return item
+
+
+def drain_backlog(engine):
+    # sync helper: runs on the engine thread, direct calls are its job
+    return engine.drain(500.0)

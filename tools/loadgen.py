@@ -24,6 +24,9 @@ CLI::
     python -m tools.loadgen --chaos              # failure-domain leg
     python -m tools.loadgen --fleet-chaos        # replica-fleet chaos leg
     python -m tools.loadgen --fleet-bench        # 1-vs-3-replica sweep
+    python -m tools.loadgen --http               # sockets parity leg
+    python -m tools.loadgen --http-chaos         # disconnect + drain leg
+    python -m tools.loadgen --http-bench         # in-process vs HTTP curves
     python -m tools.loadgen --qps 0.5,2,8 --requests 64 --arrival bursty \
         --shed-policy evict-lowest --out slo.json
 
@@ -1350,6 +1353,528 @@ def fleet_bench(seed: int = 0, n_requests: int = 18) -> Dict:
 
 
 # --------------------------------------------------------------------------
+# over-HTTP: the same traces through real sockets (docs/SERVING.md
+# "Network gateway")
+# --------------------------------------------------------------------------
+
+def _http_read_head(f) -> Tuple[int, Dict[str, str]]:
+    """Status code + lowercased headers from a response file object."""
+    line = f.readline()
+    if not line:
+        raise ConnectionError("empty HTTP response")
+    code = int(line.split()[1])
+    headers: Dict[str, str] = {}
+    while True:
+        raw = f.readline()
+        if not raw or raw in (b"\r\n", b"\n"):
+            break
+        k, _, v = raw.decode("ascii", "replace").partition(":")
+        headers[k.strip().lower()] = v.strip()
+    return code, headers
+
+
+def http_get(host: str, port: int, path: str,
+             timeout: float = 30.0) -> Tuple[int, Dict[str, str], bytes]:
+    """One blocking GET (healthz / metrics probes)."""
+    import socket
+
+    with socket.create_connection((host, port), timeout=timeout) as sock:
+        sock.sendall(f"GET {path} HTTP/1.1\r\nHost: loadgen\r\n"
+                     "Connection: close\r\n\r\n".encode("ascii"))
+        f = sock.makefile("rb")
+        code, headers = _http_read_head(f)
+        body = f.read()
+        f.close()
+    return code, headers, body
+
+
+def http_completion(host: str, port: int, payload: Dict,
+                    slo: Optional[str] = None, timeout: float = 120.0,
+                    disconnect_after: Optional[int] = None) -> Dict:
+    """One ``POST /v1/completions`` over a real socket.  Streams SSE
+    when ``payload["stream"]``; ``disconnect_after=k`` abandons the
+    connection after reading ``k`` tokens (the mid-stream-disconnect
+    chaos client).  Returns wire-side truth: HTTP code, tokens read,
+    wall TTFT/mean-TPOT ms, the final ``finish_reason``, and the
+    ``Retry-After`` header when shed."""
+    import socket
+
+    body = json.dumps(payload).encode("utf-8")
+    extra = f"x-slo-class: {slo}\r\n" if slo else ""
+    head = (f"POST /v1/completions HTTP/1.1\r\nHost: loadgen\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n{extra}\r\n").encode("ascii")
+    out: Dict = {"code": None, "tokens": [], "ttft_ms": None,
+                 "tpot_ms": None, "finish_reason": None,
+                 "retry_after": None, "disconnected": False}
+    sock = socket.create_connection((host, port), timeout=timeout)
+    f = sock.makefile("rb")
+    try:
+        t_send = time.perf_counter()
+        sock.sendall(head + body)
+        code, headers = _http_read_head(f)
+        out["code"] = code
+        if "retry-after" in headers:
+            out["retry_after"] = int(headers["retry-after"])
+        if code != 200:
+            f.read()
+            return out
+        if not payload.get("stream"):
+            resp = json.loads(f.read(
+                int(headers.get("content-length", "0"))))
+            choice = resp["choices"][0]
+            out["tokens"] = list(choice["tokens"])
+            out["finish_reason"] = choice["finish_reason"]
+            return out
+        t_tokens: List[float] = []
+        while True:
+            line = f.readline()
+            if not line:
+                break                      # server closed mid-stream
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            data = line[len(b"data: "):]
+            if data == b"[DONE]":
+                break
+            ev = json.loads(data)
+            choice = ev["choices"][0]
+            if choice["token"] is not None:
+                t_tokens.append(time.perf_counter())
+                out["tokens"].append(int(choice["token"]))
+            if choice["finish_reason"] is not None:
+                out["finish_reason"] = choice["finish_reason"]
+            if disconnect_after is not None \
+                    and len(out["tokens"]) >= disconnect_after:
+                # abandon the stream like a vanished client: shutdown
+                # the CONNECTION (makefile dups the fd, so close()
+                # alone would leave the socket open)
+                out["disconnected"] = True
+                sock.shutdown(socket.SHUT_RDWR)
+                break
+        if t_tokens:
+            out["ttft_ms"] = round((t_tokens[0] - t_send) * 1e3, 3)
+        if len(t_tokens) > 1:
+            out["tpot_ms"] = round(
+                (t_tokens[-1] - t_tokens[0]) / (len(t_tokens) - 1) * 1e3,
+                3)
+        return out
+    finally:
+        f.close()
+        try:
+            sock.close()
+        except OSError:
+            pass  # tpulint: disable=silent-except — already abandoned
+
+
+def replay_http(host: str, port: int, trace: List[Request],
+                step_ms: float = 10.0,
+                disconnects: Optional[Dict[int, int]] = None,
+                slo: Optional[str] = None,
+                timeout_s: float = 300.0) -> Dict:
+    """Replay a seeded trace over REAL sockets against a running
+    gateway: one client thread per request, arrivals paced at
+    ``step_ms`` wall-clock per trace step (the same virtual-time step
+    indices :func:`replay` uses), streaming on, explicit ``uid`` so
+    the (uid, position)-folded sampling keys make seeded streams
+    byte-comparable to the in-process reference.  ``disconnects``:
+    ``{uid: token_offset}`` — those clients abandon their connection
+    mid-stream (the failure mode only a network creates).
+
+    Returns the wire-side analogue of :func:`replay`'s bookkeeping:
+    per-uid tokens/statuses plus client-measured TTFT/TPOT and HTTP
+    codes, and the replay's wall seconds (the goodput denominator)."""
+    import threading
+
+    disconnects = disconnects or {}
+    results: Dict[int, Dict] = {}
+    errors: List[str] = []
+    lock = threading.Lock()
+    t_start = time.perf_counter() + 0.02
+
+    def worker(q: Request) -> None:
+        delay = t_start + q.step * step_ms / 1e3 - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        payload = {"uid": q.uid, "prompt": q.prompt,
+                   "max_tokens": q.max_new, "stream": True,
+                   "priority": q.priority}
+        if q.deadline_ms is not None:
+            payload["deadline_ms"] = q.deadline_ms
+        try:
+            r = http_completion(host, port, payload, slo=slo,
+                                disconnect_after=disconnects.get(q.uid))
+        except (OSError, ValueError, ConnectionError) as e:
+            r = {"code": None, "tokens": [], "ttft_ms": None,
+                 "tpot_ms": None, "finish_reason": None,
+                 "retry_after": None, "disconnected": False,
+                 "error": repr(e)}
+            with lock:
+                errors.append(f"uid {q.uid}: {e!r}")
+        with lock:
+            results[q.uid] = r
+
+    threads = [threading.Thread(target=worker, args=(q,), daemon=True)
+               for q in trace]
+    for t in threads:
+        t.start()
+    deadline = time.perf_counter() + timeout_s
+    for t in threads:
+        t.join(max(0.0, deadline - time.perf_counter()))
+    if any(t.is_alive() for t in threads):
+        # a wedged wire replay surfaces as an error, never a hang —
+        # the serving-wait discipline applied to the client harness
+        raise RuntimeError(
+            f"http replay did not drain in {timeout_s}s "
+            f"({sum(t.is_alive() for t in threads)} clients stuck)")
+    wall_s = time.perf_counter() - t_start
+    statuses: Dict[int, str] = {}
+    for q in trace:
+        r = results[q.uid]
+        if r["disconnected"]:
+            statuses[q.uid] = "disconnected"
+        elif r["code"] == 200:
+            fin = r["finish_reason"]
+            statuses[q.uid] = "finished" if fin in ("length", "stop") \
+                else (fin or "incomplete")
+        elif r["code"] in (429, 503):
+            statuses[q.uid] = "shed"
+        else:
+            statuses[q.uid] = f"http_{r['code']}"
+    return {
+        "wall_s": round(wall_s, 4),
+        "errors": errors,
+        "tokens": {u: list(r["tokens"]) for u, r in results.items()},
+        "statuses": statuses,
+        "http_codes": {u: r["code"] for u, r in results.items()},
+        "ttft_ms": {u: r["ttft_ms"] for u, r in results.items()
+                    if r["ttft_ms"] is not None},
+        "tpot_ms": {u: r["tpot_ms"] for u, r in results.items()
+                    if r["tpot_ms"] is not None},
+        "retry_after": {u: r["retry_after"] for u, r in results.items()
+                        if r["retry_after"] is not None},
+    }
+
+
+def summarize_http(res: Dict, trace: List[Request]) -> Dict:
+    """The same SLO-curve shape :func:`summarize` emits, from wire
+    measurements — so in-process and over-HTTP legs are directly
+    comparable columns in the BENCH JSON."""
+    statuses: Dict[str, int] = {}
+    for s in res["statuses"].values():
+        statuses[s] = statuses.get(s, 0) + 1
+    ttft = list(res["ttft_ms"].values())
+    tpot = list(res["tpot_ms"].values())
+    n_tok = sum(len(t) for u, t in res["tokens"].items()
+                if res["statuses"].get(u) == "finished")
+    return {
+        "requests": len(trace),
+        "statuses": statuses,
+        "wall_s": res["wall_s"],
+        "goodput_tok_s": round(n_tok / max(res["wall_s"], 1e-9), 2),
+        "ttft_ms_p50": _pct(ttft, 50), "ttft_ms_p95": _pct(ttft, 95),
+        "tpot_ms_p50": _pct(tpot, 50), "tpot_ms_p95": _pct(tpot, 95),
+    }
+
+
+def _spawn_http_gateway(model=None, sampling=None, seed=None,
+                        overload=None, check_invariants=True,
+                        gateway_kw=None, **engine_kw):
+    """A tiny engine behind a freshly spawned gateway (ephemeral
+    port); returns ``(handle, engine, model)``."""
+    from deepspeed_tpu.gateway import GatewayConfig, spawn_gateway
+
+    eng, model = build_engine(overload, model=model, **engine_kw)
+    cfg = GatewayConfig(sampling=sampling, seed=seed,
+                        check_invariants=check_invariants,
+                        **(gateway_kw or {}))
+    return spawn_gateway(eng, cfg), eng, model
+
+
+def http_smoke(seed: int = 0) -> Dict:
+    """Tier-1 sockets leg (docs/SERVING.md "Network gateway"): the
+    same seeded trace replayed in-process (the parity reference) and
+    over real loopback sockets through a spawned gateway, greedy AND
+    seeded.  Asserts the wire acceptance bar:
+
+    * every stream finishes over HTTP with EXACTLY the in-process
+      token stream (greedy and seeded — the (uid, position)-folded
+      keys make wire scheduling irrelevant);
+    * every request reaches a terminal wire status, nothing leaks
+      (allocator partition + zero open lifecycle records), with the
+      gateway's per-pump invariant checks armed the whole run;
+    * ``/healthz`` serves the health ladder and ``/metrics`` parses
+      with the existing Prometheus parser, gateway counters present
+      and consistent with the traffic."""
+    import jax
+
+    from deepspeed_tpu.inference import SamplingParams
+    from deepspeed_tpu.telemetry import parse_prometheus_text
+
+    trace = make_trace(seed=seed, n_requests=8, qps=25.0,
+                       arrival="bursty", prompt_lens=(4, 16),
+                       out_lens=(3, 6), uid0=0)
+    samplers = {
+        "greedy": (SamplingParams(max_new_tokens=1 << 30), None, None),
+        "seeded": (SamplingParams(temperature=0.8, top_k=40,
+                                  max_new_tokens=1 << 30),
+                   jax.random.PRNGKey(7), 7),
+    }
+    out: Dict = {"variants": {}}
+    checks: Dict[str, bool] = {}
+    model = None
+    for mode, (sp, rng, gw_seed) in samplers.items():
+        eng_ref, model = build_engine(model=model)
+        ref = replay(eng_ref, trace, [], sampling=sp, rng=rng)
+        h, eng, model = _spawn_http_gateway(model=model, sampling=sp,
+                                            seed=gw_seed)
+        res = replay_http(h.host, h.port, trace, step_ms=5.0)
+        hz_code, _, hz_body = http_get(h.host, h.port, "/healthz")
+        m_code, _, m_body = http_get(h.host, h.port, "/metrics")
+        metrics = parse_prometheus_text(m_body.decode("utf-8"))
+        h.stop()
+        eng.state.allocator.assert_invariants()
+        agg = eng.request_metrics()["aggregate"]
+        checks[f"{mode}_parity"] = all(
+            res["tokens"].get(q.uid) == ref["tokens"].get(q.uid, [])
+            for q in trace)
+        checks[f"{mode}_all_finished"] = not res["errors"] and all(
+            s == "finished" for s in res["statuses"].values())
+        checks[f"{mode}_no_leak"] = agg["open"] == 0 \
+            and eng.state.allocator.free_blocks \
+            == eng.state.allocator.total_blocks
+        checks[f"{mode}_healthz"] = hz_code == 200 \
+            and json.loads(hz_body)["state"] in ("healthy", "degraded")
+        streams = metrics.get("serving_gateway_streams_total")
+        checks[f"{mode}_metrics"] = m_code == 200 \
+            and streams is not None \
+            and sum(streams["samples"].values()) >= len(trace)
+        out["variants"][mode] = summarize_http(res, trace)
+    out["checks"] = checks
+    out["ok"] = all(checks.values())
+    if not out["ok"]:
+        raise AssertionError(
+            "http smoke failed: "
+            f"{json.dumps({k: v for k, v in checks.items() if not v})}")
+    return out
+
+
+def http_chaos_smoke(seed: int = 0) -> Dict:
+    """Tier-1 wire-chaos leg: the two failure modes only a network
+    creates (docs/SERVING.md "Network gateway").
+
+    (1) Mid-stream client disconnects at seeded token offsets: the
+    engine-side ``cancel()`` fires (terminal status ``cancelled``),
+    zero record/block leaks with the gateway's per-pump allocator
+    checks armed, every UNAFFECTED stream token-identical to a
+    fault-free in-process run — greedy and seeded.
+
+    (2) SIGTERM drain (the programmatic ``shutdown()`` the signal
+    handler schedules): in-flight streams run to completion, late
+    arrivals get 503 + Retry-After, the gateway exits clean with the
+    backend's final drain snapshot in hand."""
+    import jax
+
+    from deepspeed_tpu.inference import SamplingParams
+
+    r = np.random.RandomState(seed + 13)
+    trace = make_trace(seed=seed, n_requests=8, qps=25.0,
+                       arrival="poisson", prompt_lens=(4, 12),
+                       out_lens=(10, 14), uid0=100)
+    disc_uids = sorted(int(u) for u in r.choice(
+        [q.uid for q in trace], size=2, replace=False))
+    disconnects = {u: int(r.randint(1, 4)) for u in disc_uids}
+    samplers = {
+        "greedy": (SamplingParams(max_new_tokens=1 << 30), None, None),
+        "seeded": (SamplingParams(temperature=0.8, top_k=40,
+                                  max_new_tokens=1 << 30),
+                   jax.random.PRNGKey(23), 23),
+    }
+    out: Dict = {"disconnects": disconnects, "variants": {}}
+    checks: Dict[str, bool] = {}
+    model = None
+    for mode, (sp, rng, gw_seed) in samplers.items():
+        eng_ref, model = build_engine(model=model)
+        ref = replay(eng_ref, trace, [], sampling=sp, rng=rng)
+        h, eng, model = _spawn_http_gateway(model=model, sampling=sp,
+                                            seed=gw_seed)
+        res = replay_http(h.host, h.port, trace, step_ms=5.0,
+                          disconnects=disconnects)
+        # the client saw its own abandonment; the ENGINE-side close-out
+        # (disconnect watcher -> cancel() -> terminal status) lands
+        # within a couple of driver pumps — poll briefly, then assert
+        t_end = time.perf_counter() + 20.0
+        while time.perf_counter() < t_end:
+            st = {u: eng.query(u)["status"] for u in disc_uids}
+            if all(s == "cancelled" for s in st.values()):
+                break
+            time.sleep(0.02)
+        h.stop()
+        agg = eng.request_metrics()["aggregate"]
+        eng.state.allocator.assert_invariants()
+        checks[f"{mode}_cancelled"] = all(
+            eng.query(u)["status"] == "cancelled" for u in disc_uids)
+        checks[f"{mode}_unaffected_parity"] = all(
+            res["tokens"].get(q.uid) == ref["tokens"].get(q.uid, [])
+            for q in trace if q.uid not in disconnects)
+        checks[f"{mode}_unaffected_finished"] = all(
+            res["statuses"][q.uid] == "finished"
+            for q in trace if q.uid not in disconnects)
+        checks[f"{mode}_no_leak"] = agg["open"] == 0 \
+            and eng.state.allocator.free_blocks \
+            == eng.state.allocator.total_blocks
+        disc_counter = eng.metrics.get(
+            "serving_gateway_disconnect_cancels_total")
+        checks[f"{mode}_disconnects_counted"] = disc_counter is not None \
+            and disc_counter.value() >= len(disconnects)
+        out["variants"][mode] = {
+            "statuses": {s: list(res["statuses"].values()).count(s)
+                         for s in set(res["statuses"].values())},
+            "engine_status": {u: eng.query(u)["status"]
+                              for u in disc_uids},
+            "wire_journeys": {u: h.gateway.wire_journey(u)
+                              for u in disc_uids},
+        }
+
+    # ---- drain variant: in-flight finishes, late arrivals 503 ------
+    import threading
+
+    h, eng, model = _spawn_http_gateway(
+        model=model, sampling=SamplingParams(max_new_tokens=1 << 30))
+    # warm the compiled step outside the drill so "in-flight" means
+    # decoding, not compiling
+    http_completion(h.host, h.port, {"prompt": [1, 2, 3],
+                                     "max_tokens": 1})
+    inflight_uids = [300, 301, 302]
+    inflight: Dict[int, Dict] = {}
+    lock = threading.Lock()
+
+    def drive(uid: int) -> None:
+        res = http_completion(h.host, h.port, {
+            "uid": uid, "prompt": [5 + uid % 7, 9, 4, 2],
+            "max_tokens": 8, "stream": True})
+        with lock:
+            inflight[uid] = res
+
+    threads = [threading.Thread(target=drive, args=(u,), daemon=True)
+               for u in inflight_uids]
+    for t in threads:
+        t.start()
+    # wait until every stream actually holds KV (running), then pull
+    # the drain trigger exactly as the SIGTERM handler would
+    t_end = time.perf_counter() + 30.0
+    while time.perf_counter() < t_end:
+        if all(eng.query(u)["status"] == "running"
+               for u in inflight_uids):
+            break
+        time.sleep(0.01)
+    h.begin_drain(deadline_ms=60_000.0)
+    t_end = time.perf_counter() + 10.0
+    while not h.gateway._draining and time.perf_counter() < t_end:
+        time.sleep(0.005)
+    late = http_completion(h.host, h.port, {"prompt": [1, 2],
+                                            "max_tokens": 2})
+    for t in threads:
+        t.join(120.0)
+    checks["drain_late_503"] = late["code"] == 503 \
+        and late["retry_after"] is not None and late["retry_after"] >= 1
+    checks["drain_inflight_complete"] = all(
+        not t.is_alive() for t in threads) and all(
+        inflight[u]["finish_reason"] == "length"
+        and len(inflight[u]["tokens"]) == 8 for u in inflight_uids)
+    h._thread.join(60.0)
+    checks["drain_exit_clean"] = not h._thread.is_alive() \
+        and h.gateway.final_snapshot is not None
+    eng.state.allocator.assert_invariants()
+    checks["drain_no_leak"] = \
+        eng.request_metrics()["aggregate"]["open"] == 0 \
+        and eng.state.allocator.free_blocks \
+        == eng.state.allocator.total_blocks
+    checks["drain_backend_drained"] = eng.health_state() in (
+        "draining", "dead")
+    out["drain"] = {"late": {"code": late["code"],
+                             "retry_after": late["retry_after"]},
+                    "inflight": {u: inflight[u]["finish_reason"]
+                                 for u in inflight_uids}}
+    out["checks"] = checks
+    out["ok"] = all(checks.values())
+    if not out["ok"]:
+        raise AssertionError(
+            "http chaos failed: "
+            f"{json.dumps({k: v for k, v in checks.items() if not v})}")
+    return out
+
+
+def http_bench(seed: int = 0, n_requests: int = 16) -> Dict:
+    """The BENCH sockets leg: one seeded bursty trace through (a) the
+    in-process ``replay`` driver and (b) real loopback sockets against
+    a spawned gateway — same trace, same engine shape, warmed and
+    metrics-reset identically — recording both SLO curves and the
+    measured wire overhead (client-wall TTFT p95 over in-process
+    engine-record TTFT p95).  Greedy, so the two legs' token streams
+    must be identical — asserted before anything is recorded."""
+    from deepspeed_tpu.inference import SamplingParams
+
+    sp = SamplingParams(max_new_tokens=1 << 30)
+    trace = make_trace(seed=seed, n_requests=n_requests, qps=8.0,
+                       arrival="bursty", prompt_lens=(4, 24),
+                       out_lens=(4, 8), uid0=0)
+
+    # ---- in-process leg -------------------------------------------
+    eng_a, model = build_engine()
+    replay(eng_a, [Request(uid=90_001, step=0, prompt=[3, 1, 4, 1, 5],
+                           max_new=2)], [], sampling=sp)
+    eng_a.reset_metrics()
+    t0 = time.perf_counter()
+    res_a = replay(eng_a, trace, [], sampling=sp)
+    wall_a = time.perf_counter() - t0
+    eng_a = res_a["engine"]
+    rm = eng_a.request_metrics()
+    ttft_a = [r["ttft_ms"] for r in rm["requests"]
+              if r.get("ttft_ms") is not None]
+    tok_a = sum(len(t) for t in res_a["tokens"].values())
+    inproc = {
+        "wall_s": round(wall_a, 4),
+        "goodput_tok_s": round(tok_a / max(wall_a, 1e-9), 2),
+        "ttft_ms_p50": _pct(ttft_a, 50), "ttft_ms_p95": _pct(ttft_a, 95),
+    }
+
+    # ---- over-HTTP leg --------------------------------------------
+    h, eng_b, model = _spawn_http_gateway(model=model, sampling=sp,
+                                          check_invariants=False)
+    http_completion(h.host, h.port, {"uid": 90_002,
+                                     "prompt": [3, 1, 4, 1, 5],
+                                     "max_tokens": 2})
+    eng_b.reset_metrics()
+    res_b = replay_http(h.host, h.port, trace, step_ms=50.0)
+    http_leg = summarize_http(res_b, trace)
+    rm_b = eng_b.request_metrics()
+    http_leg["engine_ttft_ms_p95"] = _pct(
+        [r["ttft_ms"] for r in rm_b["requests"]
+         if r.get("ttft_ms") is not None], 95)
+    h.stop()
+
+    parity = all(res_b["tokens"].get(q.uid) ==
+                 res_a["tokens"].get(q.uid, []) for q in trace)
+    if not parity:
+        raise AssertionError(
+            "http bench: over-HTTP tokens diverged from the in-process "
+            "replay — the wire must be a transport, never a sampler")
+    denom = inproc["ttft_ms_p95"] or 0.0
+    overhead = round(http_leg["ttft_ms_p95"] / denom, 4) \
+        if denom and http_leg["ttft_ms_p95"] else None
+    return {
+        "seed": seed, "requests": n_requests, "parity": parity,
+        "inproc": inproc, "http": http_leg,
+        "http_goodput_tok_s": http_leg["goodput_tok_s"],
+        "inproc_goodput_tok_s": inproc["goodput_tok_s"],
+        "http_ttft_p95_ms": http_leg["ttft_ms_p95"],
+        "inproc_ttft_p95_ms": inproc["ttft_ms_p95"],
+        "http_ttft_overhead_ratio": overhead,
+    }
+
+
+# --------------------------------------------------------------------------
 
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
@@ -1367,6 +1892,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--fleet-bench", action="store_true",
                     help="fleet bench sweep: 1 vs 3 replicas with a "
                     "mid-sweep kill, affinity vs round-robin")
+    ap.add_argument("--http", action="store_true",
+                    help="sockets leg: the same seeded trace over real "
+                    "loopback HTTP through a spawned gateway, token "
+                    "parity vs the in-process replay")
+    ap.add_argument("--http-chaos", action="store_true",
+                    help="wire chaos: mid-stream client disconnects "
+                    "(engine-side cancel) + SIGTERM-style drain")
+    ap.add_argument("--http-bench", action="store_true",
+                    help="in-process vs over-HTTP SLO curves with the "
+                    "measured wire overhead ratio")
     ap.add_argument("--qps", default="0.5,2,8",
                     help="comma-separated offered rates to sweep")
     ap.add_argument("--requests", type=int, default=32)
@@ -1383,6 +1918,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         result = fleet_chaos_smoke(args.seed)
     elif args.fleet_bench:
         result = fleet_bench(args.seed)
+    elif args.http:
+        result = http_smoke(args.seed)
+    elif args.http_chaos:
+        result = http_chaos_smoke(args.seed)
+    elif args.http_bench:
+        result = http_bench(args.seed)
     elif args.chaos:
         result = chaos_smoke(args.seed)
     elif args.smoke:

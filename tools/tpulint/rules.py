@@ -1127,3 +1127,96 @@ def check_profiler_capture(ctx: FileContext) -> Iterator[Finding]:
                     "capture-window seam (ProfilerCapture "
                     "arm/begin/end_step), which owns the session, "
                     "budget, and clock anchor")
+
+
+# --------------------------------------------------------------------------
+# rule: async-blocking — no synchronous engine/socket work on the
+# event loop (the gateway's concurrency contract)
+# --------------------------------------------------------------------------
+
+# known-blocking engine seams: a call to one of these names counts
+# only when its receiver chain carries an engine-ish segment (matched
+# as whole dotted-name segments, the telemetry-hotpath convention), so
+# `watcher.cancel()` (an asyncio.Task) or `queue.put_nowait()` never
+# trip it while `self.backend.step()` / `eng.generate()` do
+_ASYNC_ENGINE_SEAMS = {"generate", "step", "drain", "put", "flush",
+                       "cancel", "query", "snapshot", "load_snapshot",
+                       "decode_burst", "migrate_out", "health",
+                       "health_state", "prometheus_text"}
+_ASYNC_ENGINE_RECV = {"backend", "engine", "eng", "router", "fleet",
+                      "replica", "rep", "metrics", "fleet_registry"}
+
+# blocking socket/file primitives: flagged on ANY receiver — asyncio
+# streams spell these differently (read/drain are coroutines, write is
+# buffered), so a bare-socket verb inside a coroutine is always a
+# stall on the loop
+_ASYNC_SOCKET_OPS = {"recv", "recv_into", "send", "sendall", "sendto",
+                     "accept", "connect"}
+
+
+@rule("async-blocking",
+      "synchronous blocking calls (engine step/generate/drain/put, "
+      "time.sleep, raw socket ops) directly inside an `async def` — "
+      "one blocked coroutine stalls the WHOLE event loop (every open "
+      "stream, every health probe); route the call through "
+      "asyncio.to_thread / loop.run_in_executor (the gateway's "
+      "single-worker engine thread)", library_only=True)
+def check_async_blocking(ctx: FileContext) -> Iterator[Finding]:
+    if "async def" not in ctx.source:
+        return
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        # awaited calls are fine by construction; collect them first
+        awaited: Set[int] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Await) \
+                    and isinstance(node.value, ast.Call):
+                awaited.add(id(node.value))
+
+        def walk_async(node) -> Iterator[ast.Call]:
+            """Yield Call nodes in the async function's own body —
+            nested sync defs and lambdas are deferred thunks (the
+            executor pattern hands exactly those off the loop), so
+            they are NOT this coroutine's blocking calls; a nested
+            AsyncFunctionDef is its own coroutine and gets its own
+            visit from the outer ast.walk (descending here would
+            report its calls twice, misattributed)."""
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(child, ast.Call):
+                    yield child
+                yield from walk_async(child)
+
+        for call in walk_async(fn):
+            if id(call) in awaited:
+                continue
+            d = dotted(call.func)
+            if d is None:
+                continue
+            segs = d.split(".")
+            name = segs[-1]
+            recv = set(segs[:-1])
+            hit = None
+            if name in _ASYNC_ENGINE_SEAMS and recv & _ASYNC_ENGINE_RECV:
+                hit = "a blocking engine call"
+            elif name == "sleep" and (not recv or "time" in recv):
+                # bare `sleep` covers `from time import sleep`; an
+                # un-awaited asyncio.sleep(...) is also a bug (a no-op
+                # coroutine), caught by the same arm
+                hit = "a blocking sleep"
+            elif name == "sleep" and "asyncio" in recv:
+                hit = "an un-awaited asyncio.sleep (a silent no-op)"
+            elif name in _ASYNC_SOCKET_OPS:
+                hit = "a blocking socket op"
+            if hit is not None:
+                yield Finding(
+                    "async-blocking", ctx.path, call.lineno,
+                    call.col_offset,
+                    f"{d}() inside `async def {fn.name}` is {hit} on "
+                    "the event loop — every other coroutine (streams, "
+                    "health, metrics) stalls behind it; route it "
+                    "through asyncio.to_thread / "
+                    "loop.run_in_executor(engine_thread, ...)")
