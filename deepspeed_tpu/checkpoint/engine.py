@@ -361,7 +361,11 @@ class AsyncCheckpointSaver:
             # deliberately deferred: re-raised to the caller on the next
             # wait()/submit(), so the failure is never lost
             except BaseException as e:  # tpulint: disable=silent-except
-                self._error = e
+                # happens-before: wait() joins this thread before it
+                # reads or clears _error, and submit() calls wait()
+                # first, so at most one save thread is ever in flight —
+                # the join is the synchronization edge a lock would add
+                self._error = e  # tpulint: disable=shared-state-race
 
         self._thread = threading.Thread(target=work, daemon=False,
                                         name="async-ckpt")

@@ -165,6 +165,10 @@ class Gateway:
         self._streams: Dict[int, _Stream] = {}
         self._uid_iter = itertools.count(1)
         self._journeys: Dict[int, List[Dict]] = {}
+        # _journeys is written on the event loop but read from the
+        # engine thread (_reaped_statuses) and from test/main threads
+        # (wire_journey*): one lock covers every cross-domain touch
+        self._jlock = threading.Lock()
         self._t0 = time.perf_counter()
         self._wake = asyncio.Event()
         self._stopped = asyncio.Event()
@@ -210,25 +214,28 @@ class Gateway:
             "wire requests currently open")
 
     def _journey(self, uid: int, phase: str, **info) -> None:
-        j = self._journeys.get(uid)
-        if j is None:
-            while len(self._journeys) >= self.cfg.journey_retention:
-                self._journeys.pop(next(iter(self._journeys)))
-            j = self._journeys[uid] = []
         stamp = {"phase": phase,
                  "t_ms": round((time.perf_counter() - self._t0) * 1e3, 3)}
         stamp.update(info)
-        j.append(stamp)
+        with self._jlock:
+            j = self._journeys.get(uid)
+            if j is None:
+                while len(self._journeys) >= self.cfg.journey_retention:
+                    self._journeys.pop(next(iter(self._journeys)))
+                j = self._journeys[uid] = []
+            j.append(stamp)
 
     def wire_journey(self, uid: int) -> Optional[List[Dict]]:
         """The wire-phase stamps of one request (received -> admitted/
         shed -> first_token -> closed, plus disconnects), the gateway's
         analogue of the router's request journeys."""
-        j = self._journeys.get(uid)
-        return None if j is None else list(j)
+        with self._jlock:
+            j = self._journeys.get(uid)
+            return None if j is None else list(j)
 
     def wire_journeys(self) -> Dict[int, List[Dict]]:
-        return {u: list(j) for u, j in self._journeys.items()}
+        with self._jlock:
+            return {u: list(j) for u, j in self._journeys.items()}
 
     # ------------------------------------------------------------------
     # the one seam onto the blocking backend
@@ -366,9 +373,11 @@ class Gateway:
         # include journeyed uids whose stream is already torn down
         # (disconnect path): their journey still needs its terminal
         # "closed" stamp even though no queue is left to feed
+        with self._jlock:
+            journeyed = set(self._journeys)
         return {uid: be.query(uid).get("status", "released")
                 for uid in reaped
-                if uid in self._streams or uid in self._journeys}
+                if uid in self._streams or uid in journeyed}
 
     def _pump(self) -> Tuple[Dict[int, int], Dict[int, str]]:
         outs = self.backend.step(rng=self._rng, sampling=self._sampling)
@@ -706,7 +715,13 @@ class Gateway:
         s = _Stream(uid=uid, rid=f"cmpl-{uid}",
                     max_tokens=req.max_tokens,
                     want_stream=req.stream, queue=asyncio.Queue())
-        self._streams[uid] = s
+        # happens-before: the event loop is _streams' ONLY writer (this
+        # insert + unreserve's del); the engine thread only performs
+        # GIL-atomic point lookups (.get/membership/len) and never
+        # iterates-while-mutating, and every executor read of a record
+        # inserted here is ordered after the insert by the run_in_executor
+        # submission that carries the uid across
+        self._streams[uid] = s  # tpulint: disable=shared-state-race
 
         def unreserve() -> None:
             if self._streams.get(uid) is s:

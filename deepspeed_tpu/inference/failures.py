@@ -235,62 +235,76 @@ class Watchdog:
         self._thread: Optional[threading.Thread] = None
         self._token = 0
         self.abandoned = 0          # workers stranded by expiries
+        # ONE guarded call at a time: the worker handshake is a single
+        # (req, res) queue pair, so two concurrent run() calls would
+        # interleave tokens on one queue, and a shared expiry could
+        # tear down (_thread = _req = _res = None) the very worker the
+        # other caller is still waiting on — double-counting
+        # ``abandoned`` and stranding a result.  The admission lock
+        # makes spawn + token bump + wait + abandon one atomic episode.
+        # Reentrant: run() holds it across its call into
+        # _ensure_worker(), which takes it again for callers that
+        # pre-warm the worker directly.
+        self._admit = threading.RLock()
 
     def _ensure_worker(self) -> None:
-        if self._thread is not None and self._thread.is_alive():
-            return
-        self._req = queue.Queue()
-        self._res = queue.Queue()
+        with self._admit:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._req = queue.Queue()
+            self._res = queue.Queue()
 
-        def loop(req: queue.Queue, res: queue.Queue) -> None:
-            while True:
-                token, fn = req.get()
-                if fn is None:        # poison pill: worker was abandoned
-                    return
-                try:
-                    out = (token, True, fn())
-                except BaseException as e:  # tpulint: disable=silent-except — shipped across the queue and re-raised in the caller
-                    out = (token, False, e)
-                res.put(out)
+            def loop(req: queue.Queue, res: queue.Queue) -> None:
+                while True:
+                    token, fn = req.get()
+                    if fn is None:    # poison pill: worker was abandoned
+                        return
+                    try:
+                        out = (token, True, fn())
+                    except BaseException as e:  # tpulint: disable=silent-except — shipped across the queue and re-raised in the caller
+                        out = (token, False, e)
+                    res.put(out)
 
-        self._thread = threading.Thread(
-            target=loop, args=(self._req, self._res),
-            name="serving-watchdog", daemon=True)
-        self._thread.start()
+            self._thread = threading.Thread(
+                target=loop, args=(self._req, self._res),
+                name="serving-watchdog", daemon=True)
+            self._thread.start()
 
     def run(self, fn: Callable, timeout_ms: Optional[float]):
         """Run ``fn()`` under ``timeout_ms``; inline when None."""
         if timeout_ms is None:
             return fn()
-        self._ensure_worker()
-        self._token += 1
-        token = self._token
-        self._req.put((token, fn))
-        deadline = time.perf_counter() + timeout_ms / 1e3
-        while True:
-            remaining = deadline - time.perf_counter()
-            try:
-                tok, ok, val = self._res.get(
-                    timeout=max(1e-4, remaining) if remaining > 0 else 1e-4)
-            except queue.Empty:
-                # abandon this worker.  A stuck XLA call cannot be
-                # interrupted from Python, but the poison pill makes
-                # the thread EXIT (instead of parking forever) the
-                # moment the call eventually completes — only calls
-                # that truly never return keep a thread, and the
-                # engine's max_abandoned_workers cap declares the
-                # device dead before that count can grow unboundedly
-                self.abandoned += 1
-                self._req.put((None, None))
-                self._thread = self._req = self._res = None
-                raise DispatchTimeoutError(
-                    f"device dispatch outlived its {timeout_ms:.0f} ms "
-                    "deadline") from None
-            if tok != token:        # stale result from an older call
-                continue
-            if ok:
-                return val
-            raise val
+        with self._admit:
+            self._ensure_worker()
+            self._token += 1
+            token = self._token
+            self._req.put((token, fn))
+            deadline = time.perf_counter() + timeout_ms / 1e3
+            while True:
+                remaining = deadline - time.perf_counter()
+                try:
+                    tok, ok, val = self._res.get(
+                        timeout=max(1e-4, remaining)
+                        if remaining > 0 else 1e-4)
+                except queue.Empty:
+                    # abandon this worker.  A stuck XLA call cannot be
+                    # interrupted from Python, but the poison pill makes
+                    # the thread EXIT (instead of parking forever) the
+                    # moment the call eventually completes — only calls
+                    # that truly never return keep a thread, and the
+                    # engine's max_abandoned_workers cap declares the
+                    # device dead before that count can grow unboundedly
+                    self.abandoned += 1
+                    self._req.put((None, None))
+                    self._thread = self._req = self._res = None
+                    raise DispatchTimeoutError(
+                        f"device dispatch outlived its {timeout_ms:.0f} ms "
+                        "deadline") from None
+                if tok != token:    # stale result from an older call
+                    continue
+                if ok:
+                    return val
+                raise val
 
 
 class FailurePolicy:

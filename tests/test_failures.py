@@ -7,6 +7,7 @@ health states, graceful drain, and the status-retention satellite.
 Everything host-heavy runs on tiny CPU engines; the only real sleeping
 happens in the two watchdog deadline tests (sub-second)."""
 
+import threading
 import time
 
 import jax
@@ -146,6 +147,45 @@ class TestWatchdog:
         assert wd.abandoned == 1
         # a fresh worker serves the next call; a stale late result from
         # the abandoned one can never be mistaken for this call's
+        assert wd.run(lambda: "alive", 1000.0) == "alive"
+
+    def test_concurrent_guarded_calls_are_serialized(self):
+        """Regression (tpulint v3 hardening): two threads sharing one
+        watchdog must not interleave tokens on the single (req, res)
+        queue pair — the admission lock serializes guarded episodes, so
+        every caller gets its own result and no worker is abandoned."""
+        wd = Watchdog()
+        results: dict = {}
+
+        def guarded(i):
+            # a raise here leaves results[i] unset -> the assert fails
+            results[i] = wd.run(lambda: i * 10, 1000.0)
+
+        threads = [threading.Thread(target=guarded, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == {i: i * 10 for i in range(8)}
+        assert wd.abandoned == 0
+
+    def test_expiry_concurrent_with_fast_call(self):
+        """Regression: an expiry racing another guarded call may only
+        tear down ITS OWN worker — the racing call still completes and
+        exactly one worker is abandoned."""
+        wd = Watchdog()
+
+        def slow():
+            with pytest.raises(DispatchTimeoutError):
+                wd.run(lambda: time.sleep(0.3), 30.0)
+
+        t = threading.Thread(target=slow)
+        t.start()
+        assert wd.run(lambda: "ok", 1000.0) == "ok"
+        t.join()
+        assert wd.abandoned == 1
+        # and the shared watchdog still serves fresh calls afterwards
         assert wd.run(lambda: "alive", 1000.0) == "alive"
 
     def test_auto_deadline_warmup_and_scaling(self):

@@ -14,6 +14,7 @@ file owns the protocol surface and the per-feature integration paths.
 
 import json
 import socket
+import threading
 import time
 
 import pytest
@@ -272,6 +273,44 @@ def test_wire_journey_stamps(gw):
     # stamps are monotone wire-relative ms
     times = [s["t_ms"] for s in j]
     assert times == sorted(times)
+
+
+def test_wire_journeys_safe_during_live_streaming(gw):
+    """Regression (tpulint v3 shared-state-race finding): wire_journey*
+    read ``_journeys`` from the caller's thread while the event loop is
+    stamping phases into it.  Unlocked, the snapshot comprehension can
+    trip over a mid-mutation dict (RuntimeError: dictionary changed
+    size during iteration) or see a half-built journey.  Hammer the
+    readers while a stream is live: every snapshot must be coherent and
+    the stream must finish untouched."""
+    h, _ = gw
+    done = threading.Event()
+    res = {}
+
+    def fire():
+        res["r"] = http_completion(
+            h.host, h.port,
+            {"uid": 83, "prompt": [4, 5, 6], "max_tokens": 24,
+             "stream": True})
+        done.set()
+
+    t = threading.Thread(target=fire)
+    t.start()
+    polls = 0
+    while True:
+        snap = h.gateway.wire_journeys()
+        for j in snap.values():
+            assert all("phase" in st and "t_ms" in st for st in j)
+        h.gateway.wire_journey(83)
+        polls += 1
+        if done.is_set():
+            break
+    t.join()
+    assert polls > 0
+    assert res["r"]["code"] == 200
+    assert len(res["r"]["tokens"]) == 24
+    j = h.gateway.wire_journey(83)
+    assert [s["phase"] for s in j][-1] == "closed"
 
 
 def test_unknown_slo_class_is_400(gw):

@@ -27,6 +27,8 @@ from tools.tpulint import (RULES, Finding, collect_files,  # noqa: E402
                            find_mesh_axes, lint_paths)
 from tools.tpulint.core import _axes_from_source, parse_context  # noqa: E402
 from tools.tpulint.graph import build_program, module_name_for  # noqa: E402
+from tools.tpulint.concurrency import (EXECUTOR, LOOP, THREAD,  # noqa: E402
+                                       function_domains)
 
 ALL_RULES = sorted(RULES)
 PROGRAM_RULES = sorted(n for n, r in RULES.items() if r.scope == "program")
@@ -115,20 +117,25 @@ def test_fleet_metric_label_fixtures():
 
 
 def test_whole_tree_is_clean_fast_and_jax_free():
-    """The enforced gate, all three invariants in ONE whole-tree run
-    (the two-pass analyzer costs ~9 s — running it once keeps the gate
+    """The enforced gate, every invariant in ONE whole-tree run (the
+    three-pass analyzer costs ~9-11 s — running it once keeps the gate
     itself inside the suite's time budget):
 
-    * deepspeed_tpu + tests carry zero findings;
+    * the pass-3 concurrency families are registered and armed;
+    * deepspeed_tpu + tests carry zero findings (all 22 rules,
+      concurrency included);
     * the run stays under 15 s wall — measured ~9 s (per-file rules
-      ~4 s + program pass ~5 s); the assert leaves headroom without
+      ~4 s + program passes ~5 s); the assert leaves headroom without
       letting the analyzer quietly become a multi-minute tax;
     * the analyzer never imports JAX (pure ast), checked in a fresh
       interpreter where nothing else has imported it.
     """
     code = (
         "import sys, time; t0 = time.perf_counter()\n"
-        "from tools.tpulint.core import lint_paths\n"
+        "from tools.tpulint.core import RULES, lint_paths\n"
+        "conc = {'shared-state-race', 'lock-order-cycle',\n"
+        "        'await-under-lock', 'seam-freeze'}\n"
+        "assert conc <= set(RULES), 'concurrency pass not armed'\n"
         "fs = lint_paths(['deepspeed_tpu', 'tests'])\n"
         "dt = time.perf_counter() - t0\n"
         "assert 'jax' not in sys.modules, 'tpulint imported JAX'\n"
@@ -207,6 +214,14 @@ def test_new_rule_families_present():
     """The four PR-3 dataflow families exist and are program-scoped."""
     assert {"rng-discipline", "dtype-flow", "donation-lifetime",
             "retrace-hazard"} <= set(PROGRAM_RULES)
+
+
+def test_concurrency_rule_families_present():
+    """The four pass-3 concurrency families exist and are
+    program-scoped (they need the cross-file call graph + spawn
+    edges, not one file's AST)."""
+    assert {"shared-state-race", "lock-order-cycle",
+            "await-under-lock", "seam-freeze"} <= set(PROGRAM_RULES)
 
 
 # --------------------------------------------------------------------------
@@ -491,3 +506,386 @@ def test_async_blocking_nested_coroutine_no_duplicates():
     nested = [f for f in findings if "backend.step" in f.message]
     assert len(nested) == 1
     assert "async def inner" in nested[0].message
+
+
+# --------------------------------------------------------------------------
+# pass 3: execution-domain inference (graph.py spawn edges)
+# --------------------------------------------------------------------------
+
+def test_domain_inference_spawn_kinds(tmp_path):
+    """Every spawn edge kind lands its target in the right domain:
+    Thread(target=) -> thread, run_in_executor -> executor,
+    create_task -> loop (coroutines are always loop), and a sync
+    helper called from a coroutine inherits loop."""
+    prog = _program_for(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/w.py": """\
+            import asyncio
+            import threading
+
+            def thread_target():
+                return 1
+
+            def thunk():
+                return 2
+
+            async def coro_helper():
+                sync_from_loop()
+
+            def sync_from_loop():
+                return 3
+
+            async def main_entry():
+                loop = asyncio.get_running_loop()
+                t = threading.Thread(target=thread_target)
+                t.start()
+                await loop.run_in_executor(None, thunk)
+                asyncio.create_task(coro_helper())
+        """,
+    })
+    doms = function_domains(prog)
+    assert THREAD in doms["pkg.w::thread_target"]
+    assert EXECUTOR in doms["pkg.w::thunk"]
+    assert doms["pkg.w::coro_helper"] == {LOOP}
+    assert LOOP in doms["pkg.w::sync_from_loop"]
+    assert doms["pkg.w::main_entry"] == {LOOP}
+    kinds = {e.kind for e in prog.spawn_edges}
+    assert {"thread", "executor", "task"} <= kinds
+
+
+def test_domain_cross_module_thread_target(tmp_path):
+    """A thread spawned in one module over a callable imported from
+    another: the TARGET module's function goes thread-domain, and the
+    spawn edge remembers the spawning site for dual-endpoint
+    findings."""
+    prog = _program_for(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/a.py": """\
+            def tick():
+                return 7
+        """,
+        "pkg/b.py": """\
+            import threading
+            from .a import tick
+
+            def watch():
+                threading.Thread(target=tick, daemon=True).start()
+        """,
+    })
+    doms = function_domains(prog)
+    assert THREAD in doms["pkg.a::tick"]
+    edge = next(e for e in prog.spawn_edges if e.kind == "thread")
+    assert edge.target == "pkg.a::tick"
+    assert edge.path.endswith("b.py")
+
+
+def test_executor_seam_forwarding_sanctions_engine_calls(tmp_path):
+    """The Gateway._call idiom: a callable handed to a forwarder whose
+    parameter feeds run_in_executor runs in the EXECUTOR domain — so
+    engine calls inside it are sanctioned and seam-freeze stays
+    quiet."""
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/g.py": """\
+            import asyncio
+            import functools
+
+            class Gate:
+                def __init__(self, engine, ex):
+                    self.engine = engine
+                    self._exec = ex
+
+                async def _call(self, fn, *args):
+                    loop = asyncio.get_running_loop()
+                    return await loop.run_in_executor(
+                        self._exec, functools.partial(fn, *args))
+
+                async def go(self):
+                    await self._call(self._work)
+
+                def _work(self):
+                    return self.engine.step({})
+        """,
+    }
+    prog = _program_for(tmp_path / "p1", files)
+    doms = function_domains(prog)
+    assert EXECUTOR in doms["pkg.g::Gate._work"]
+    root = _make_pkg(tmp_path / "p2", files)
+    assert lint_paths([str(root)], mesh_axes=set(),
+                      rules=["seam-freeze"]) == []
+
+
+# --------------------------------------------------------------------------
+# pass 3: lock-order / await-under-lock / seam-freeze units
+# --------------------------------------------------------------------------
+
+def test_lock_order_cycle_interprocedural(tmp_path):
+    """The cycle only exists through a CALL made while holding a lock —
+    no single function nests the two ``with`` blocks in both orders."""
+    root = _make_pkg(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/bank.py": """\
+            import threading
+
+            class Bank:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def credit(self):
+                    with self._a:
+                        self._grab_b()
+
+                def _grab_b(self):
+                    with self._b:
+                        pass
+
+                def debit(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """,
+    })
+    findings = lint_paths([str(root)], mesh_axes=set(),
+                          rules=["lock-order-cycle"])
+    assert len(findings) == 1
+    assert "Bank._a" in findings[0].message
+    assert "Bank._b" in findings[0].message
+    assert findings[0].end_path is not None   # the reversed acquisition
+
+
+def test_lock_order_consistent_is_clean(tmp_path):
+    root = _make_pkg(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/bank.py": """\
+            import threading
+
+            class Bank:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def credit(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def debit(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """,
+    })
+    assert lint_paths([str(root)], mesh_axes=set(),
+                      rules=["lock-order-cycle"]) == []
+
+
+def test_await_under_lock_endpoints(tmp_path):
+    """The finding anchors at the await and carries the acquisition
+    site as its second endpoint; an asyncio.Lock (``async with``) is
+    the sanctioned form and stays quiet."""
+    root = _make_pkg(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/m.py": """\
+            import asyncio
+            import threading
+
+            _lock = threading.Lock()
+            _alock = asyncio.Lock()
+
+            async def bad():
+                with _lock:
+                    await asyncio.sleep(0)
+
+            async def good():
+                async with _alock:
+                    await asyncio.sleep(0)
+        """,
+    })
+    findings = lint_paths([str(root)], mesh_axes=set(),
+                          rules=["await-under-lock"])
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.line == 9                       # the await
+    assert f.end_line == 8                   # the with
+    assert f.end_path == f.path
+
+
+def _seam_split_pkg(tmp_path):
+    """Engine call in a.py, thread spawn in b.py — the seam-freeze
+    finding anchors where the call lives and ends where the thread is
+    spawned (two files, one finding)."""
+    return _make_pkg(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/a.py": """\
+            class Relay:
+                def __init__(self, engine):
+                    self.engine = engine
+
+                def _probe(self):
+                    return self.engine.query(0)
+        """,
+        "pkg/b.py": """\
+            import threading
+            from .a import Relay
+
+            def watch(engine):
+                r = Relay(engine)
+                threading.Thread(target=r._probe, daemon=True).start()
+        """,
+    })
+
+
+def test_seam_freeze_dual_endpoints_in_json(tmp_path):
+    root = _seam_split_pkg(tmp_path)
+    findings = lint_paths([str(root)], mesh_axes=set(),
+                          rules=["seam-freeze"])
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.path.endswith("a.py") and f.end_path.endswith("b.py")
+    d = f.json()                             # both locations on the wire
+    assert d["end_path"].endswith("b.py") and d["end_line"] == 6
+    assert "b.py:6" in f.human()
+
+
+def test_changed_keeps_finding_when_either_endpoint_dirty(tmp_path):
+    """The --changed blind spot: editing ONLY the spawn site must still
+    surface the cross-file finding anchored in the untouched module
+    (and vice versa); a dirty bystander file surfaces nothing."""
+    root = _seam_split_pkg(tmp_path)
+    a = str(root / "pkg" / "a.py")
+    b = str(root / "pkg" / "b.py")
+    init = str(root / "pkg" / "__init__.py")
+    for dirty in ({a}, {b}):
+        hits = lint_paths([str(root)], mesh_axes=set(),
+                          rules=["seam-freeze"], report_only=dirty)
+        assert len(hits) == 1, f"finding lost with dirty={dirty}"
+    assert lint_paths([str(root)], mesh_axes=set(),
+                      rules=["seam-freeze"], report_only={init}) == []
+
+
+def test_race_detected_across_modules(tmp_path):
+    """Shared-state race with the spawn in another module: the writer
+    runs thread-domain because of b.py's spawn, the reader stays
+    main-domain — one finding, carrying both access sites."""
+    root = _make_pkg(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/a.py": """\
+            class Counter:
+                def __init__(self):
+                    self.n = 0
+
+                def bump(self):
+                    self.n += 1
+
+                def stats(self):
+                    return self.n
+        """,
+        "pkg/b.py": """\
+            import threading
+            from .a import Counter
+
+            def drive():
+                c = Counter()
+                threading.Thread(target=c.bump, daemon=True).start()
+                return c.stats()
+        """,
+    })
+    findings = lint_paths([str(root)], mesh_axes=set(),
+                          rules=["shared-state-race"])
+    assert len(findings) == 1
+    f = findings[0]
+    assert "Counter.n" in f.message and "thread" in f.message
+    assert f.end_line is not None
+
+
+def test_race_quiet_under_lock_and_queue_disciplines(tmp_path):
+    """The two main sanctioned shapes in one package: a lock shared by
+    every conflicting access, and a queue.Queue hand-off."""
+    root = _make_pkg(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/a.py": """\
+            import queue
+            import threading
+
+            class Feed:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.total = 0
+                    self.inbox = queue.Queue()
+
+                def bump(self):
+                    with self._lock:
+                        self.total += 1
+
+                def stats(self):
+                    with self._lock:
+                        return self.total
+
+                def submit(self, item):
+                    self.inbox.put(item)
+
+                def drain(self):
+                    return self.inbox.get_nowait()
+        """,
+        "pkg/b.py": """\
+            import threading
+            from .a import Feed
+
+            def drive():
+                f = Feed()
+                threading.Thread(target=f.bump, daemon=True).start()
+                threading.Thread(target=f.drain, daemon=True).start()
+                f.submit(3)
+                return f.stats()
+        """,
+    })
+    assert lint_paths([str(root)], mesh_axes=set(),
+                      rules=["shared-state-race"]) == []
+
+
+def test_loadgen_clean_under_concurrency_families():
+    """tools/loadgen.py spawns real worker threads over shared
+    bookkeeping — it must hold the line under the new families (its
+    per-worker result lists are disjoint by construction)."""
+    findings = lint_paths(
+        [str(REPO / "tools" / "loadgen.py")],
+        rules=["shared-state-race", "lock-order-cycle",
+               "await-under-lock", "seam-freeze"])
+    assert findings == [], [f.human() for f in findings]
+
+
+def test_sarif_roundtrip_against_json_formatter():
+    """--format sarif carries exactly the native JSON formatter's
+    content: same order, ruleId == rule, 1-based startColumn, and the
+    optional second endpoint as a relatedLocation."""
+    from tools.tpulint.__main__ import to_sarif
+    f1 = Finding("print", "a.py", 3, 2, "msg")
+    f2 = Finding("seam-freeze", "a.py", 5, 0, "m2",
+                 end_path="b.py", end_line=9)
+    doc = json.loads(json.dumps(to_sarif([f1, f2])))
+    assert doc["version"] == "2.1.0" and "$schema" in doc
+    results = doc["runs"][0]["results"]
+    for native, sar in zip([f1.json(), f2.json()], results):
+        assert sar["ruleId"] == native["rule"]
+        assert sar["message"]["text"] == native["message"]
+        loc = sar["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == native["path"]
+        assert loc["region"]["startLine"] == native["line"]
+        assert loc["region"]["startColumn"] == native["col"] + 1
+    assert "relatedLocations" not in results[0]
+    rel = results[1]["relatedLocations"][0]["physicalLocation"]
+    assert rel["artifactLocation"]["uri"] == "b.py"
+    assert rel["region"]["startLine"] == 9
+    ids = [r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]]
+    assert ids == ["print", "seam-freeze"]       # sorted, deduped
+
+
+def test_sarif_cli_mode(capsys):
+    from tools.tpulint.__main__ import main as cli
+    rc = cli([str(FIXTURES / "bad_print.py"), "--format", "sarif"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    assert all(r["ruleId"] == "print"
+               for r in doc["runs"][0]["results"])

@@ -1,0 +1,19 @@
+"""Deliberately-bad fixture: await-under-lock.
+
+The coroutine suspends while still holding a *synchronous*
+``threading.Lock`` — every thread and every other task that needs the
+lock now waits on a parked coroutine.
+"""
+import asyncio
+import threading
+
+
+class Budget:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.spent = 0
+
+    async def charge(self, amount):
+        with self._lock:
+            await asyncio.sleep(0)       # BAD: suspends with the lock held
+            self.spent += amount

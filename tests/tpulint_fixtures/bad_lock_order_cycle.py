@@ -1,0 +1,26 @@
+"""Deliberately-bad fixture: lock-order-cycle.
+
+``credit`` takes ``_alock`` then ``_block``; ``debit`` takes them
+reversed — two threads interleaving the two paths deadlock.
+"""
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+        self.a = 0
+        self.b = 0
+
+    def credit(self, n):
+        with self._alock:
+            with self._block:
+                self.a += n
+                self.b += n
+
+    def debit(self, n):
+        with self._block:
+            with self._alock:            # BAD: reversed acquisition order
+                self.b -= n
+                self.a -= n

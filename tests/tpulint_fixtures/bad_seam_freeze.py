@@ -1,0 +1,26 @@
+"""Deliberately-bad fixture: seam-freeze.
+
+Two paths reach the engine without the executor seam, and neither is
+visible to the per-file async-blocking rule (which only inspects
+syntactic ``async def`` bodies): a sync helper *called from* a
+coroutine (loop domain), and a spawned thread target (thread domain).
+"""
+import threading
+
+
+class Relay:
+    def __init__(self, engine):
+        self.engine = engine
+
+    async def drive(self):
+        self._tick()
+
+    def _tick(self):
+        self.engine.step({})             # BAD: loop domain, no seam
+
+    def watch(self):
+        t = threading.Thread(target=self._probe, daemon=True)
+        t.start()
+
+    def _probe(self):
+        self.engine.query(0)             # BAD: thread domain, no seam

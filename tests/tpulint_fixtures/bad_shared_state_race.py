@@ -1,0 +1,29 @@
+"""Deliberately-bad fixture: shared-state-race.
+
+A worker thread drains a plain list and bumps a counter that the main
+(serving) thread also mutates/reads — no lock, no queue, no flag
+discipline.  Exactly two attrs conflict: ``pending`` and ``total``.
+"""
+import threading
+
+
+class TokenFeed:
+    def __init__(self):
+        self.pending = []
+        self.total = 0
+        self._worker = None
+
+    def start(self):
+        self._worker = threading.Thread(target=self._drain, daemon=True)
+        self._worker.start()
+
+    def _drain(self):
+        while self.pending:
+            item = self.pending.pop()    # BAD: list mutated from thread
+            self.total += len(item)      # BAD: counter written from thread
+
+    def submit(self, item):
+        self.pending.append(item)        # ... and appended from main
+
+    def stats(self):
+        return self.total                # ... and read from main

@@ -1,0 +1,23 @@
+"""Known-good twin of bad_await_under_lock: the awaited section runs
+under an ``asyncio.Lock`` (``async with`` suspends cleanly), and the
+shared counter's sync-lock region contains no await — main-thread
+readers share the same sync lock."""
+import asyncio
+import threading
+
+
+class Budget:
+    def __init__(self):
+        self._alock = asyncio.Lock()
+        self._sync = threading.Lock()
+        self.spent = 0
+
+    async def charge(self, amount):
+        async with self._alock:
+            await asyncio.sleep(0)
+            with self._sync:
+                self.spent += amount
+
+    def snapshot(self):
+        with self._sync:
+            return self.spent

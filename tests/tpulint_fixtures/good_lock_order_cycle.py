@@ -1,0 +1,23 @@
+"""Known-good twin of bad_lock_order_cycle: both paths acquire the two
+locks in the same global order, so the acquisition graph is acyclic."""
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+        self.a = 0
+        self.b = 0
+
+    def credit(self, n):
+        with self._alock:
+            with self._block:
+                self.a += n
+                self.b += n
+
+    def debit(self, n):
+        with self._alock:
+            with self._block:
+                self.b -= n
+                self.a -= n

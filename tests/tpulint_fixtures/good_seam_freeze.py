@@ -1,0 +1,24 @@
+"""Known-good twin of bad_seam_freeze: every engine touch routes
+through ONE executor seam (`_call` forwards its callable to
+``run_in_executor``), so the executor-domain thunk is the engine's
+only home — the frozen PR-15 gateway contract."""
+import asyncio
+import functools
+
+
+class Relay:
+    def __init__(self, engine, executor):
+        self.engine = engine
+        self._exec = executor
+
+    async def _call(self, fn, *args):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._exec, functools.partial(fn, *args))
+
+    async def drive(self):
+        await self._call(self.engine.step, {})
+        await self._call(self._pump)
+
+    def _pump(self):
+        self.engine.flush()              # executor domain: sanctioned

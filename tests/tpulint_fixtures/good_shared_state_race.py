@@ -1,0 +1,35 @@
+"""Known-good twin of bad_shared_state_race: the same worker-thread
+shape, with every cross-domain access behind a recognized discipline —
+a ``queue.Queue`` hand-off, a shared ``threading.Lock``, and a
+single-writer constant flag."""
+import queue
+import threading
+
+
+class TokenFeed:
+    def __init__(self):
+        self.pending = queue.Queue()     # hand-off: thread-safe by type
+        self.total = 0
+        self.stopped = False             # single-writer constant flag
+        self._lock = threading.Lock()
+        self._worker = None
+
+    def start(self):
+        self._worker = threading.Thread(target=self._drain, daemon=True)
+        self._worker.start()
+
+    def _drain(self):
+        while not self.stopped:
+            item = self.pending.get()
+            with self._lock:
+                self.total += len(item)
+
+    def submit(self, item):
+        self.pending.put(item)
+
+    def stats(self):
+        with self._lock:
+            return self.total
+
+    def stop(self):
+        self.stopped = True

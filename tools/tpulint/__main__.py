@@ -80,17 +80,74 @@ def git_dirty_files(repo_cwd: str = ".") -> Optional[Set[str]]:
     return out
 
 
+def to_sarif(findings: List[Finding]) -> dict:
+    """SARIF 2.1.0 document for ``findings`` — a static writer (stdlib
+    json only) so CI annotators and editors can ingest tpulint runs.
+
+    Mapping from the native JSON formatter (round-trip tested in
+    tests/test_tpulint.py): ``rule`` -> ``ruleId``; ``path``/``line``
+    stay 0-based nowhere — SARIF columns are 1-based, so ``startColumn``
+    is our ``col + 1``; the optional second endpoint (``end_path`` /
+    ``end_line``) becomes a ``relatedLocations`` entry."""
+    rule_ids = sorted({f.rule for f in findings})
+    driver = {
+        "name": "tpulint",
+        "informationUri": "docs/TPULINT.md",
+        "rules": [{"id": rid,
+                   "shortDescription": {"text": RULES[rid].doc}
+                   if rid in RULES else {"text": rid}}
+                  for rid in rule_ids],
+    }
+    results = []
+    for f in findings:
+        res = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                },
+            }],
+        }
+        if f.end_path is not None:
+            res["relatedLocations"] = [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.end_path},
+                    "region": {"startLine": f.end_line},
+                },
+                "message": {"text": "other endpoint (conflicting "
+                                    "access / spawn site / reversed "
+                                    "acquisition)"},
+            }]
+        results.append(res)
+    return {
+        "version": "2.1.0",
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "runs": [{"tool": {"driver": driver}, "results": results}],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="tpulint",
         description="JAX/TPU-aware static analysis (pure AST, no "
-                    "imports of the target modules; two passes: "
-                    "per-file rules + whole-program dataflow)")
+                    "imports of the target modules; three passes: "
+                    "per-file rules, whole-program dataflow, and "
+                    "whole-program concurrency)")
     ap.add_argument("paths", nargs="*", default=["deepspeed_tpu", "tests"],
                     help="files or directories to lint "
                          "(default: deepspeed_tpu tests)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="emit findings as a JSON array")
+                    help="emit findings as a JSON array "
+                         "(alias for --format json)")
+    ap.add_argument("--format", default=None, dest="fmt",
+                    choices=["human", "json", "sarif"],
+                    help="output format (default human; sarif emits a "
+                         "SARIF 2.1.0 document for CI annotators)")
     ap.add_argument("--rules", default=None,
                     help="comma-separated subset of rules to run")
     ap.add_argument("--list-rules", action="store_true",
@@ -155,8 +212,11 @@ def main(argv=None) -> int:
         print(f"tpulint: baseline absorbed {before - len(findings)} "
               f"of {before} finding(s)", file=sys.stderr)
 
-    if args.as_json:
+    fmt = args.fmt or ("json" if args.as_json else "human")
+    if fmt == "json":
         print(json.dumps([f.json() for f in findings], indent=2))
+    elif fmt == "sarif":
+        print(json.dumps(to_sarif(findings), indent=2))
     else:
         for f in findings:
             print(f.human())

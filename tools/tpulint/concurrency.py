@@ -1,0 +1,741 @@
+"""tpulint pass 3: whole-program concurrency analysis.
+
+Built on the pass-1 call graph plus the spawn edges :mod:`graph` now
+records, this pass answers the question the per-file rules cannot:
+*which execution domain runs each function*, and therefore which
+attribute accesses, lock acquisitions, and engine calls can actually
+race.
+
+**Execution domains** (a function can live in several):
+
+* ``main``     — the synchronous serving/training loop (the default);
+* ``loop``     — event-loop coroutines (every ``async def``) and the
+  sync helpers they call directly;
+* ``executor`` — thunks handed to ``run_in_executor`` / ``to_thread``
+  / ``pool.submit``, directly or forwarded through a seam method like
+  ``Gateway._call`` (serialized by the gateway's single worker);
+* ``thread``   — ``threading.Thread(target=...)`` targets and the
+  sync code they call.
+
+Domains are inferred by BFS from the roots (async defs, spawn-edge
+targets) through the resolved call graph; coroutine bodies never
+inherit a caller's domain (calling an ``async def`` only builds the
+coroutine — the loop runs it).  Functions no domain reaches default to
+``main`` and propagate it the same way.
+
+**Rule families** (all ``scope="program"``, all library-only):
+
+* ``shared-state-race``  — a per-class attribute table (read/write x
+  domain): an attr written from >=2 domains, or written in one and
+  read in another, without a recognized discipline (a shared
+  ``threading.Lock`` guard, a queue hand-off, a single-writer constant
+  flag, or living entirely behind the executor seam) is a finding;
+* ``lock-order-cycle``   — the lock-acquisition graph over nested
+  ``with lock`` scopes (including locks acquired by callees while a
+  lock is held); any cycle is a latent deadlock;
+* ``await-under-lock``   — an ``await`` inside a *sync* lock's ``with``
+  body parks the coroutine while the lock stays held: every other
+  task needing it deadlocks against the loop;
+* ``seam-freeze``        — the PR-15 gateway contract ("the engine is
+  single-threaded behind one executor seam") as an invariant:
+  engine-ish receiver calls from loop or thread domains that don't
+  route through the seam.  This closes the gap ``async-blocking``
+  leaves: that rule only sees syntactic ``async def`` bodies, so a
+  sync helper *called from* a coroutine, or a spawned thread target,
+  could still reach the engine directly.
+
+Like every other pass: pure ``ast``, memoized on the Program object,
+bounded fixpoints only — the whole-tree run must stay inside the
+existing wall-clock budget.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from .core import Finding, rule
+from .graph import FunctionInfo, ModuleInfo, Program
+from .rules import _ASYNC_ENGINE_RECV, _ASYNC_ENGINE_SEAMS, dotted
+
+MAIN = "main"
+LOOP = "loop"
+EXECUTOR = "executor"
+THREAD = "thread"
+
+_INIT_NAMES = {"__init__", "__post_init__", "__new__"}
+
+# receiver methods that mutate the object they are called on.  NOT
+# ``put``/``put_nowait``: recognized queue attrs are exempt by type
+# anyway, and ``put`` doubles as THE engine-seam verb — counting
+# ``self.backend.put(...)`` as a container write would misfile every
+# sanctioned executor-domain engine call as a race on ``backend``
+_MUTATORS = {"append", "appendleft", "add", "insert", "extend",
+             "remove", "discard", "pop", "popitem", "popleft", "clear",
+             "update", "setdefault", "sort", "reverse", "push"}
+
+# thread-safe-by-construction attr types: accesses through them ARE the
+# discipline (queue hand-off, event flag, the lock object itself)
+_SAFE_QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue",
+                     "PriorityQueue", "deque"}
+_THREADING_SYNC_CTORS = {"Event", "Lock", "RLock", "Condition",
+                         "Semaphore", "BoundedSemaphore", "Barrier"}
+# sync locks whose `with` blocks count as guarded regions
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+def _ctor_kind(d: Optional[str]) -> Optional[str]:
+    """"queue" / "sync" / "lock" when ``d`` is a recognized
+    thread-safe constructor (asyncio.Lock et al. are async-side
+    primitives, not cross-thread guards — only their queues count)."""
+    if not d:
+        return None
+    segs = d.split(".")
+    name, first = segs[-1], (segs[0] if len(segs) > 1 else "")
+    if name in _SAFE_QUEUE_CTORS and first in ("", "queue", "asyncio",
+                                               "collections"):
+        return "queue"
+    if name in _THREADING_SYNC_CTORS and first in ("", "threading"):
+        return "lock" if name in _LOCK_CTORS else "sync"
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class _Access:
+    attr: str
+    write: bool
+    init: bool            # construction phase: __init__ + init-only helpers
+    const_store: bool     # plain `self.x = <constant>` assignment
+    domains: FrozenSet[str]
+    guards: FrozenSet[str]
+    path: str
+    line: int
+    col: int
+    scope_name: str
+
+
+class _Analysis:
+    """All pass-3 facts, computed once per Program and shared by the
+    four rules (the memoized-fixpoint discipline of pass 2)."""
+
+    def __init__(self, program: Program) -> None:
+        self.program = program
+        self.fn_domains: Dict[str, Set[str]] = {}
+        # (module path, id(scope node)) -> domain set
+        self._scope_dom: Dict[Tuple[str, int], FrozenSet[str]] = {}
+        # (module name, class name) -> {attr: "queue"|"sync"|"lock"}
+        self.safe_attrs: Dict[Tuple[str, str], Dict[str, str]] = {}
+        # canonical lock id -> ctor name ("Lock", "RLock", ...)
+        self.lock_ctor: Dict[str, str] = {}
+        # per-class attr access table
+        self.table: Dict[Tuple[str, str], List[_Access]] = {}
+        # qual -> lock ids acquired directly in the function body
+        self.direct_acquires: Dict[str, Set[str]] = {}
+        # lock graph: held -> {acquired: (path, line)}
+        self.lock_edges: Dict[str, Dict[str, Tuple[str, int]]] = {}
+        # await-under-lock hits: (await node info, lock id, with line)
+        self.await_hits: List[Tuple[str, int, int, str, int, str]] = []
+        # first spawn edge per target qual (for cross-file endpoints)
+        self.spawn_for: Dict[str, "object"] = {}
+        self._trans_acq: Dict[str, FrozenSet[str]] = {}
+
+        self._compute_domains()
+        self._collect_locks_and_safe_attrs()
+        self._collect_accesses_and_lock_order()
+
+    # -- domains -----------------------------------------------------------
+
+    def _compute_domains(self) -> None:
+        program = self.program
+        dom: Dict[str, Set[str]] = {q: set() for q in program.functions}
+        for q, fi in program.functions.items():
+            if isinstance(fi.node, ast.AsyncFunctionDef):
+                dom[q].add(LOOP)
+        for e in program.spawn_edges:
+            if e.target is not None:
+                self.spawn_for.setdefault(e.target, e)
+            t = e.target
+            if t not in dom:
+                continue
+            if isinstance(program.functions[t].node,
+                          ast.AsyncFunctionDef):
+                continue    # a coroutine body runs on the loop regardless
+            dom[t].add(e.kind if e.kind in (THREAD, EXECUTOR) else LOOP)
+
+        # spawned NESTED defs: their calls are attributed to the
+        # enclosing def, so seed their resolved callees here
+        if program.nested_spawns:
+            by_path: Dict[str, Dict[int, str]] = {}
+            for (path, nid), kind in program.nested_spawns.items():
+                by_path.setdefault(path, {})[nid] = kind
+            for path, nested in by_path.items():
+                mod = program.by_path.get(path)
+                if mod is None:
+                    continue
+                for scope, owner, nodes in program.scope_index(mod):
+                    kind = nested.get(id(scope))
+                    if kind is None:
+                        continue
+                    seed = kind if kind in (THREAD, EXECUTOR) else LOOP
+                    for node in nodes:
+                        if not isinstance(node, ast.Call):
+                            continue
+                        callee = program.resolve_call(mod, owner, node)
+                        if callee is not None and callee.qual in dom \
+                                and not isinstance(callee.node,
+                                                   ast.AsyncFunctionDef):
+                            dom[callee.qual].add(seed)
+
+        def propagate(work: List[str]) -> None:
+            while work:
+                q = work.pop()
+                for callee in program.calls.get(q, ()):
+                    tfi = program.functions.get(callee)
+                    if tfi is None or isinstance(tfi.node,
+                                                 ast.AsyncFunctionDef):
+                        continue
+                    add = dom[q] - dom[callee]
+                    if add:
+                        dom[callee] |= add
+                        work.append(callee)
+
+        propagate([q for q in dom if dom[q]])
+        mains = [q for q in dom if not dom[q]]
+        for q in mains:
+            dom[q].add(MAIN)
+        propagate(mains)
+        self.fn_domains = dom
+
+    def scope_domains(self, mod: ModuleInfo, scope: ast.AST,
+                      owner: Optional[FunctionInfo]) -> FrozenSet[str]:
+        key = (mod.path, id(scope))
+        out = self._scope_dom.get(key)
+        if out is not None:
+            return out
+        program = self.program
+        if owner is not None and scope is owner.node:
+            out = frozenset(self.fn_domains.get(owner.qual, {MAIN}))
+        else:
+            kind = program.nested_spawns.get(key)
+            if kind is not None:
+                out = frozenset({kind if kind in (THREAD, EXECUTOR)
+                                 else LOOP})
+            elif isinstance(scope, ast.AsyncFunctionDef):
+                out = frozenset({LOOP})
+            elif owner is not None:
+                # un-spawned nested def: runs wherever its owner runs
+                out = frozenset(self.fn_domains.get(owner.qual, {MAIN}))
+            else:
+                out = frozenset({MAIN})
+        self._scope_dom[key] = out
+        return out
+
+    # -- locks + safe attrs ------------------------------------------------
+
+    def _collect_locks_and_safe_attrs(self) -> None:
+        """Per-class safe-typed attrs (queues, events, locks) and the
+        canonical-id registry for module-level / local lock objects."""
+        self.local_locks: Dict[Tuple[str, str], str] = {}
+        for mod in self.program.modules.values():
+            src = mod.ctx.source
+            if "(" not in src:
+                continue
+            for ci in mod.classes.values():
+                attrs: Dict[str, str] = {}
+                for fi in ci.methods.values():
+                    for node in ast.walk(fi.node):
+                        if not (isinstance(node, ast.Assign)
+                                and isinstance(node.value, ast.Call)):
+                            continue
+                        kind = _ctor_kind(dotted(node.value.func))
+                        if kind is None:
+                            continue
+                        for t in node.targets:
+                            if isinstance(t, ast.Attribute) \
+                                    and isinstance(t.value, ast.Name) \
+                                    and t.value.id == "self":
+                                attrs.setdefault(t.attr, kind)
+                                if kind == "lock":
+                                    lid = f"{mod.name}::{ci.name}." \
+                                          f"{t.attr}"
+                                    self.lock_ctor[lid] = dotted(
+                                        node.value.func).split(".")[-1]
+                if attrs:
+                    self.safe_attrs[(mod.name, ci.name)] = attrs
+            # module-level / function-local lock objects
+            if "Lock(" in src or "Condition(" in src or "RLock(" in src:
+                for scope, owner, nodes in self.program.scope_index(mod):
+                    scope_key = owner.qual if owner is not None \
+                        else mod.name
+                    for node in nodes:
+                        if not (isinstance(node, ast.Assign)
+                                and isinstance(node.value, ast.Call)):
+                            continue
+                        if _ctor_kind(dotted(node.value.func)) != "lock":
+                            continue
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                lid = f"{scope_key}::{t.id}"
+                                self.local_locks[(scope_key, t.id)] = lid
+                                self.lock_ctor[lid] = dotted(
+                                    node.value.func).split(".")[-1]
+
+    def lock_id(self, mod: ModuleInfo, owner: Optional[FunctionInfo],
+                expr: ast.AST) -> Optional[str]:
+        """Canonical identity of a lock-valued expression, or None.
+        Known-constructed locks always qualify; otherwise a trailing
+        segment containing "lock"/"mutex" does (named-lock heuristic)."""
+        d = dotted(expr)
+        if d is None:
+            return None
+        segs = d.split(".")
+        last = segs[-1].lower()
+        if segs[0] in ("self", "cls") and len(segs) == 2 \
+                and owner is not None and owner.class_name:
+            lid = f"{mod.name}::{owner.class_name}.{segs[1]}"
+            if lid in self.lock_ctor or "lock" in last or "mutex" in last:
+                return lid
+            kind = self.safe_attrs.get(
+                (mod.name, owner.class_name), {}).get(segs[1])
+            return lid if kind == "lock" else None
+        if len(segs) == 1:
+            for scope_key in ((owner.qual,) if owner else ()) + (mod.name,):
+                lid = self.local_locks.get((scope_key, segs[0]))
+                if lid is not None:
+                    return lid
+            if "lock" in last or "mutex" in last:
+                key = owner.qual if owner is not None else mod.name
+                return f"{key}::{segs[0]}"
+            return None
+        if "lock" in last or "mutex" in last:
+            return f"{mod.name}::{d}"
+        return None
+
+    # -- access table + lock order + await-under-lock ----------------------
+
+    def _init_phase(self) -> Set[str]:
+        """Methods that only ever run during construction: ``__init__``
+        itself plus helpers reachable ONLY from construction-phase
+        methods of the same class (the ``self._setup_metrics()`` idiom)
+        — their writes are pre-publication and cannot race."""
+        program = self.program
+        callers: Dict[str, Set[str]] = {}
+        for q, callees in program.calls.items():
+            for c in callees:
+                callers.setdefault(c, set()).add(q)
+        init = {q for q, fi in program.functions.items()
+                if fi.is_method and fi.name in _INIT_NAMES}
+        for _ in range(3):
+            changed = False
+            for q, fi in program.functions.items():
+                if q in init or not fi.is_method:
+                    continue
+                cs = callers.get(q)
+                if not cs:
+                    continue
+                prefix = q.rsplit(".", 1)[0]    # "mod::Cls"
+                if all(c in init and c.rsplit(".", 1)[0] == prefix
+                       for c in cs):
+                    init.add(q)
+                    changed = True
+            if not changed:
+                break
+        return init
+
+    def _collect_accesses_and_lock_order(self) -> None:
+        program = self.program
+        init_phase = self._init_phase()
+        nontrivial = any(d - {MAIN} for d in self.fn_domains.values())
+        # two phases: direct_acquires must be complete for EVERY scope
+        # before any interprocedural (call-under-held-lock) edge is
+        # drawn, so locked scopes are queued and processed afterwards
+        locked_scopes = []
+        for mod in program.modules.values():
+            src = mod.ctx.source
+            want_locks = "with" in src and ("lock" in src.lower()
+                                            or "Condition" in src)
+            want_access = nontrivial and ("self." in src
+                                          or "= " in src)
+            if not (want_locks or want_access):
+                continue
+            parents = program.parents(mod)
+            for scope, owner, nodes in program.scope_index(mod):
+                sdom = self.scope_domains(mod, scope, owner)
+                lock_withs: Dict[int, List[str]] = {}
+                if want_locks:
+                    for node in nodes:
+                        if isinstance(node, ast.With):
+                            ids = []
+                            for item in node.items:
+                                lid = self.lock_id(mod, owner,
+                                                   item.context_expr)
+                                if lid is not None:
+                                    ids.append(lid)
+                            if ids:
+                                lock_withs[id(node)] = ids
+                if lock_withs:
+                    key = owner.qual if owner is not None \
+                        else f"<{mod.path}>"
+                    acq = self.direct_acquires.setdefault(key, set())
+                    for ids in lock_withs.values():
+                        acq.update(ids)
+                    locked_scopes.append(
+                        (mod, owner, scope, nodes, parents, lock_withs))
+                if want_access:
+                    self._accesses_for_scope(
+                        mod, owner, scope, nodes, parents, sdom,
+                        lock_withs, init_phase)
+        for mod, owner, scope, nodes, parents, lock_withs in locked_scopes:
+            self._lock_order_for_scope(
+                mod, owner, scope, nodes, parents, lock_withs)
+            self._await_under_lock_for_scope(
+                mod, nodes, parents, scope, lock_withs)
+
+    def _guards_of(self, node: ast.AST, parents, scope: ast.AST,
+                   lock_withs: Dict[int, List[str]]) -> FrozenSet[str]:
+        out: Set[str] = set()
+        cur = parents.get(id(node))
+        while cur is not None and cur is not scope:
+            ids = lock_withs.get(id(cur))
+            if ids:
+                out.update(ids)
+            cur = parents.get(id(cur))
+        return frozenset(out)
+
+    def _accesses_for_scope(self, mod, owner, scope, nodes, parents,
+                            sdom, lock_withs, init_phase) -> None:
+        for node in nodes:
+            if not isinstance(node, ast.Attribute):
+                continue
+            base = node.value
+            if not isinstance(base, ast.Name):
+                continue
+            if base.id in ("self", "cls"):
+                if owner is None or not owner.class_name:
+                    continue
+                ck = (mod.name, owner.class_name)
+                is_self = True
+            else:
+                if owner is None:
+                    continue
+                cname = owner.constructed_class(base.id)
+                if cname is None:
+                    continue
+                ci = self.program.resolve_class(mod, cname)
+                if ci is None:
+                    continue
+                ck = (ci.module.name, ci.name)
+                is_self = False
+
+            par = parents.get(id(node))
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            const_store = bool(
+                write and isinstance(par, ast.Assign)
+                and isinstance(par.value, ast.Constant))
+            if not write:
+                if isinstance(par, ast.Subscript) and par.value is node \
+                        and isinstance(par.ctx, (ast.Store, ast.Del)):
+                    write = True              # self.x[k] = v / del self.x[k]
+                elif isinstance(par, ast.Attribute) and par.value is node:
+                    gp = parents.get(id(par))
+                    if isinstance(par.ctx, (ast.Store, ast.Del)):
+                        write = True          # self.x.y = v mutates x's obj
+                    elif isinstance(gp, ast.Call) and gp.func is par \
+                            and par.attr in _MUTATORS:
+                        write = True          # self.x.append(...)
+            init = (is_self and owner is not None
+                    and owner.qual in init_phase
+                    and owner.class_name == ck[1])
+            self.table.setdefault(ck, []).append(_Access(
+                attr=node.attr, write=write, init=init,
+                const_store=const_store, domains=sdom,
+                guards=self._guards_of(node, parents, scope, lock_withs),
+                path=mod.path, line=node.lineno, col=node.col_offset,
+                scope_name=(owner.name if owner is not None
+                            else "<module>")))
+
+    def _lock_order_for_scope(self, mod, owner, scope, nodes, parents,
+                              lock_withs) -> None:
+        for node in nodes:
+            wids = lock_withs.get(id(node))
+            if wids:
+                held = list(self._guards_of(node, parents, scope,
+                                            lock_withs))
+                cur = held[:]
+                for lid in wids:
+                    for h in cur:
+                        self.lock_edges.setdefault(h, {}).setdefault(
+                            lid, (mod.path, node.lineno))
+                    cur.append(lid)
+            elif isinstance(node, ast.Call):
+                held = self._guards_of(node, parents, scope, lock_withs)
+                if not held:
+                    continue
+                callee = self.program.resolve_call(mod, owner, node)
+                if callee is None:
+                    continue
+                for lid in self.transitive_acquires(callee.qual):
+                    for h in held:
+                        self.lock_edges.setdefault(h, {}).setdefault(
+                            lid, (mod.path, node.lineno))
+
+    def transitive_acquires(self, qual: str,
+                            _seen: Optional[Set[str]] = None
+                            ) -> FrozenSet[str]:
+        cached = self._trans_acq.get(qual)
+        if cached is not None:
+            return cached
+        seen = _seen if _seen is not None else set()
+        if qual in seen:
+            return frozenset()
+        seen.add(qual)
+        out = set(self.direct_acquires.get(qual, ()))
+        for callee in self.program.calls.get(qual, ()):
+            out |= self.transitive_acquires(callee, seen)
+        if _seen is None:
+            self._trans_acq[qual] = frozenset(out)
+        return frozenset(out)
+
+    def _await_under_lock_for_scope(self, mod, nodes, parents, scope,
+                                    lock_withs) -> None:
+        for node in nodes:
+            if not isinstance(node, ast.Await):
+                continue
+            cur = parents.get(id(node))
+            while cur is not None and cur is not scope:
+                ids = lock_withs.get(id(cur))
+                if ids:
+                    self.await_hits.append(
+                        (mod.path, node.lineno, node.col_offset,
+                         ids[0], cur.lineno, mod.path))
+                    break
+                cur = parents.get(id(cur))
+
+
+def _analysis(program: Program) -> _Analysis:
+    a = getattr(program, "_tpulint_concurrency", None)
+    if a is None:
+        a = _Analysis(program)
+        program._tpulint_concurrency = a
+    return a
+
+
+def function_domains(program: Program) -> Dict[str, Set[str]]:
+    """Public seam for tests: qual -> inferred execution-domain set."""
+    return _analysis(program).fn_domains
+
+
+def _fmt_dom(domains: FrozenSet[str]) -> str:
+    return "/".join(sorted(domains))
+
+
+# --------------------------------------------------------------------------
+# rule: shared-state-race
+# --------------------------------------------------------------------------
+
+@rule("shared-state-race",
+      "a class attribute written from two execution domains, or "
+      "written in one and read from another, with no recognized "
+      "discipline (shared threading.Lock guard, queue hand-off, "
+      "single-writer constant flag, or executor-seam serialization) — "
+      "a data race the GIL only hides until the schedule changes",
+      library_only=True, scope="program")
+def check_shared_state_race(program: Program) -> Iterator[Finding]:
+    a = _analysis(program)
+    for (mod_name, cls), accesses in sorted(a.table.items()):
+        safe = a.safe_attrs.get((mod_name, cls), {})
+        by_attr: Dict[str, List[_Access]] = {}
+        for acc in accesses:
+            by_attr.setdefault(acc.attr, []).append(acc)
+        for attr, accs in sorted(by_attr.items()):
+            if attr in safe:
+                continue    # queue/event/lock attr: the discipline itself
+            writes = [x for x in accs if x.write and not x.init]
+            if not writes:
+                continue
+            wdoms = frozenset().union(*[x.domains for x in writes])
+            if len(wdoms) > 1:
+                conflicts = writes
+                multi = True
+            else:
+                cross = [x for x in accs
+                         if not x.write and not x.init
+                         and not (x.domains <= wdoms)]
+                if not cross:
+                    continue
+                conflicts = writes + cross
+                multi = False
+            common = set(conflicts[0].guards)
+            for x in conflicts[1:]:
+                common &= set(x.guards)
+            if common:
+                continue    # every conflicting access shares a lock
+            if not multi and all(x.const_store for x in writes):
+                continue    # single-writer constant flag (GIL-atomic
+                #             publication, e.g. self._dead = True)
+            anchor = min(writes, key=lambda x: (x.path, x.line))
+            other = next((x for x in conflicts
+                          if x.domains != anchor.domains), None)
+            if other is None:
+                other = next((x for x in conflicts if x is not anchor),
+                             anchor)
+            verb = "written" if other.write else "read"
+            yield Finding(
+                "shared-state-race", anchor.path, anchor.line,
+                anchor.col,
+                f"{cls}.{attr} is written in the "
+                f"{_fmt_dom(anchor.domains)} domain ({anchor.scope_name})"
+                f" and {verb} in the {_fmt_dom(other.domains)} domain "
+                f"({other.scope_name}, {Path(other.path).name}:"
+                f"{other.line}) with no shared lock, queue hand-off, or "
+                "single-writer-flag discipline — guard both sides with "
+                "one threading.Lock, hand the value through a queue, or "
+                "route the access through the executor seam",
+                end_path=other.path, end_line=other.line)
+
+
+# --------------------------------------------------------------------------
+# rule: lock-order-cycle
+# --------------------------------------------------------------------------
+
+@rule("lock-order-cycle",
+      "two locks acquired in opposite orders on different code paths "
+      "(directly nested `with` blocks or via calls made while a lock "
+      "is held) — a latent AB/BA deadlock that only needs two threads "
+      "and the wrong schedule",
+      library_only=True, scope="program")
+def check_lock_order_cycle(program: Program) -> Iterator[Finding]:
+    a = _analysis(program)
+    if not a.lock_edges:
+        return
+    # self-loop: re-acquiring a known non-reentrant Lock deadlocks
+    reported: Set[FrozenSet[str]] = set()
+    for src, dsts in sorted(a.lock_edges.items()):
+        if src in dsts and a.lock_ctor.get(src) == "Lock":
+            path, line = dsts[src]
+            key = frozenset({src})
+            if key not in reported:
+                reported.add(key)
+                yield Finding(
+                    "lock-order-cycle", path, line, 0,
+                    f"{src.split('::')[-1]} is acquired again while "
+                    "already held and is a non-reentrant "
+                    "threading.Lock — this self-deadlocks on the first "
+                    "nested entry (use RLock, or restructure so the "
+                    "inner path is called lock-free)")
+    # AB/BA (and longer) cycles via DFS
+    for start in sorted(a.lock_edges):
+        stack = [(start, [start])]
+        while stack:
+            node, trail = stack.pop()
+            for nxt in sorted(a.lock_edges.get(node, {})):
+                if nxt == start and len(trail) > 1:
+                    key = frozenset(trail)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    p1, l1 = a.lock_edges[trail[0]][trail[1]]
+                    back_p, back_l = a.lock_edges[trail[-1]][start]
+                    order = " -> ".join(t.split("::")[-1]
+                                        for t in trail + [start])
+                    yield Finding(
+                        "lock-order-cycle", p1, l1, 0,
+                        f"lock acquisition cycle {order}: one path "
+                        "takes them in this order while another takes "
+                        "them reversed "
+                        f"({Path(back_p).name}:{back_l}) — two threads "
+                        "interleaving these paths deadlock; pick one "
+                        "global order (or collapse to a single lock)",
+                        end_path=back_p, end_line=back_l)
+                elif nxt not in trail and len(trail) < 6:
+                    stack.append((nxt, trail + [nxt]))
+
+
+# --------------------------------------------------------------------------
+# rule: await-under-lock
+# --------------------------------------------------------------------------
+
+@rule("await-under-lock",
+      "an `await` inside a synchronous lock's `with` body — the "
+      "coroutine parks with the lock still held, so any other task "
+      "(or thread) needing it blocks the loop indefinitely; use "
+      "asyncio.Lock with `async with`, or release before awaiting",
+      library_only=True, scope="program")
+def check_await_under_lock(program: Program) -> Iterator[Finding]:
+    a = _analysis(program)
+    for (path, line, col, lid, with_line, with_path) in a.await_hits:
+        yield Finding(
+            "await-under-lock", path, line, col,
+            f"await while holding the synchronous lock "
+            f"{lid.split('::')[-1]} (acquired "
+            f"{Path(with_path).name}:{with_line}): the coroutine "
+            "suspends with the lock held, stalling every thread and "
+            "task that needs it — make it an asyncio.Lock (`async "
+            "with`) or move the await outside the guarded region",
+            end_path=with_path, end_line=with_line)
+
+
+# --------------------------------------------------------------------------
+# rule: seam-freeze
+# --------------------------------------------------------------------------
+
+@rule("seam-freeze",
+      "an engine-ish call (step/put/drain/cancel/...) from a "
+      "loop-domain sync helper or a spawned thread that does not "
+      "route through the executor seam — the engine is "
+      "single-threaded behind ONE seam (Gateway._call's worker); any "
+      "other path races it.  Complements async-blocking, which only "
+      "sees syntactic `async def` bodies",
+      library_only=True, scope="program")
+def check_seam_freeze(program: Program) -> Iterator[Finding]:
+    a = _analysis(program)
+    interesting = any((LOOP in d or THREAD in d)
+                      for d in a.fn_domains.values()) \
+        or program.nested_spawns
+    if not interesting:
+        return
+    for mod in program.modules.values():
+        src = mod.ctx.source
+        if not any(s in src for s in _ASYNC_ENGINE_RECV):
+            continue
+        for scope, owner, nodes in program.scope_index(mod):
+            if isinstance(scope, (ast.AsyncFunctionDef, ast.Module)):
+                continue    # async bodies are async-blocking's turf
+            sdom = a.scope_domains(mod, scope, owner)
+            if not (sdom & {LOOP, THREAD}) or EXECUTOR in sdom:
+                continue
+            # cross-file provenance: the spawn that created this domain
+            edge = None
+            if owner is not None:
+                edge = a.spawn_for.get(owner.qual)
+                if edge is None and scope is not owner.node \
+                        and isinstance(scope, ast.FunctionDef):
+                    edge = a.spawn_for.get(
+                        f"{owner.qual}.<local>.{scope.name}")
+            for node in nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                if d is None:
+                    continue
+                segs = d.split(".")
+                if segs[-1] in _ASYNC_ENGINE_SEAMS \
+                        and set(segs[:-1]) & _ASYNC_ENGINE_RECV:
+                    where = ("a spawned thread" if THREAD in sdom
+                             else "a loop-domain sync helper")
+                    yield Finding(
+                        "seam-freeze", mod.path, node.lineno,
+                        node.col_offset,
+                        f"{d}() runs in {where} "
+                        f"({_fmt_dom(sdom)} domain) without routing "
+                        "through the executor seam — the engine is "
+                        "single-threaded behind one run_in_executor "
+                        "worker; call it via the seam "
+                        "(await gateway._call(...) / run_in_executor) "
+                        "or hand the work to the main serving loop",
+                        end_path=(edge.path if edge is not None
+                                  else None),
+                        end_line=(edge.line if edge is not None
+                                  else None))

@@ -30,16 +30,27 @@ _PRAGMA = re.compile(r"#\s*tpulint:\s*(disable(?:-file)?)\s*=\s*([\w\-,\s]+)")
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
-    """One diagnostic: ``path:line:col: [rule] message``."""
+    """One diagnostic: ``path:line:col: [rule] message``.
+
+    Whole-program findings can span two files — e.g. a thread spawned
+    in one module racing state defined in another.  ``end_path`` /
+    ``end_line`` carry the second endpoint (the conflicting access, the
+    spawn site, the other lock acquisition); ``--changed`` keeps a
+    finding when EITHER endpoint is dirty."""
     rule: str
     path: str
     line: int
     col: int
     message: str
+    end_path: Optional[str] = None
+    end_line: Optional[int] = None
 
     def human(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] " \
-               f"{self.message}"
+        s = f"{self.path}:{self.line}:{self.col}: [{self.rule}] " \
+            f"{self.message}"
+        if self.end_path is not None:
+            s += f" [-> {self.end_path}:{self.end_line}]"
+        return s
 
     def json(self) -> Dict[str, object]:
         return dataclasses.asdict(self)
@@ -245,6 +256,7 @@ def lint_paths(paths: Iterable[str],
     file, so cross-file context is never lost (``--changed`` mode)."""
     from . import rules as _rules  # noqa: F401  (populate the registry)
     from . import dataflow as _dataflow  # noqa: F401
+    from . import concurrency as _concurrency  # noqa: F401
     axes = mesh_axes if mesh_axes is not None else find_mesh_axes(paths)
     selected = list(RULES.values())
     if rules is not None:
@@ -285,5 +297,11 @@ def lint_paths(paths: Iterable[str],
 
     if report_only is not None:
         keep = {str(Path(p).resolve()) for p in report_only}
-        out = [f for f in out if str(Path(f.path).resolve()) in keep]
+        # either-endpoint match: a cross-file finding whose cause site
+        # (spawn) is dirty but whose symptom site is clean must still
+        # be reported
+        out = [f for f in out
+               if str(Path(f.path).resolve()) in keep
+               or (f.end_path is not None
+                   and str(Path(f.end_path).resolve()) in keep)]
     return sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule))

@@ -17,7 +17,14 @@ Walks every linted file once and produces a :class:`Program`:
   pjit / shard_map decorated, passed to a jit/pjit/shard_map
   application, or transitively called from one of those;
 * **donation table** — every ``donate_argnums`` binding, whether bound
-  to a local name, a ``self.attr``, or returned from a builder helper.
+  to a local name, a ``self.attr``, or returned from a builder helper;
+* **spawn edges** — a second edge kind alongside plain calls: every
+  site that hands a callable to another execution domain
+  (``threading.Thread(target=...)``, ``run_in_executor`` /
+  ``to_thread`` / ``executor.submit`` thunks — including callables
+  forwarded through a seam method like ``Gateway._call`` — and
+  ``create_task`` / ``run_coroutine_threadsafe`` task spawns).  Pass 3
+  (:mod:`concurrency`) BFSes these to infer execution domains.
 
 Pass 2 (:mod:`dataflow`) runs its rules against this context.  Like the
 rest of tpulint the pass is pure ``ast`` — nothing is imported.
@@ -128,6 +135,26 @@ class FunctionInfo:
 
 
 @dataclasses.dataclass(frozen=True)
+class SpawnEdge:
+    """One concurrency hand-off: a callable crossing into another
+    execution domain.  ``kind`` is ``"thread"`` (Thread target),
+    ``"executor"`` (run_in_executor / to_thread / pool.submit thunk,
+    directly or forwarded through a seam method), or ``"task"``
+    (create_task / ensure_future / run_coroutine_threadsafe /
+    asyncio.run).  ``target`` is the resolved program-level def's qual;
+    for a def nested inside the spawning function it is the synthetic
+    ``owner.qual + ".<local>." + name`` (the nested def itself is also
+    indexed in ``Program.nested_spawns``)."""
+    kind: str
+    caller: Optional[str]           # qual of the spawning def, None: module
+    target: Optional[str]
+    path: str                       # spawn site
+    line: int
+    target_path: Optional[str] = None
+    target_line: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class JitBinding:
     """One jit/pjit application with its trace-relevant kwargs."""
     donate_argnums: Tuple[int, ...]
@@ -204,6 +231,15 @@ class Program:
         self.call_sites: Dict[str, List[Tuple[ast.Call, FunctionInfo]]] = {}
         self.jit_roots: Set[str] = set()
         self.jit_reachable: Set[str] = set()
+        # concurrency hand-off sites (thread/executor/task spawns)
+        self.spawn_edges: List[SpawnEdge] = []
+        # nested defs used as spawn targets: (module path, id(def node))
+        # -> spawn kind — their bodies run in the spawned domain even
+        # though their calls are attributed to the enclosing def
+        self.nested_spawns: Dict[Tuple[str, int], str] = {}
+        # params a def forwards to an executor submission (the
+        # ``Gateway._call(fn, ...)`` seam idiom), by qual
+        self.executor_params: Dict[str, Set[str]] = {}
         # FunctionInfo for the innermost def enclosing any AST node,
         # keyed by (module path, id(node))
         self._owner: Dict[Tuple[str, int], Optional[FunctionInfo]] = {}
@@ -383,6 +419,45 @@ class Program:
                         cls = self.resolve_class(module, cls_name)
                         if cls:
                             return self.method_on(cls, func.attr)
+        return None
+
+    def resolve_callable_expr(self, module: ModuleInfo,
+                              owner: Optional[FunctionInfo],
+                              expr: ast.AST) -> Optional[FunctionInfo]:
+        """A callable-valued expression (a thread target, an executor
+        thunk) -> the program-level def it names, or None.  Unwraps
+        ``functools.partial(fn, ...)``; nested defs resolve to None
+        here (see ``Program.nested_spawns``)."""
+        if isinstance(expr, ast.Call):
+            d = dotted(expr.func)
+            if d and d.split(".")[-1] == "partial" and expr.args:
+                return self.resolve_callable_expr(module, owner,
+                                                  expr.args[0])
+            return None
+        if isinstance(expr, ast.Name):
+            if owner is not None and owner.nested_def(expr.id) is not None:
+                return None
+            return self.resolve_symbol(module, expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id in ("self", "cls") \
+                    and owner is not None and owner.class_name:
+                cls = module.classes.get(owner.class_name)
+                return self.method_on(cls, expr.attr) if cls else None
+            d = dotted(base)
+            if d is not None:
+                target = module.imports.get(d.split(".")[0])
+                if target:
+                    mod_name = ".".join([target] + d.split(".")[1:])
+                    m = self.modules.get(mod_name)
+                    if m and expr.attr in m.functions:
+                        return m.functions[expr.attr]
+                if owner is not None and isinstance(base, ast.Name):
+                    cls_name = owner.constructed_class(base.id)
+                    if cls_name:
+                        cls = self.resolve_class(module, cls_name)
+                        if cls:
+                            return self.method_on(cls, expr.attr)
         return None
 
 
@@ -608,6 +683,162 @@ def _collect_calls_and_roots(program: Program, mod: ModuleInfo) -> None:
                 program.jit_roots.add(owner.qual)
 
 
+# --------------------------------------------------------------------------
+# spawn edges (thread / executor / task hand-offs)
+# --------------------------------------------------------------------------
+
+_THREAD_SPAWN_NAMES = {"Thread", "Timer"}
+_TASK_SPAWN_NAMES = {"create_task", "ensure_future",
+                     "run_coroutine_threadsafe"}
+# substrings whose absence lets a whole module skip the spawn walk
+_SPAWN_HINTS = ("Thread", "Timer", "executor", "to_thread", "submit",
+                "create_task", "ensure_future", "run_coroutine",
+                "asyncio.run")
+
+
+def _spawn_callable_expr(call: ast.Call):
+    """``(kind, callable expr)`` when ``call`` hands a callable to
+    another execution domain, else None."""
+    d = dotted(call.func)
+    if d is None:
+        return None
+    segs = d.split(".")
+    name = segs[-1]
+    if name in _THREAD_SPAWN_NAMES:
+        for k in call.keywords:
+            if k.arg == "target":
+                return ("thread", k.value)
+        return None
+    if name == "run_in_executor" and len(call.args) >= 2:
+        return ("executor", call.args[1])
+    if name == "to_thread" and call.args:
+        return ("executor", call.args[0])
+    if name == "submit" and call.args:
+        recv = [s.lower() for s in segs[:-1]]
+        if any("exec" in s or "pool" in s for s in recv):
+            return ("executor", call.args[0])
+        return None
+    if name in _TASK_SPAWN_NAMES and call.args:
+        return ("task", call.args[0])
+    if d == "asyncio.run" and call.args:
+        return ("task", call.args[0])
+    return None
+
+
+def _record_spawn(program: Program, mod: ModuleInfo,
+                  owner: Optional[FunctionInfo], kind: str,
+                  expr: ast.AST, site: ast.AST) -> None:
+    target_qual = target_path = target_line = None
+    # a task spawn's argument is usually the coroutine CALL itself
+    if kind == "task" and isinstance(expr, ast.Call):
+        fi = program.resolve_call(mod, owner, expr)
+    else:
+        fi = program.resolve_callable_expr(mod, owner, expr)
+        if fi is None and isinstance(expr, ast.Name) and owner is not None:
+            nested = owner.nested_def(expr.id)
+            if nested is not None:
+                program.nested_spawns.setdefault(
+                    (mod.path, id(nested)), kind)
+                target_qual = f"{owner.qual}.<local>.{expr.id}"
+                target_path, target_line = mod.path, nested.lineno
+    if fi is not None:
+        target_qual = fi.qual
+        target_path, target_line = fi.module.path, fi.node.lineno
+    program.spawn_edges.append(SpawnEdge(
+        kind, owner.qual if owner else None, target_qual,
+        mod.path, site.lineno, target_path, target_line))
+
+
+def _collect_spawn_edges(program: Program, mod: ModuleInfo) -> None:
+    src = mod.ctx.source
+    if not any(h in src for h in _SPAWN_HINTS):
+        return
+    for node in ast.walk(mod.ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        hit = _spawn_callable_expr(node)
+        if hit is None:
+            continue
+        kind, expr = hit
+        owner = program.owner_of(mod, node)
+        _record_spawn(program, mod, owner, kind, expr, node)
+
+
+def _collect_executor_forwarders(program: Program) -> None:
+    """Fixpoint over ``Program.executor_params``: a def forwards a param
+    to the executor when the param is the callable of a
+    run_in_executor / to_thread / pool.submit site in its body
+    (possibly wrapped in ``partial``), or is passed on to another
+    forwarder at a forwarder-param position.  Every resolved call that
+    feeds a forwarder param then records an "executor" spawn edge —
+    this is how the ``Gateway._call`` seam stays one edge kind."""
+    # seeds: direct executor submissions of a param
+    for mod in program.modules.values():
+        if "executor" not in mod.ctx.source \
+                and "to_thread" not in mod.ctx.source \
+                and "submit" not in mod.ctx.source:
+            continue
+        for node in ast.walk(mod.ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = _spawn_callable_expr(node)
+            if hit is None or hit[0] != "executor":
+                continue
+            expr = hit[1]
+            if isinstance(expr, ast.Call):          # partial(fn, ...)
+                d = dotted(expr.func)
+                if d and d.split(".")[-1] == "partial" and expr.args:
+                    expr = expr.args[0]
+            owner = program.owner_of(mod, node)
+            if owner is None or not isinstance(expr, ast.Name):
+                continue
+            names, _ = owner.params()
+            if expr.id in names:
+                program.executor_params.setdefault(
+                    owner.qual, set()).add(expr.id)
+
+    # propagate through forwarder call chains (bounded)
+    for _ in range(4):
+        changed = False
+        for qual, sites in program.call_sites.items():
+            caller = program.functions.get(qual)
+            if caller is None:
+                continue
+            names, _ = caller.params()
+            if not names:
+                continue
+            for call, callee in sites:
+                fwd = program.executor_params.get(callee.qual)
+                if not fwd:
+                    continue
+                for pname, aexpr in callee.arg_to_param(call).items():
+                    if pname in fwd and isinstance(aexpr, ast.Name) \
+                            and aexpr.id in names:
+                        have = program.executor_params.setdefault(
+                            qual, set())
+                        if aexpr.id not in have:
+                            have.add(aexpr.id)
+                            changed = True
+        if not changed:
+            break
+
+    # every call feeding a forwarder param spawns its argument onto the
+    # executor: record the edge
+    for qual, sites in program.call_sites.items():
+        caller = program.functions.get(qual)
+        if caller is None:
+            continue
+        mod = caller.module
+        for call, callee in sites:
+            fwd = program.executor_params.get(callee.qual)
+            if not fwd:
+                continue
+            for pname, aexpr in callee.arg_to_param(call).items():
+                if pname in fwd:
+                    _record_spawn(program, mod, caller, "executor",
+                                  aexpr, call)
+
+
 def build_program(ctxs: Iterable[FileContext]) -> Program:
     program = Program()
     for ctx in ctxs:
@@ -628,6 +859,9 @@ def build_program(ctxs: Iterable[FileContext]) -> Program:
         _collect_attr_bindings(program, mod)
     for mod in program.modules.values():
         _collect_calls_and_roots(program, mod)
+    for mod in program.modules.values():
+        _collect_spawn_edges(program, mod)
+    _collect_executor_forwarders(program)
 
     # BFS: everything reachable from a trace entry is traced
     frontier = list(program.jit_roots)
