@@ -246,6 +246,7 @@ def main(trace_path=None, profile_dir=None):
     overload = leg(overload_serving_bench, on_tpu)
     chaos = leg(chaos_serving_bench, on_tpu)
     fleet = leg(fleet_serving_bench, on_tpu)
+    tiered = leg(tiered_kv_serving_bench, on_tpu)
     http = leg(http_serving_bench, on_tpu)
     llama_train = leg(llama_train_bench, on_tpu, peak)
     llama_serve = leg(llama8b_serving_bench, on_tpu)
@@ -270,7 +271,7 @@ def main(trace_path=None, profile_dir=None):
     }
     out.update(serve)
     print(json.dumps({**out, **pipe, **prefix, **spec, **overload,  # tpulint: disable=print — the bench's one JSON output line
-                      **chaos, **fleet, **http, **llama_train,
+                      **chaos, **fleet, **tiered, **http, **llama_train,
                       **llama_serve, **moe, **comm}))
 
 
@@ -387,6 +388,34 @@ def fleet_serving_bench(on_tpu: bool):
             # and the aggregated fleet device metrics
             "fleet_serving_anomalies": out["affinity"]["anomalies"],
             "fleet_device_metrics": out["affinity"]["device_metrics"]}
+
+
+def tiered_kv_serving_bench(on_tpu: bool):
+    """Tiered-KV leg (docs/KV_TIERING.md): a revisit-heavy prefix
+    workload whose working set is >4x the KV pool, through
+    discard-on-evict / tiered / all-HBM arms at identical shapes, plus
+    the fleet remote-restage-vs-re-prefill arm.  Token parity across
+    arms and tier-counter consistency (revives never outrun demotions,
+    zero verify failures) are asserted inside before anything is
+    recorded.  The headline metrics land top-level for
+    ``tools/benchdiff.py``'s direction rules: ``tiered_kv_hit_rate``
+    up-is-better, the ``*_ttft_*`` keys down-is-better — including
+    ``tiered_kv_ttft_vs_allhbm``, the 1.25x acceptance bar (tiered p95
+    TTFT over the all-HBM ceiling) — and
+    ``tiered_kv_remote_restage_speedup`` (re-prefill TTFT over
+    cross-replica restage TTFT) up-is-better."""
+    from tools.loadgen import tiered_kv_bench
+
+    out = tiered_kv_bench(seed=0)
+    return {"tiered_kv": out,
+            "tiered_kv_hit_rate": out["tiered"]["hit_rate"],
+            "tiered_kv_ttft_p95_ms": out["tiered"]["ttft_ms_p95"],
+            "tiered_kv_baseline_ttft_p95_ms":
+                out["baseline"]["ttft_ms_p95"],
+            "tiered_kv_allhbm_ttft_p95_ms": out["allhbm"]["ttft_ms_p95"],
+            "tiered_kv_ttft_vs_allhbm": out["ttft_vs_allhbm"],
+            "tiered_kv_remote_restage_speedup":
+                out["remote_restage_speedup"]}
 
 
 def http_serving_bench(on_tpu: bool):

@@ -264,6 +264,25 @@ class InferenceConfig:
     # open work token-identically.  None uses FailureConfig()
     # defaults (auto watchdog, engaged after a calibration warmup).
     failure: Optional[FailureConfig] = None
+    # tiered KV cache (inference/ragged/tier.py, docs/KV_TIERING.md):
+    # prefix-cache eviction demotes full content-hashed blocks into a
+    # bounded host-RAM ring instead of discarding them, with ring
+    # overflow spilled to NVMe files through ops/aio.py; a match_prefix
+    # digest hit in the tier restages the chain asynchronously —
+    # overlapping the dispatch-ahead window the way COW drains do — so
+    # a spilled-chain hit pays block uploads, not a re-prefill.  "on"
+    # enables (requires prefix_cache != "off"); "off" disables; "auto"
+    # defers to the engine and today resolves OFF (the tier trades host
+    # RAM/disk for recompute — the ROADMAP-4 autotuner is the intended
+    # flipper, and bench.py's tiered_kv leg records the tradeoff).
+    kv_tier: str = "auto"
+    # host-RAM ring budget; overflow spills to kv_tier_dir (if set)
+    kv_tier_ram_mb: float = 64.0
+    # NVMe spill directory — None (default) runs the tier RAM-only;
+    # spill files are named <chain_digest>.kv and are useless without
+    # the owning process's in-memory index (restart discards them)
+    kv_tier_dir: Optional[str] = None
+    kv_tier_nvme_mb: float = 256.0
 
 
 # attn-impl probe results, memoized per (backend, shape signature)
@@ -330,6 +349,15 @@ class InferenceEngine:
         if self.icfg.prefix_cache not in ("auto", "on", "off"):
             raise ValueError(f"prefix_cache={self.icfg.prefix_cache!r}: "
                              "expected 'auto', 'on', or 'off'")
+        if self.icfg.kv_tier not in ("auto", "on", "off"):
+            raise ValueError(f"kv_tier={self.icfg.kv_tier!r}: "
+                             "expected 'auto', 'on', or 'off'")
+        if self.icfg.kv_tier == "on" and self.icfg.prefix_cache == "off":
+            raise ValueError(
+                "kv_tier='on' requires the prefix cache: the tier keys "
+                "demoted blocks by their chain digests, which only the "
+                "prefix-cache index computes (set prefix_cache to "
+                "'auto'/'on' or kv_tier to 'auto'/'off')")
         max_len = self.icfg.max_seq_len or self.cfg.max_seq_len
         # a sequence can never hold more blocks than the pool has
         self.max_blocks_per_seq = min(-(-max_len // self.icfg.kv_block_size),
@@ -346,6 +374,15 @@ class InferenceEngine:
                                   max_blocks_per_seq=self.max_blocks_per_seq,
                                   prefix_cache=self.icfg.prefix_cache
                                   != "off")
+        # "auto" resolves OFF today — demotion trades host RAM/disk +
+        # drain time for saved recompute, a workload call the ROADMAP-4
+        # autotuner (and bench.py's tiered_kv leg) is meant to make
+        if self.icfg.kv_tier == "on":
+            from .ragged.tier import KVBlockTier
+            self.state.tier = KVBlockTier(
+                ram_bytes=int(self.icfg.kv_tier_ram_mb * (1 << 20)),
+                nvme_dir=self.icfg.kv_tier_dir,
+                nvme_bytes=int(self.icfg.kv_tier_nvme_mb * (1 << 20)))
         self.topology = topology if (
             topology is not None and topology.device_count > 1) else None
         self.params = jax.tree.map(
@@ -387,6 +424,7 @@ class InferenceEngine:
         self._ctx_exhausted: set = set()
         self._rng = jax.random.PRNGKey(0)
         self._cow_fn = None           # lazy jitted prefix-cache block copy
+        self._restage_fn = None       # lazy jitted tier->HBM block upload
         self._pstep_fns: Dict[tuple, object] = {}  # (bucket, sampler_key)
         self._burst_fns: Dict[tuple, object] = {}
         # serving programs that have COMPLETED at least one call: only
@@ -529,6 +567,60 @@ class InferenceEngine:
                 "re-builds of a program key this engine had already "
                 "compiled (runtime retrace — each warns loudly)",
                 int_valued=True),
+            # tiered KV cache (docs/KV_TIERING.md): demotions count
+            # blocks evicted into the host ring, spills the ring's
+            # overflow pushed on to NVMe files, revives the blocks
+            # restaged back into HBM by source tier; every revive that
+            # lands in a round which also dispatched a step overlapped
+            # the dispatch-ahead window (the TTFT win the tier exists
+            # for).  Verify failures are payloads rejected by the
+            # checksum / chain-digest contract — nonzero outside a
+            # corruption drill means the spill path is eating data
+            "kv_tier_demotions": reg.counter(
+                "serving_kv_tier_demotions_total",
+                "KV blocks demoted from HBM into the host-RAM tier",
+                int_valued=True),
+            "kv_tier_spills": reg.counter(
+                "serving_kv_tier_spills_total",
+                "tier blocks spilled from the host ring to NVMe",
+                int_valued=True),
+            "kv_tier_drops": reg.counter(
+                "serving_kv_tier_drops_total",
+                "tier blocks dropped off the bottom of the hierarchy",
+                int_valued=True),
+            "kv_tier_revives_ram": reg.counter(
+                "serving_kv_tier_revives_ram_total",
+                "blocks restaged into HBM from the host ring",
+                int_valued=True),
+            "kv_tier_revives_nvme": reg.counter(
+                "serving_kv_tier_revives_nvme_total",
+                "blocks restaged into HBM from NVMe spill files",
+                int_valued=True),
+            "kv_tier_revives_remote": reg.counter(
+                "serving_kv_tier_revives_remote_total",
+                "blocks restaged into HBM from peer-replica fetches",
+                int_valued=True),
+            "kv_tier_restage_overlap_hits": reg.counter(
+                "serving_kv_tier_restage_overlap_hits_total",
+                "revives resolved in a round that also dispatched a "
+                "step (the restage overlapped the dispatch-ahead "
+                "window)", int_valued=True),
+            "kv_tier_verify_failures": reg.counter(
+                "serving_kv_tier_verify_failures_total",
+                "restage/fetch payloads rejected by checksum or "
+                "chain-digest verification (fell back to re-prefill)",
+                int_valued=True),
+            "kv_tier_demoted_bytes": reg.counter(
+                "serving_kv_tier_demoted_bytes_total",
+                "payload bytes demoted into the host ring",
+                int_valued=True),
+            "kv_tier_spilled_bytes": reg.counter(
+                "serving_kv_tier_spilled_bytes_total",
+                "payload bytes spilled to NVMe", int_valued=True),
+            "kv_tier_remote_blocks": reg.counter(
+                "serving_kv_tier_remote_blocks_total",
+                "tier blocks imported from peer replicas "
+                "(snapshot-v2 tier_blocks records)", int_valued=True),
         }
         # first-call wall time of each program (compile rides it): the
         # timestamps are the dispatch path's existing t2/t3, so this
@@ -578,6 +670,19 @@ class InferenceEngine:
         reg.gauge_fn("serving_prefix_hit_rate", self._prefix_hit_rate,
                      "cached_tokens / prompt_tokens over the measured "
                      "window (absent before any prompt token)")
+        # tier occupancy: pull-gauges over tier.stats() truth (absent
+        # when the tier is off — None suppresses the series, the same
+        # contract the devtel gauges use)
+        tg = lambda k: (lambda: (self.state.tier.stats()[k]  # noqa: E731
+                                 if self.state.tier is not None else None))
+        reg.gauge_fn("serving_kv_tier_ram_entries", tg("ram_entries"),
+                     "blocks resident in the host-RAM tier ring")
+        reg.gauge_fn("serving_kv_tier_ram_bytes", tg("ram_bytes"),
+                     "payload bytes resident in the host-RAM tier ring")
+        reg.gauge_fn("serving_kv_tier_nvme_entries", tg("nvme_entries"),
+                     "blocks resident in NVMe spill files")
+        reg.gauge_fn("serving_kv_tier_nvme_bytes", tg("nvme_bytes"),
+                     "payload bytes resident in NVMe spill files")
         # --- flight recorder (telemetry/flight.py): always constructed
         # — the happy path never touches it, and the failure path's
         # breadcrumbs must exist BEFORE the crash someone debugs
@@ -1741,6 +1846,13 @@ class InferenceEngine:
             prompt_len = len(toks) if new_prompt else 0
             cached = 0
             if new_prompt and prefix_on and toks[0] != FEEDBACK_TOKEN:
+                if self.state.restaging(uid):
+                    # a tiered chain is restaging for this request —
+                    # defer (keep it queued, schedule nothing): the
+                    # pre-dispatch drain re-indexes the chain and the
+                    # next round's match covers it, instead of
+                    # re-prefilling content already in flight
+                    return "ok"
                 # the match may revive cached-free blocks / take a COW
                 # copy ONLY from the headroom not already reserved by
                 # earlier admits this round
@@ -1750,6 +1862,8 @@ class InferenceEngine:
                         uid, toks,
                         max_pool_take=self.state.allocator.free_blocks
                         - reserved_blocks)
+                if not cached and self.state.restaging(uid):
+                    return "ok"       # the match itself began a restage
                 if cached:
                     del toks[:cached]
                     seq = self.state.seqs[uid]
@@ -2449,6 +2563,29 @@ class InferenceEngine:
             self._reaped.add(rec["uid"])
         return part
 
+    def export_tier_chain(self, digests: Sequence[bytes]) -> Optional[Dict]:
+        """Extract the leading contiguous run of ``digests`` this
+        engine's KV tier can serve, as a snapshot-v2-shaped partial
+        payload (``tier_blocks`` records ride the same fabric migration
+        records do — ``load_snapshot(merge=True)`` on the destination).
+        Non-destructive: this replica keeps its tier entries.  Returns
+        None when the tier is off or the first digest misses; every
+        record was checksum-verified on the way out, so a corrupted
+        spill file truncates the run instead of exporting bad bytes."""
+        tier = self.state.tier
+        if tier is None:
+            return None
+        blocks = []
+        for h in digests:
+            rec = tier.export(bytes(h))
+            if rec is None:
+                break          # only a leading run is restageable
+            blocks.append(rec)
+        if not blocks:
+            return None
+        return {"version": self.SNAPSHOT_VERSION, "partial": True,
+                "requests": [], "tier_blocks": blocks}
+
     def load_snapshot(self, snap: Dict, merge: bool = False) -> None:
         """Re-open a snapshot's requests on THIS engine (the restore
         half of the warm restart — :meth:`restore` wraps construction +
@@ -2494,6 +2631,20 @@ class InferenceEngine:
                     f"load_snapshot(merge=True): uid(s) {sorted(clash)} "
                     "already open on this engine — a request must never "
                     "run on two replicas at once")
+        # fetched KV tier blocks (docs/KV_TIERING.md): part of the same
+        # whole-payload-first validation — every record must recompute
+        # its chain digest from (parent, tokens) AND match its payload
+        # checksum before anything is applied.  A forged or corrupted
+        # block rejects the payload; it can never reach the device cache
+        tier_blocks = snap.get("tier_blocks") or []
+        if tier_blocks:
+            from .ragged.tier import KVBlockTier
+            bad = [i for i, rec in enumerate(tier_blocks)
+                   if not KVBlockTier.verify_record(rec)]
+            if bad:
+                raise ValueError(
+                    f"snapshot tier_blocks {bad} failed digest/checksum "
+                    "verification: refusing the whole payload")
         now = time.perf_counter()
         tm = self.timings
         for rec in snap["requests"]:
@@ -2524,6 +2675,20 @@ class InferenceEngine:
                 open_rec.retries = int(rec.get("retries", 0))
             if self._spec is not None:
                 self._spec.observe(uid, toks)
+        if tier_blocks:
+            tier = self.state.tier
+            if tier is None:
+                logger.warning(
+                    "load_snapshot: %d tier_blocks arrived but kv_tier "
+                    "is off on this engine — dropping them (the request "
+                    "records were applied normally)", len(tier_blocks))
+            else:
+                for rec in tier_blocks:
+                    ev = tier.insert_record(rec)
+                    tm["kv_tier_remote_blocks"] += ev["stored"]
+                    tm["kv_tier_spills"] += ev["spilled"]
+                    tm["kv_tier_spilled_bytes"] += ev["spilled_bytes"]
+                    tm["kv_tier_drops"] += ev["dropped"]
 
     @classmethod
     def restore(cls, model: Model, snap: Dict,
@@ -2655,6 +2820,11 @@ class InferenceEngine:
         sched = self._schedule()
         self._close_ctx_exhausted()
         if not sched:
+            # an idle round still moves tier work: evictions queued by
+            # the schedule pass demote, and in-flight restages resolve
+            # (a deferred request is waiting on exactly this)
+            self._drain_tier_demote()
+            self._drain_tier_restage(dispatching=False)
             return None
         cap = self._cap
         if cap is not None and cap.armed:
@@ -2697,7 +2867,13 @@ class InferenceEngine:
                 draft_lens={u: len(d)
                             for u, d in self._sched_drafts.items()},
                 n_verify=self._n_verify))
+        # device-order bracket: demote reads of just-evicted blocks must
+        # enqueue before ANY write that may reuse them (COW copies,
+        # restage uploads, the step itself) — stream ordering then makes
+        # the read see the old content
+        self._drain_tier_demote()
         self._drain_cow()       # COW copies land before the step's write
+        self._drain_tier_restage(dispatching=True)
         t2 = time.perf_counter()
         if callable(rng):
             rng = rng()
@@ -2880,6 +3056,68 @@ class InferenceEngine:
             for src, dst in copies:
                 self.state.kv = self._cow_fn(self.state.kv, np.int32(src),
                                              np.int32(dst))
+
+    def _drain_tier_demote(self) -> None:  # tpulint: serving-loop
+        """Read each just-evicted block off the device and demote its
+        payload into the host tier (tier.py owns the host-side copy and
+        any NVMe spill).  Runs BEFORE every write that could reuse the
+        block — the COW drain, restage uploads, the step dispatch — so
+        stream ordering guarantees the read sees the old content.  A
+        round with no eviction is a no-op."""
+        q = self.state.take_tier_demotes()
+        if not q:
+            return
+        tm = self.timings
+        with self.tracer.span("tier_demote", track="stage", n=len(q)):
+            for parent, digest, tokens, blk in q:
+                payload = jax.tree.map(lambda x: x[:, blk], self.state.kv)
+                ev = self.state.tier.put(parent, digest, tokens,
+                                         jax.tree.leaves(payload))
+                tm["kv_tier_demotions"] += ev["stored"]
+                tm["kv_tier_demoted_bytes"] += ev["nbytes"]
+                tm["kv_tier_spills"] += ev["spilled"]
+                tm["kv_tier_spilled_bytes"] += ev["spilled_bytes"]
+                tm["kv_tier_drops"] += ev["dropped"]
+
+    def _drain_tier_restage(self,
+                            dispatching: bool
+                            ) -> None:  # tpulint: serving-loop
+        """Resolve every queued tier->HBM restage: finish its I/O,
+        verify the payload (checksum; the chain digest was verified at
+        import for remote records), upload it into the reserved block
+        and register the digest — or free the block and count a verify
+        failure, leaving the deferred request to re-prefill.  Runs
+        after the COW drain so uploads into just-evicted blocks enqueue
+        AFTER the demote reads of those same blocks."""
+        q = self.state.take_tier_restage()
+        if not q:
+            return
+        if self._restage_fn is None:
+            def write_block(kv, dst, payload):
+                return jax.tree.map(
+                    lambda x, p: x.at[:, dst].set(p), kv, payload)
+
+            # same donation/placement policy as the step programs (and
+            # the COW copy): the upload is an async enqueue, the drain
+            # never waits on the device
+            self._restage_fn = self._serving_jit(write_block, kv_argnum=0,
+                                                 kv_only_output=True)
+        tm = self.timings
+        treedef = jax.tree.structure(self.state.kv)
+        with self.tracer.span("tier_restage", track="stage", n=len(q)):
+            for ent in q:
+                leaves = self.state.tier.resolve(ent.op)
+                if leaves is None:
+                    self.state.abort_restage(ent)
+                    tm["kv_tier_verify_failures"] += 1
+                    continue
+                payload = jax.tree.unflatten(treedef, leaves)
+                self.state.kv = self._restage_fn(
+                    self.state.kv, np.int32(ent.dst), payload)
+                self.state.commit_restage(ent)
+                tm["kv_tier_revives_" + ent.op.source] += 1
+                if dispatching:
+                    tm["kv_tier_restage_overlap_hits"] += 1
 
     def _mark_feedback(self, uid: int, st: _InFlight) -> None:
         """Queue uid's next decode token as a deferred on-device read of
@@ -3122,7 +3360,11 @@ class InferenceEngine:
             # capture windows count bursts as one step each (the one
             # profiler seam — profile_decode8b drives this path)
             capw.begin(sid=self._dispatch_seq, step=self._steps_done)
+        # same bracket as _dispatch: demote reads, then COW copies, then
+        # restage uploads — all enqueued before the burst's writes
+        self._drain_tier_demote()
         self._drain_cow()        # pending COW copies precede burst writes
+        self._drain_tier_restage(dispatching=True)
         st = self.state
         S = self.icfg.max_seqs
         base = np.zeros(S, np.int32)

@@ -38,6 +38,26 @@ FEEDBACK_TOKEN = -1
 # root parent digest of every per-sequence block hash chain
 _CHAIN_ROOT = b"kv-prefix-chain-v1"
 
+# revive rounds a single queued request may trigger before match_prefix
+# stops probing the tier for it: reviving allocates destination blocks,
+# and in a tiny pool that allocation can evict (and re-demote) the very
+# ancestors the chain needs — the cap turns that churn into a bounded
+# cost and falls through to a plain resident match / re-prefill
+_MAX_REVIVE_ATTEMPTS = 2
+
+
+class RestageEntry(NamedTuple):
+    """One queued tier->HBM block restage: the engine resolves ``op``
+    (tier.ReviveOp) at its pre-dispatch drain, uploads the verified
+    payload into block ``dst`` and registers ``digest`` — or frees
+    ``dst`` when verification fails (the caller re-prefills)."""
+    uid: int
+    digest: bytes
+    parent: bytes
+    tokens: Tuple[int, ...]
+    dst: int
+    op: object
+
 
 def chain_hash(parent: bytes, tokens) -> bytes:
     """Rolling content hash of one FULL KV block: digest of
@@ -296,6 +316,26 @@ class StateManager:
         # domains & recovery") or a later prefix match would alias
         # never-written KV
         self.round_registered: List[Tuple[bytes, int]] = []
+        # tiered KV (tier.py, attached by the engine when kv_tier
+        # resolves on; None = discard-on-evict, the pre-tier behavior).
+        # Demotions and restages are QUEUES the engine drains around its
+        # pre-dispatch device work — the scheduler itself never touches
+        # the device or the disk
+        self.tier = None
+        # (parent_digest, chain_digest, block_tokens, block) — content
+        # evicted from the index this round, payload still on device
+        # until the next dispatched step overwrites the block
+        self.tier_pending_demote: List[
+            Tuple[bytes, bytes, Tuple[int, ...], int]] = []
+        self.tier_pending_restage: List[RestageEntry] = []
+        # uid -> outstanding restage ops; a uid in here is deferred by
+        # the scheduler (admitted next round, once its chain re-indexes)
+        self._restaging_uids: Dict[int, int] = {}
+        self._revive_attempts: Dict[int, int] = {}
+        # block -> (parent_digest, block_tokens): what _on_evict needs
+        # to demote a block's content under its chain key; tracks
+        # _block_hash keys exactly
+        self._block_meta: Dict[int, Tuple[bytes, Tuple[int, ...]]] = {}
         # paged KV: [L, blocks+1, block_size, 2, Hkv, D] — the extra row is
         # the trash block that padding tokens' KV writes are routed to
         # (plus per-vector scales when cfg.quant != "none")
@@ -318,6 +358,7 @@ class StateManager:
         reference each: a block whose content is index-registered and
         whose refcount hits zero retires to the cached-free LRU pool
         (matchable until evicted); the rest go back to the free list."""
+        self._revive_attempts.pop(uid, None)
         seq = self.seqs.pop(uid, None)
         if seq is None:
             return
@@ -339,10 +380,18 @@ class StateManager:
     # ---- prefix cache ----------------------------------------------------
     def _on_evict(self, block: int) -> None:
         """Allocator reclaimed a cached-free block: drop its index entry
-        (nothing may match content about to be overwritten)."""
+        (nothing may match content about to be overwritten).  With a
+        tier attached the content is queued for demotion instead of
+        dying — the engine reads the block off the device BEFORE the
+        step that overwrites it dispatches (same pre-dispatch ordering
+        COW drains rely on)."""
         h = self._block_hash.pop(block, None)
+        meta = self._block_meta.pop(block, None)
         if h is not None:
             self._hash_index.pop(h, None)
+            if self.tier is not None and meta is not None:
+                self.tier_pending_demote.append(
+                    (meta[0], h, meta[1], block))
 
     def match_prefix(self, uid: int, tokens: List[int],
                      max_pool_take: Optional[int] = None) -> int:
@@ -375,12 +424,36 @@ class StateManager:
         hashes: List[bytes] = []
         blocks: List[int] = []
         takes = 0
+        revive_run: List[bytes] = []
         # lazy digests: a cache-miss admission hashes ONE block and
         # stops, instead of pre-hashing the whole prompt
-        for h in iter_prefix_chain_digests(tokens, bs,
-                                           self.max_blocks_per_seq):
+        digest_iter = iter_prefix_chain_digests(tokens, bs,
+                                                self.max_blocks_per_seq)
+        for h in digest_iter:
             b = self._hash_index.get(h)
             if b is None:
+                if (self.tier is not None
+                        and uid not in self._restaging_uids
+                        and self._revive_attempts.get(uid, 0)
+                        < _MAX_REVIVE_ATTEMPTS
+                        and h in self.tier):
+                    # the resident run ends in the tier: gather the
+                    # contiguous spilled continuation, bounded by the
+                    # pool headroom its destination blocks will consume.
+                    # max_pool_take is the scheduler's UNRESERVED
+                    # headroom — at <= 0 a restage dst would steal a
+                    # block already promised to this round's admitted
+                    # batch, so the revive waits for a later round
+                    budget = min(max_pool_take,
+                                 self.allocator.free_blocks)
+                    if budget <= 0:
+                        break
+                    revive_run.append(h)
+                    for h2 in digest_iter:
+                        if len(revive_run) >= budget \
+                                or h2 not in self.tier:
+                            break
+                        revive_run.append(h2)
                 break
             t = 1 if self.allocator.refcount(b) == 0 else 0
             if takes + t > max_pool_take:
@@ -388,6 +461,12 @@ class StateManager:
             takes += t
             hashes.append(h)
             blocks.append(b)
+        if revive_run and self._begin_restage(uid, revive_run):
+            # the whole match ABORTS (no refs were taken): the caller
+            # re-queues the request and the engine's restage drain
+            # re-indexes the chain, so next round's match covers both
+            # the resident run and the revived continuation
+            return 0
         if not blocks:
             return 0
         for b in blocks:
@@ -419,6 +498,7 @@ class StateManager:
         seq.cached_tokens = matched
         seq.chain = list(tokens[:matched])
         seq.hashes = hashes
+        self._revive_attempts.pop(uid, None)
         return matched
 
     def _register_chain_blocks(self, seq: SequenceDescriptor) -> None:
@@ -436,6 +516,8 @@ class StateManager:
                 b = seq.blocks[k]
                 self._hash_index[h] = b
                 self._block_hash[b] = h
+                self._block_meta[b] = (
+                    parent, tuple(seq.chain[k * bs:(k + 1) * bs]))
                 self.allocator.mark_cached(b)
                 self.round_registered.append((h, b))
 
@@ -451,6 +533,7 @@ class StateManager:
                 continue
             del self._hash_index[h]
             self._block_hash.pop(b, None)
+            self._block_meta.pop(b, None)
             self.allocator.unmark_cached(b)
 
     def reset_prefix_cache(self) -> None:
@@ -461,7 +544,17 @@ class StateManager:
             self.allocator.unmark_cached(b)
         self._block_hash.clear()
         self._hash_index.clear()
+        self._block_meta.clear()
         self.cow_pending.clear()
+        # invalidated content must not be demoted or restaged either:
+        # dump the demote queue and free every pending restage's
+        # destination (its tier entry was consumed — acceptable loss on
+        # a content reset, which only happens before real traffic)
+        self.tier_pending_demote.clear()
+        for ent in self.tier_pending_restage:
+            self.allocator.free([ent.dst])
+        self.tier_pending_restage.clear()
+        self._restaging_uids.clear()
 
     def prefix_digests(self) -> frozenset:
         """Hex digests resident in the prefix-cache index right now —
@@ -495,6 +588,87 @@ class StateManager:
         clear the queue."""
         out = [(s, d) for _, s, d in self.cow_pending]
         self.cow_pending.clear()
+        return out
+
+    # ---- tier plumbing (tier.py; docs/KV_TIERING.md) ---------------------
+    def _begin_restage(self, uid: int, run: List[bytes]) -> bool:
+        """Start restaging a contiguous run of tiered chain digests for
+        a deferred request: consume each tier entry (NVMe reads are
+        queued inside ``begin_revive`` so they overlap the scheduler
+        round) and allocate its destination block, held at refcount 1
+        until the engine's drain commits or aborts the upload."""
+        if not run or self.allocator.free_blocks < len(run):
+            return False
+        if len(self._revive_attempts) > 1024:
+            # bounded: uids cancelled while still queued never release()
+            self._revive_attempts.pop(next(iter(self._revive_attempts)))
+        self._revive_attempts[uid] = \
+            self._revive_attempts.get(uid, 0) + 1
+        started = 0
+        for h in run:
+            op = self.tier.begin_revive(h)
+            if op is None:
+                break
+            [dst] = self.allocator.allocate(1)
+            self.tier_pending_restage.append(
+                RestageEntry(uid, h, op.parent, op.tokens, dst, op))
+            started += 1
+        if not started:
+            return False
+        self._restaging_uids[uid] = \
+            self._restaging_uids.get(uid, 0) + started
+        return True
+
+    def restaging(self, uid: int) -> bool:
+        """Whether ``uid`` has restage ops in flight — the scheduler
+        defers (keeps queued, schedules nothing for) such a request."""
+        return uid in self._restaging_uids
+
+    def commit_restage(self, ent: RestageEntry) -> None:
+        """The engine verified and uploaded ``ent``'s payload into
+        ``ent.dst``: register the digest and retire the block to the
+        cached-free pool (matchable, evictable — restaged content IS
+        cache content).  Joins ``round_registered`` so a failed step
+        unwinds the registration like any other."""
+        b, h = ent.dst, ent.digest
+        if h not in self._hash_index:
+            self._hash_index[h] = b
+            self._block_hash[b] = h
+            self._block_meta[b] = (ent.parent, tuple(ent.tokens))
+            self.allocator.mark_cached(b)
+            self.round_registered.append((h, b))
+        # a racing prefill may have re-registered the digest while the
+        # restage was in flight — our copy is then redundant and the
+        # free below retires it straight to the plain free list
+        self.allocator.free([b])
+        self._restage_done(ent.uid)
+
+    def abort_restage(self, ent: RestageEntry) -> None:
+        """Verification failed (or the payload died with its spill
+        file): free the destination unregistered — the request falls
+        back to a plain re-prefill, which rebuilds the chain."""
+        self.allocator.free([ent.dst])
+        self._restage_done(ent.uid)
+
+    def _restage_done(self, uid: int) -> None:
+        n = self._restaging_uids.get(uid, 0) - 1
+        if n <= 0:
+            self._restaging_uids.pop(uid, None)
+        else:
+            self._restaging_uids[uid] = n
+
+    def take_tier_demotes(self) -> List[Tuple[bytes, bytes,
+                                              Tuple[int, ...], int]]:
+        """Hand the queued (parent, digest, tokens, block) demotions to
+        the engine, which reads each block off the device BEFORE the
+        step that overwrites it dispatches."""
+        out = self.tier_pending_demote
+        self.tier_pending_demote = []
+        return out
+
+    def take_tier_restage(self) -> List[RestageEntry]:
+        out = self.tier_pending_restage
+        self.tier_pending_restage = []
         return out
 
     # ---- scheduling query ------------------------------------------------

@@ -10,11 +10,32 @@ from __future__ import annotations
 
 import ctypes
 import os
+import warnings
 from typing import Optional
 
 import numpy as np
 
 from .builder import AsyncIOBuilder
+
+
+class AioError(OSError):
+    """Typed failure from the aio pool: a read against a missing or
+    short file, or chunks the backend reported failed.  Callers that
+    treat spill files as a cache (the KV tier, the swappers) catch this
+    one type and fall back to recompute — a partial buffer must never
+    be returned silently.
+
+    ``path`` names the file, ``expected`` the bytes the caller needed,
+    ``actual`` the bytes available (or failed-chunk count for a backend
+    failure; ``None`` when the file is missing outright)."""
+
+    def __init__(self, msg: str, path: Optional[str] = None,
+                 expected: Optional[int] = None,
+                 actual: Optional[int] = None):
+        super().__init__(msg)
+        self.path = path
+        self.expected = expected
+        self.actual = actual
 
 
 class AsyncIOHandle:
@@ -100,15 +121,46 @@ class AsyncIOHandle:
 
     def __del__(self):
         h = getattr(self, "_h", None)
-        if h:
-            self._lib.aio_destroy(h)
-            self._h = None
+        lib = getattr(self, "_lib", None)
+        if not h or lib is None:
+            return
+        leaked = int(lib.aio_pending(h))
+        if leaked:
+            # a handle dropped with ops still queued is a caller bug
+            # (buffers may be freed while worker threads still target
+            # them) — surface it, then drain so destruction is safe
+            warnings.warn(
+                f"AsyncIOHandle destroyed with {leaked} pending op(s); "
+                "call wait() before dropping the handle", ResourceWarning,
+                stacklevel=2)
+            lib.aio_wait(h)
+        lib.aio_destroy(h)
+        self._h = None
 
     # ---- async (reference: async_pread/async_pwrite) --------------------
     def async_pread(self, buffer: np.ndarray, path: str, offset: int = 0):
+        """Queue a read of exactly ``buffer.nbytes`` at ``offset``.
+
+        Raises :class:`AioError` up front when the file is missing or
+        shorter than the requested span — queueing would otherwise fill
+        part of the buffer and leave the rest stale, and the failure
+        would only surface as an aggregate failed-chunk count at
+        ``wait()`` with no way to name the file."""
         if not buffer.flags["C_CONTIGUOUS"]:
             raise ValueError("buffer must be C-contiguous")
-        self._lib.aio_pread(self._h, os.fspath(path).encode(),
+        p = os.fspath(path)
+        need = offset + buffer.nbytes
+        try:
+            have = os.stat(p).st_size
+        except OSError as e:
+            raise AioError(f"async_pread: {p!r}: {e.strerror or e}",
+                           path=p, expected=need) from e
+        if have < need:
+            raise AioError(
+                f"async_pread: short file {p!r}: need {need} bytes, "
+                f"file has {have} — refusing a partial read",
+                path=p, expected=need, actual=have)
+        self._lib.aio_pread(self._h, p.encode(),
                             buffer.ctypes.data_as(ctypes.c_void_p),
                             buffer.nbytes, offset)
 
@@ -135,8 +187,19 @@ class AsyncIOHandle:
 
     # ---- sync (reference: sync_pread/sync_pwrite) ------------------------
     def sync_pread(self, buffer: np.ndarray, path: str, offset: int = 0) -> int:
+        """Read and drain; raises :class:`AioError` when any chunk fails
+        (a file that shrank or vanished after the up-front size check,
+        an EIO from the device) instead of handing back a buffer that is
+        silently part-stale.  Returns 0 on success, for API parity with
+        the reference's failed-chunk count."""
         self.async_pread(buffer, path, offset)
-        return self.wait()
+        failed = self.wait()
+        if failed:
+            raise AioError(
+                f"sync_pread: {failed} failed chunk(s) reading "
+                f"{os.fspath(path)!r}", path=os.fspath(path),
+                expected=offset + buffer.nbytes, actual=failed)
+        return 0
 
     def sync_pwrite(self, buffer: np.ndarray, path: str, offset: int = 0,
                     truncate: bool = False) -> int:

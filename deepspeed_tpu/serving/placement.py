@@ -33,6 +33,31 @@ from ..inference.ragged.state import prefix_chain_digests
 PLACEMENT_POLICIES = ("affinity", "least_loaded", "round_robin")
 
 
+class CombinedDigestIndex:
+    """Membership view over a replica's RESIDENT digest index plus its
+    KV tier (docs/KV_TIERING.md "The tier as a fleet asset"): a tiered
+    chain scores placement affinity exactly like a resident one,
+    because the engine's ``match_prefix`` revive path can serve it —
+    restaging a spilled chain is far cheaper than re-prefilling it on a
+    cold replica.  Pure membership composition (two ``in``-supporting
+    containers), so it stays inside this module's no-engine-references
+    contract; :meth:`~.replica.ReplicaHandle.digest_index` builds it.
+    ``__len__`` is an upper bound (a digest resident AND tiered counts
+    twice) — ranking only uses ``in``."""
+
+    __slots__ = ("resident", "tier")
+
+    def __init__(self, resident, tier):
+        self.resident = resident
+        self.tier = tier
+
+    def __contains__(self, h) -> bool:
+        return h in self.resident or h in self.tier
+
+    def __len__(self) -> int:
+        return len(self.resident) + len(self.tier)
+
+
 def prompt_digests(tokens: Sequence[int], block_size: int,
                    max_blocks: Optional[int] = None) -> List[str]:
     """Hex chain digests of the prompt's full block-aligned prefixes —

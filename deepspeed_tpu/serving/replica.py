@@ -33,6 +33,8 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
+from .placement import CombinedDigestIndex
+
 
 class CircuitBreaker:
     """Per-replica quarantine state machine (module docstring above).
@@ -124,15 +126,28 @@ class ReplicaHandle:
 
     def prefix_digests(self) -> frozenset:
         """The replica's LIVE cache-affinity key (hex digest set) —
-        same key space as ``snapshot()["prefix_index"]``."""
-        return self.engine.state.prefix_digests()
+        same key space as ``snapshot()["prefix_index"]``.  With the KV
+        tier on, TIERED chains are advertised too: a spilled chain is
+        still servable (restage beats re-prefill), so it must still
+        attract its stream (docs/KV_TIERING.md)."""
+        base = self.engine.state.prefix_digests()
+        tier = getattr(self.engine.state, "tier", None)
+        if tier is not None and len(tier):
+            base = base | frozenset(h.hex() for h in tier.digests())
+        return base
 
     def digest_index(self):
         """The live BYTES-digest membership view the router scores
         against per placement — the index dict itself, so scoring a
         prompt costs dict lookups only (no per-placement set build or
-        hex conversion; read-only by contract).
-        :meth:`prefix_digests` is the exportable hex form."""
+        hex conversion; read-only by contract), or the resident+tier
+        :class:`~.placement.CombinedDigestIndex` when the engine's KV
+        tier is on (two lookups — tiered chains score like resident
+        ones).  :meth:`prefix_digests` is the exportable hex form."""
+        tier = getattr(self.engine.state, "tier", None)
+        if tier is not None:
+            return CombinedDigestIndex(self.engine.state._hash_index,
+                                       tier)
         return self.engine.state._hash_index
 
     def load(self) -> int:

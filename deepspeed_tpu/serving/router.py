@@ -228,6 +228,20 @@ class FleetRouter:
             "engine-level shed closures that were fleet routing "
             "retries — phantom terminals the reconciled fleet rollups "
             "subtract back out", int_valued=True)
+        self._c_tier_fetches = reg.counter(
+            "serving_fleet_tier_fetches_total",
+            "cross-replica KV tier chain fetches: a spilled chain "
+            "pulled from a peer replica's tier into the chosen "
+            "replica's (docs/KV_TIERING.md)", int_valued=True)
+        self._c_tier_fetch_blocks = reg.counter(
+            "serving_fleet_tier_fetch_blocks_total",
+            "KV blocks moved by cross-replica tier fetches",
+            int_valued=True)
+        self._c_tier_fetch_rejects = reg.counter(
+            "serving_fleet_tier_fetch_rejects_total",
+            "tier-fetch payloads rejected by digest/checksum "
+            "verification on arrival (the chosen replica re-prefills "
+            "instead)", int_valued=True)
         self._g_replicas = reg.gauge(
             "serving_fleet_replicas", "replicas registered (incl. dead)")
         self._g_routable = reg.gauge(
@@ -327,6 +341,75 @@ class FleetRouter:
             order = order + p_order
         return order, scores
 
+    def _tier_fetch(self, uid: int, name: str, tokens) -> None:  # tpulint: serving-loop
+        """Cross-replica KV tier fetch (docs/KV_TIERING.md "The tier as
+        a fleet asset").  After placing ``uid`` on replica ``name``,
+        find the prompt-chain CONTINUATION the chosen replica cannot
+        serve locally (neither resident nor tiered) but some peer still
+        holds in ITS tier, and move that leading run over the
+        snapshot-v2 record path — ``export_tier_chain`` (checksum-
+        verified on the way out) into ``load_snapshot(merge=True)``
+        (digest+checksum re-verified on arrival; a rejected payload
+        leaves the destination untouched and the stream simply
+        re-prefills).  Host-side bytes only — no device work; the
+        destination engine restages the blocks through its own
+        dispatch-overlapped revive path."""
+        dst = self._reps[name].engine
+        if getattr(dst.state, "tier", None) is None or len(self._reps) < 2:
+            return
+        peers = [(p, tier)
+                 for p, rep in self._reps.items()
+                 if p != name and not rep.dead
+                 and (tier := getattr(rep.engine.state, "tier",
+                                      None)) is not None
+                 and len(tier)]
+        if not peers:
+            return
+        local = self._reps[name].digest_index()
+        digests = list(iter_prefix_chain_digests(
+            tokens, self._block_size, self._max_blocks))
+        n = 0
+        for h in digests:
+            if h not in local:
+                break              # match_prefix stops here too
+            n += 1
+        rest = digests[n:]
+        if not rest:
+            return
+        best, best_len = None, 0
+        for peer, tier in peers:
+            k = 0
+            for h in rest:
+                if h not in tier:
+                    break          # only a leading run is restageable
+                k += 1
+            if k > best_len:
+                best, best_len = peer, k
+        if best is None:
+            return
+        payload = self._reps[best].engine.export_tier_chain(
+            rest[:best_len])
+        if payload is None:
+            return                 # peer's copy vanished or failed export
+        try:
+            dst.load_snapshot(payload, merge=True)
+        except ValueError as e:
+            # verification rejected the payload on arrival: count it,
+            # keep the placement — the request re-prefills normally
+            self._c_tier_fetch_rejects.inc()
+            self.flight.note("tier_fetch_reject", uid=int(uid),
+                             src=best, dst=name)
+            logger.warning("fleet: tier fetch %s -> %s rejected (%s)",
+                           best, name, e)
+            return
+        nblk = len(payload["tier_blocks"])
+        self._c_tier_fetches.inc()
+        self._c_tier_fetch_blocks.inc(nblk)
+        if self._ftel is not None:
+            self._ftel.journey_event(uid, "tier_fetch", self._steps,
+                                     replica=name, src=best,
+                                     blocks=nblk)
+
     # ------------------------------------------------------------------
     # the engine-shaped request API
     # ------------------------------------------------------------------
@@ -394,6 +477,12 @@ class FleetRouter:
                             uid, "placed", self._steps, replica=name,
                             via="arrival", policy=self.cfg.placement,
                             score=int(scores.get(name, 0)))
+                    # the chosen replica may be missing part of the
+                    # prompt's chain that a PEER spilled to its tier:
+                    # fetch it now, before first admission, so the
+                    # engine's match sees it and restages instead of
+                    # re-prefilling (docs/KV_TIERING.md)
+                    self._tier_fetch(uid, name, tokens)
                     return v._replace(replica=name)
                 # this replica shed a put the fleet will retry
                 # elsewhere: its engine-side terminal is a PHANTOM the

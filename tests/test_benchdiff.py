@@ -124,6 +124,33 @@ def test_http_leg_metrics_are_gated():
                for r in v["regressions"])
 
 
+def test_tiered_kv_leg_metrics_are_gated():
+    """The tiered_kv_serving_bench leg (docs/KV_TIERING.md): its
+    headline metrics land top-level under names the EXISTING direction
+    rules gate — hit rate up-is-better, the TTFT columns down-is-better
+    including ``tiered_kv_ttft_vs_allhbm`` (the 1.25x acceptance bar:
+    tiered p95 over the all-HBM ceiling, gated via its ``ttft`` stem),
+    and the fleet remote-restage speedup up-is-better — so a tier that
+    drifts away from the all-HBM curve or loses to re-prefill fails a
+    same-fingerprint compare."""
+    assert metric_direction("tiered_kv_hit_rate") == 1
+    assert metric_direction("tiered_kv_ttft_p95_ms") == -1
+    assert metric_direction("tiered_kv_baseline_ttft_p95_ms") == -1
+    assert metric_direction("tiered_kv_allhbm_ttft_p95_ms") == -1
+    assert metric_direction("tiered_kv_ttft_vs_allhbm") == -1
+    assert metric_direction("tiered_kv_remote_restage_speedup") == 1
+    # and drifting off the all-HBM curve actually trips the gate
+    base = {"engine_version": "1", "config_hash": "aaaa",
+            "value": 100.0, "tiered_kv_hit_rate": 0.6,
+            "tiered_kv_ttft_vs_allhbm": 1.2,
+            "tiered_kv_remote_restage_speedup": 1.1}
+    worse = dict(base, tiered_kv_ttft_vs_allhbm=1.7)
+    v = compare(base, worse)
+    assert not v["ok"]
+    assert any(r["metric"] == "tiered_kv_ttft_vs_allhbm"
+               for r in v["regressions"])
+
+
 def test_matching_fingerprint_enforces_and_exits_nonzero(tmp_path):
     old = {"engine_version": "1", "config_hash": "aaaa",
            "value": 100.0, "serving_decode_tok_s": 700.0}

@@ -18,7 +18,8 @@ import pytest
 from tools.loadgen import (Fault, Request, build_engine, chaos_smoke,
                            default_faults, fleet_chaos_smoke,
                            http_chaos_smoke, http_smoke, make_trace,
-                           replay, run_sweep, smoke, summarize)
+                           replay, run_sweep, smoke, summarize,
+                           tier_chaos_smoke)
 
 
 def test_make_trace_deterministic():
@@ -205,6 +206,26 @@ def test_fleet_chaos_covers_all_variants(fleet_chaos_out):
     # shared-prefix trace is doing its job)
     assert fleet_chaos_out["checks"]["greedy_cache_on_cache_hit"]
     assert fleet_chaos_out["checks"]["seeded_cache_on_cache_hit"]
+
+
+def test_tier_chaos_smoke_is_the_tiering_acceptance_check():
+    """The tiered-KV chaos bar (docs/KV_TIERING.md "Chaos bar"),
+    identical to ``python -m tools.loadgen --tier-chaos``: a corrupted
+    spill file on disk is rejected by checksum verification (counted,
+    never served), a replica killed mid-restage fails over with zero
+    lost requests, and every stream — greedy AND seeded — keeps exact
+    token parity with a fault-free tier-off single-engine run."""
+    out = tier_chaos_smoke(seed=0)
+    assert out["ok"] and all(out["checks"].values())
+    for mode, var in out["variants"].items():
+        assert var["verify_failures"] >= 1, mode
+        assert var["failovers"] == 1, mode
+        tc = var["tier_counters"]
+        assert tc["kv_tier_demotions"] >= 1, mode
+        assert tc["kv_tier_spills"] >= 1, mode
+        assert tc["kv_tier_revives_ram"] + tc["kv_tier_revives_nvme"] \
+            >= 1, mode
+    json.dumps(out)
 
 
 def test_fleet_chaos_observability_plane(fleet_chaos_out):

@@ -58,11 +58,12 @@ class TestAsyncIO:
             np.testing.assert_array_equal(b, o)
 
     def test_missing_file_reports_error(self, tmp_path):
-        from deepspeed_tpu.ops.aio import AsyncIOHandle
+        from deepspeed_tpu.ops.aio import AioError, AsyncIOHandle
 
         h = AsyncIOHandle()
         buf = np.empty(10, np.float32)
-        assert h.sync_pread(buf, str(tmp_path / "nope.bin")) > 0
+        with pytest.raises(AioError):
+            h.sync_pread(buf, str(tmp_path / "nope.bin"))
 
     def test_offsets(self, tmp_path):
         from deepspeed_tpu.ops.aio import AsyncIOHandle

@@ -684,3 +684,117 @@ def test_preempt_resume_prefix_cache_parity(model):
     rec = {x["uid"]: x for x in eng.request_metrics()["requests"]}
     assert rec[0]["cached_tokens"] > 0
     _check_pool_accounting(eng)
+
+
+def _check_tier_accounting(eng):
+    """The sharper partition with the KV tier in play
+    (docs/KV_TIERING.md): every pending-restage destination block is
+    referenced at refcount 1 but held by NO sequence, the restage
+    bookkeeping mirrors the queue exactly, and the tier counters obey
+    their consistency bounds (a revive never outruns a demotion, a
+    remote revive never outruns an imported record)."""
+    st = eng.state
+    al = st.allocator
+    held = Counter(b for seq in st.seqs.values() for b in seq.blocks)
+    pend = [ent.dst for ent in st.tier_pending_restage]
+    assert len(pend) == len(set(pend)), "restage dst handed out twice"
+    assert not set(pend) & set(held), "restage dst aliased by a live seq"
+    for b in pend:
+        assert al.refcount(b) == 1, \
+            f"restage dst {b}: refcount {al.refcount(b)} != 1"
+    al.assert_invariants()
+    assert al.referenced_blocks == len(held) + len(pend)
+    per_uid = Counter(ent.uid for ent in st.tier_pending_restage)
+    assert dict(per_uid) == st._restaging_uids, \
+        "restaging-uid ledger diverged from the restage queue"
+    tm = eng.timings
+    assert tm["kv_tier_revives_ram"] + tm["kv_tier_revives_nvme"] \
+        <= tm["kv_tier_demotions"]
+    assert tm["kv_tier_revives_remote"] <= tm["kv_tier_remote_blocks"]
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_tier_fuzz_invariants(model, seed):
+    """The prefix-cache fuzz extended across the tier boundary on a
+    PAIR of engines: identical-prompt admits, releases, eviction
+    pressure, scheduler rounds, the engine's own demote/restage drains,
+    and cross-replica record fetches (``export_tier_chain`` ->
+    ``load_snapshot(merge=True)``, the fleet path) interleave randomly
+    — and after every op the allocator partition still holds on both
+    engines, no block is double-freed or resurrected, a consumed tier
+    entry never revives twice, and flushing everything at the end
+    returns both pools to fully reclaimable."""
+    r = np.random.RandomState(500 + seed)
+
+    def mk():
+        return InferenceEngine(model, InferenceConfig(
+            token_budget=16, max_seqs=3, kv_block_size=8,
+            num_kv_blocks=8, max_seq_len=96, prefix_cache="on",
+            kv_tier="on", kv_tier_ram_mb=64.0))
+
+    engs = [mk(), mk()]
+    prefixes = [list(r.randint(1, 128, n)) for n in (16, 17, 24, 32)]
+    next_uid = 0
+    fetched = False
+    for _ in range(300):
+        eng = engs[r.randint(2)]
+        op = r.randint(6)
+        live = list(eng.state.seqs)
+        if op == 0:                          # identical-prompt admit
+            p = prefixes[r.randint(len(prefixes))]
+            tail = list(r.randint(1, 128, r.randint(0, 6)))
+            eng.put(next_uid, p + tail)
+            next_uid += 1
+        elif op == 1 and live:               # decode continuation
+            uid = live[r.randint(len(live))]
+            if not eng._pending.get(uid):
+                eng.put(uid, [int(r.randint(1, 128))])
+        elif op == 2 and live:               # release a random live seq
+            eng.flush(live[r.randint(len(live))])
+        elif op == 3:                        # unique prompt => eviction
+            eng.put(next_uid,                # pressure => demotions
+                    list(r.randint(1, 128, r.randint(1, 40))))
+            next_uid += 1
+        elif op == 4:                        # scheduler round
+            sched = eng._schedule()
+            _check_invariants(eng, sched)
+            if sched:
+                eng.state.build_batch(sched, eng.icfg.token_budget,
+                                      stager=eng._stager)
+        else:                                # cross-replica tier fetch
+            src, dst = engs if r.randint(2) else engs[::-1]
+            ds = list(src.state.tier.digests())
+            if ds:
+                payload = src.export_tier_chain(
+                    ds[:1 + r.randint(min(3, len(ds)))])
+                if payload is not None:
+                    dst.load_snapshot(payload, merge=True)
+                    fetched = True
+        # mid-flight check (restage dsts referenced but seq-less), then
+        # the engine's own idle-path drains, then the stock partition
+        _check_tier_accounting(eng)
+        for e in engs:
+            e._drain_tier_demote()
+            e._drain_cow()
+            e._drain_tier_restage(dispatching=False)
+            _check_tier_accounting(e)
+            _check_pool_accounting(e)
+    assert any(e.timings["kv_tier_demotions"] > 0 for e in engs), \
+        "fuzz never demoted a block into the tier"
+    assert any(e.timings["kv_tier_revives_ram"]
+               + e.timings["kv_tier_revives_remote"] > 0
+               for e in engs), "fuzz never revived a tiered block"
+    assert fetched, "fuzz never exercised the cross-replica fetch path"
+    for e in engs:
+        assert e.timings["kv_tier_verify_failures"] == 0
+        for uid in list(e.state.seqs):
+            e.flush(uid)
+        e._drain_tier_demote()
+        e._drain_cow()
+        e._drain_tier_restage(dispatching=False)
+        al = e.state.allocator
+        al.assert_invariants()
+        assert al.referenced_blocks == 0
+        assert al.free_blocks == al.total_blocks
+        assert e.state._restaging_uids == {}
+        assert e.state.tier_pending_restage == []

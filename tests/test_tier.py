@@ -1,0 +1,343 @@
+"""Tiered KV cache (docs/KV_TIERING.md): the host-RAM ring + NVMe
+spill tier itself (ring accounting, spill/evict, revive, the checksum
+verification contract, cross-replica export/import), the engine config
+gates, the fleet tier fetch end-to-end on tiny engines, and the
+exposition-format regression for the ``kv_tier_*`` series riding the
+fleet Prometheus view."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.ragged.state import (chain_hash,
+                                                  prefix_chain_digests)
+from deepspeed_tpu.inference.ragged.tier import (KVBlockTier,
+                                                 payload_checksum)
+
+_ROOT = b"\x00" * 16
+
+
+def _leaves(seed, nbytes=512):
+    r = np.random.RandomState(seed)
+    a = r.randn(nbytes // 8).astype(np.float32)
+    b = r.randn(nbytes // 8).astype(np.float32)
+    return [a, b]
+
+
+def _digest(tokens, parent=_ROOT):
+    return chain_hash(parent, list(tokens))
+
+
+def _put(tier, seed, parent=_ROOT):
+    tokens = [seed, seed + 1]
+    d = _digest(tokens, parent)
+    leaves = _leaves(seed)
+    ev = tier.put(parent, d, tokens, leaves)
+    return d, tokens, leaves, ev
+
+
+def _aio_available():
+    from deepspeed_tpu.ops.builder import AsyncIOBuilder
+    return AsyncIOBuilder().is_compatible()
+
+
+aio_required = pytest.mark.skipif(not _aio_available(),
+                                  reason="no g++ toolchain")
+
+
+class TestRamRing:
+    def test_put_contains_revive_roundtrip(self):
+        tier = KVBlockTier(ram_bytes=1 << 20)
+        d, tokens, leaves, ev = _put(tier, 1)
+        assert ev["stored"] == 1 and ev["spilled"] == 0
+        assert d in tier and tier.contains(d)
+        assert len(tier) == 1 and d in tier.digests()
+        op = tier.begin_revive(d)
+        assert op is not None and op.source == "ram"
+        out = tier.resolve(op)
+        for got, want in zip(out, leaves):
+            np.testing.assert_array_equal(got, want)
+        # revival CONSUMED the entry
+        assert d not in tier and len(tier) == 0
+
+    def test_duplicate_put_is_noop(self):
+        tier = KVBlockTier(ram_bytes=1 << 20)
+        d, tokens, leaves, _ = _put(tier, 2)
+        ev = tier.put(_ROOT, d, tokens, leaves)
+        assert ev["stored"] == 0 and len(tier) == 1
+
+    def test_ring_overflow_drops_oldest_without_nvme(self):
+        one = sum(a.nbytes for a in _leaves(0))
+        tier = KVBlockTier(ram_bytes=2 * one)
+        d0, *_ = _put(tier, 10)
+        d1, *_ = _put(tier, 20)
+        d2, _, _, ev = _put(tier, 30)
+        assert ev["dropped"] == 1
+        assert d0 not in tier and d1 in tier and d2 in tier
+        assert tier.stats()["ram_bytes"] <= 2 * one
+
+    def test_oversize_payload_dropped(self):
+        tier = KVBlockTier(ram_bytes=64)
+        _, _, _, ev = _put(tier, 3)
+        assert ev["dropped"] == 1 and ev["stored"] == 0
+        assert len(tier) == 0
+
+    def test_miss_returns_none(self):
+        tier = KVBlockTier(ram_bytes=1 << 20)
+        assert tier.begin_revive(b"\x01" * 16) is None
+
+
+class TestVerification:
+    def test_resolve_rejects_tampered_ram_payload(self):
+        tier = KVBlockTier(ram_bytes=1 << 20)
+        d, *_ = _put(tier, 4)
+        # tamper with the stored leaves behind the checksum's back
+        tier._ram[d].leaves[0][0] += 1.0
+        op = tier.begin_revive(d)
+        assert tier.resolve(op) is None
+        assert tier.stats()["spill_failures"] == 1
+
+    def test_verify_record_contract(self):
+        tokens = [5, 6]
+        d = _digest(tokens)
+        leaves = _leaves(5)
+        rec = {"digest": d, "parent": _ROOT, "tokens": tokens,
+               "leaves": leaves, "checksum": payload_checksum(leaves)}
+        assert KVBlockTier.verify_record(rec)
+        # wrong digest (forged chain) rejects
+        bad = dict(rec, digest=_digest([9, 9]))
+        assert not KVBlockTier.verify_record(bad)
+        # tampered payload rejects
+        bad = dict(rec, leaves=[leaves[0] + 1, leaves[1]])
+        assert not KVBlockTier.verify_record(bad)
+        # malformed record rejects instead of raising
+        assert not KVBlockTier.verify_record({})
+        assert not KVBlockTier.verify_record(dict(rec, tokens="xx"))
+
+    def test_export_insert_roundtrip(self):
+        src = KVBlockTier(ram_bytes=1 << 20)
+        dst = KVBlockTier(ram_bytes=1 << 20)
+        d, tokens, leaves, _ = _put(src, 7)
+        rec = src.export(d)
+        assert rec is not None and d in src          # non-destructive
+        assert KVBlockTier.verify_record(rec)
+        ev = dst.insert_record(rec)
+        assert ev["stored"] == 1
+        op = dst.begin_revive(d)
+        assert op.source == "remote"
+        out = dst.resolve(op)
+        for got, want in zip(out, leaves):
+            np.testing.assert_array_equal(got, want)
+
+    def test_export_miss_returns_none(self):
+        tier = KVBlockTier(ram_bytes=1 << 20)
+        assert tier.export(b"\x02" * 16) is None
+
+
+@aio_required
+class TestNvmeSpill:
+    def _tier(self, tmp_path, n_ram=1):
+        one = sum(a.nbytes for a in _leaves(0))
+        return KVBlockTier(ram_bytes=n_ram * one,
+                           nvme_dir=str(tmp_path / "spill"),
+                           nvme_bytes=1 << 20), one
+
+    def test_overflow_spills_to_disk_and_revives(self, tmp_path):
+        tier, _ = self._tier(tmp_path)
+        d0, t0, l0, _ = _put(tier, 40)
+        d1, _, _, ev = _put(tier, 50)
+        assert ev["spilled"] == 1 and ev["dropped"] == 0
+        tier._drain_io()
+        assert os.path.exists(os.path.join(str(tmp_path / "spill"),
+                                           d0.hex() + ".kv"))
+        st = tier.stats()
+        assert st["nvme_entries"] == 1 and st["ram_entries"] == 1
+        op = tier.begin_revive(d0)
+        assert op.source == "nvme"
+        out = tier.resolve(op)
+        for got, want in zip(out, l0):
+            np.testing.assert_array_equal(got, want)
+        # the consumed spill file is gone
+        assert not os.path.exists(os.path.join(str(tmp_path / "spill"),
+                                               d0.hex() + ".kv"))
+
+    def test_corrupted_spill_file_rejected(self, tmp_path):
+        tier, _ = self._tier(tmp_path)
+        d0, *_ = _put(tier, 60)
+        _put(tier, 70)                      # pushes d0 to NVMe
+        tier._drain_io()
+        path = tier._nvme[d0].path
+        with open(path, "r+b") as f:
+            f.seek(100)
+            f.write(b"\xff\xff")
+        op = tier.begin_revive(d0)
+        assert tier.resolve(op) is None
+        assert tier.stats()["spill_failures"] >= 1
+
+    def test_corrupted_spill_file_never_exports(self, tmp_path):
+        tier, _ = self._tier(tmp_path)
+        d0, *_ = _put(tier, 80)
+        _put(tier, 90)
+        tier._drain_io()
+        with open(tier._nvme[d0].path, "r+b") as f:
+            f.seek(50)
+            f.write(b"\x00\x00\x00")
+        assert tier.export(d0) is None
+        assert d0 not in tier               # entry dropped
+        assert tier.stats()["spill_failures"] >= 1
+
+    def test_missing_spill_file_is_a_miss_not_a_crash(self, tmp_path):
+        tier, _ = self._tier(tmp_path)
+        d0, *_ = _put(tier, 95)
+        _put(tier, 96)
+        tier._drain_io()
+        os.remove(tier._nvme[d0].path)
+        op = tier.begin_revive(d0)
+        assert op is not None and tier.resolve(op) is None
+
+    def test_nvme_budget_evicts_oldest_file(self, tmp_path):
+        one = sum(a.nbytes for a in _leaves(0))
+        tier = KVBlockTier(ram_bytes=one,
+                           nvme_dir=str(tmp_path / "spill"),
+                           nvme_bytes=2 * one)
+        ds = [_put(tier, 100 + 10 * i)[0] for i in range(5)]
+        tier._drain_io()
+        st = tier.stats()
+        assert st["nvme_bytes"] <= 2 * one
+        # oldest spilled digest fell off the bottom
+        assert ds[0] not in tier
+
+
+class TestEngineConfigGates:
+    def test_bad_kv_tier_value_rejected(self):
+        from tools.loadgen import build_engine
+        with pytest.raises(ValueError, match="kv_tier"):
+            build_engine(kv_tier="always")
+
+    def test_tier_requires_prefix_cache(self):
+        from tools.loadgen import build_engine
+        with pytest.raises(ValueError, match="prefix"):
+            build_engine(kv_tier="on", prefix_cache="off")
+
+    def test_auto_resolves_off_today(self):
+        from tools.loadgen import build_engine
+        eng, _ = build_engine(kv_tier="auto")
+        assert eng.state.tier is None
+
+
+@pytest.fixture(scope="module")
+def tier_fleet_out():
+    """One 2-replica fleet run shared by the fleet assertions below:
+    replica r0 serves a 4-block shared-prefix family, fillers churn its
+    pool until the chain demotes into its tier, then the family
+    returns on r1 (round-robin rotation) — the router must fetch the
+    chain r0 -> r1 so r1 restages instead of re-prefilling."""
+    from deepspeed_tpu.inference import SamplingParams
+    from deepspeed_tpu.serving import FleetConfig
+    from tools.loadgen import (Request, build_fleet, build_engine,
+                               replay, replay_fleet)
+
+    block = 8
+    r = np.random.RandomState(41)
+    fam = [int(x) for x in r.randint(1, 120, 4 * block)]
+    trace = [Request(uid=0, step=0, prompt=fam + [5, 6, 7], max_new=4)]
+    for i in range(6):
+        rf = np.random.RandomState(600 + i)
+        trace.append(Request(
+            uid=1 + i, step=14 * (i + 1),
+            prompt=[int(x) for x in rf.randint(1, 120, 44)], max_new=4))
+    # 7 arrivals rotate the round-robin cursor to r1 for the 8th
+    ret = Request(uid=100, step=14 * 8, prompt=fam + [5, 6, 9],
+                  max_new=4)
+    sp = SamplingParams(max_new_tokens=1 << 30)
+    router, model = build_fleet(
+        2, fleet_cfg=FleetConfig(placement="round_robin",
+                                 telemetry="on"),
+        num_kv_blocks=16, prefix_cache="on", kv_tier="on",
+        kv_tier_ram_mb=64.0)
+    res = replay_fleet(router, trace + [ret], sampling=sp,
+                       check_invariants=True)
+    ref_eng, _ = build_engine(model=model, prefix_cache="on")
+    ref = replay(ref_eng, trace + [ret], [], sampling=sp)
+    fam_digests = prefix_chain_digests(fam, block)
+    return {"router": router, "res": res, "ref": ref,
+            "fam_digests": fam_digests}
+
+
+class TestFleetTierFetch:
+    def test_chain_demoted_then_fetched_cross_replica(self, tier_fleet_out):
+        router = tier_fleet_out["router"]
+        res = tier_fleet_out["res"]
+        eng0 = router.replica("r0").engine
+        eng1 = router.replica("r1").engine
+        assert res["placements"][0] == "r0"
+        assert res["placements"][100] == "r1"
+        assert int(eng0.timings["kv_tier_demotions"]) >= 1
+        assert int(router._c_tier_fetches.value()) >= 1
+        assert int(router._c_tier_fetch_blocks.value()) >= 1
+        assert int(router._c_tier_fetch_rejects.value()) == 0
+        # r1 revived the fetched chain as REMOTE blocks, and the
+        # engine's own consistency bound holds
+        assert int(eng1.timings["kv_tier_revives_remote"]) >= 1
+        assert int(eng1.timings["kv_tier_revives_remote"]) <= \
+            int(eng1.timings["kv_tier_remote_blocks"])
+        assert int(eng1.timings["kv_tier_verify_failures"]) == 0
+        assert int(eng0.timings["kv_tier_verify_failures"]) == 0
+
+    def test_fetch_preserves_exact_parity(self, tier_fleet_out):
+        res, ref = tier_fleet_out["res"], tier_fleet_out["ref"]
+        for uid, toks in ref["tokens"].items():
+            assert res["tokens"].get(uid) == toks, uid
+        assert all(s == "finished" for s in res["status"].values())
+
+    def test_tiered_digests_advertised(self, tier_fleet_out):
+        """A replica's affinity key includes TIERED chains — the hex
+        set and the bytes membership view agree on them."""
+        router = tier_fleet_out["router"]
+        rep = router.replica("r0")
+        tier = rep.engine.state.tier
+        if len(tier) == 0:
+            pytest.skip("every tier entry was revived back out")
+        d = next(iter(tier.digests()))
+        assert d.hex() in rep.prefix_digests()
+        assert d in rep.digest_index()
+
+    def test_journey_carries_tier_fetch_span(self, tier_fleet_out):
+        """Satellite of docs/KV_TIERING.md: the fetch shows up on the
+        request's fleet journey AND as a journey-track instant in the
+        router trace (what ``tracemerge --fleet`` merges onto the
+        timeline)."""
+        router = tier_fleet_out["router"]
+        j = router.request_journey(100)
+        fetch = [e for e in j if e["event"] == "tier_fetch"]
+        assert fetch and fetch[0]["replica"] == "r1" \
+            and fetch[0]["src"] == "r0" and fetch[0]["blocks"] >= 1
+        names = {ev["name"] for ev in router._ftel.tracer.events()}
+        assert "tier_fetch" in names
+
+    def test_fleet_exposition_carries_kv_tier_series(self, tier_fleet_out):
+        """Exposition-format regression: the per-replica ``kv_tier_*``
+        counters ride the fleet Prometheus view under ``replica=``
+        labels, their ``serving_fleet_`` rollups sum them, and the
+        fleet's own tier-fetch counters are present — all in parseable
+        exposition format."""
+        from deepspeed_tpu.telemetry import parse_prometheus_text
+
+        router = tier_fleet_out["router"]
+        text = router.fleet_registry.prometheus_text()
+        parsed = parse_prometheus_text(text)
+        for name in ("serving_kv_tier_demotions_total",
+                     "serving_kv_tier_revives_remote_total"):
+            samples = parsed[name]["samples"]
+            replicas = {dict(k[1]).get("replica") for k in samples}
+            assert replicas == {"r0", "r1"}, name
+            roll = parsed["serving_fleet_" + name[len("serving_"):]]
+            assert int(sum(roll["samples"].values())) == \
+                int(sum(samples.values())), name
+        assert int(sum(parsed["serving_fleet_tier_fetches_total"]
+                       ["samples"].values())) >= 1
+        # the pull-gauges ride along too
+        assert "serving_kv_tier_ram_entries" in parsed
+        json.dumps({"n_series": len(parsed)})

@@ -23,6 +23,8 @@ CLI::
     python -m tools.loadgen --smoke              # tier-1 deterministic leg
     python -m tools.loadgen --chaos              # failure-domain leg
     python -m tools.loadgen --fleet-chaos        # replica-fleet chaos leg
+    python -m tools.loadgen --tier-chaos         # tiered-KV corruption leg
+    python -m tools.loadgen --tier-bench         # tiered-KV perf arms
     python -m tools.loadgen --fleet-bench        # 1-vs-3-replica sweep
     python -m tools.loadgen --http               # sockets parity leg
     python -m tools.loadgen --http-chaos         # disconnect + drain leg
@@ -793,6 +795,23 @@ def check_fleet_invariants(router) -> None:
     assert agg["statuses"] == reconciled, \
         f"fleet terminal statuses diverged: records " \
         f"{agg['statuses']} != reconciled counters {reconciled}"
+    # KV tier counter consistency (docs/KV_TIERING.md): a block can
+    # only be revived locally after it was demoted, and only revived
+    # as "remote" after a cross-replica fetch delivered it — a drift
+    # here means a revive resurrected a freed or never-demoted block
+    for name in router.replica_names:
+        rep = router.replica(name)
+        if rep.dead or rep.engine.state.tier is None:
+            continue
+        tm = rep.engine.timings
+        local_revives = int(tm["kv_tier_revives_ram"]) \
+            + int(tm["kv_tier_revives_nvme"])
+        assert local_revives <= int(tm["kv_tier_demotions"]), \
+            f"{name}: {local_revives} local tier revives exceed " \
+            f"{int(tm['kv_tier_demotions'])} demotions"
+        assert int(tm["kv_tier_revives_remote"]) <= \
+            int(tm["kv_tier_remote_blocks"]), \
+            f"{name}: remote revives exceed fetched blocks"
 
 
 def _busiest_routable(router) -> Optional[str]:
@@ -1209,6 +1228,188 @@ def fleet_chaos_smoke(seed: int = 0) -> Dict:
     return out
 
 
+def tier_chaos_smoke(seed: int = 0) -> Dict:
+    """The tiered-KV chaos bar (docs/KV_TIERING.md "Chaos bar"): a
+    2-replica fleet with the KV tier ON and a host ring tiny enough
+    that demoted chains overflow to NVMe spill files, driven through
+    three phases per sampler (greedy + seeded):
+
+    * **warm + churn** — a shared-prefix family prefills on r0, then
+      unique-prompt fillers churn its pool until the family chain
+      demotes into the tier and spills to disk;
+    * **corrupt one spill file** — a byte is flipped in a family-chain
+      spill file on disk; the re-arriving family request (affinity
+      places it on r0, which still advertises the tiered chain) must
+      REVIVE up to the corrupted block, reject it by checksum, and
+      fall back to re-prefill — finishing with exact token parity;
+    * **kill mid-restage** — after re-churning the chain back into the
+      tier, the next family request begins a restage and r0 is KILLED
+      on the following step; the failover must migrate the request to
+      r1 (whose tier never saw the chain) and finish it by re-prefill.
+
+    Asserts zero lost requests (every uid exactly one fleet-terminal
+    ``finished``), exact greedy+seeded parity for EVERY stream against
+    a fault-free single-engine tier-off reference, at least one
+    counted digest-verification failure (the corruption was detected,
+    never served), the demote→spill→revive flow actually exercised,
+    per-step fleet invariants (allocator partition + tier counter
+    consistency), and zero block leaks on the survivors."""
+    import os
+    import tempfile
+
+    import jax
+
+    from deepspeed_tpu.inference import FailureConfig, SamplingParams
+    from deepspeed_tpu.inference.ragged.state import prefix_chain_digests
+    from deepspeed_tpu.serving import FleetConfig
+
+    block = 8
+    r = np.random.RandomState(seed + 31)
+    fam = [int(x) for x in r.randint(1, 120, 4 * block)]   # 4 blocks
+    fam_digests = prefix_chain_digests(fam, block)
+
+    def spaced(reqs, uid0, gap=14, start=14):
+        """Arrivals far enough apart that each request finishes before
+        the next lands: every placement sees equal (zero) loads, so
+        the deterministic name tiebreak keeps the churn on r0."""
+        return [Request(uid=uid0 + i, step=start + i * gap, prompt=p,
+                        max_new=4) for i, (p) in enumerate(reqs)]
+
+    def fam_req(uid, step, tail_seed):
+        rt = np.random.RandomState(tail_seed)
+        return Request(uid=uid, step=step,
+                       prompt=fam + [int(x) for x in rt.randint(1, 120, 3)],
+                       max_new=4)
+
+    def fillers(n, seed0):
+        out = []
+        for i in range(n):
+            rf = np.random.RandomState(seed0 + i)
+            out.append([int(x) for x in rf.randint(1, 120, 44)])
+        return out
+
+    samplers = {
+        "greedy": (SamplingParams(max_new_tokens=1 << 30), None),
+        "seeded": (SamplingParams(temperature=0.8, top_k=40,
+                                  max_new_tokens=1 << 30),
+                   jax.random.PRNGKey(29)),
+    }
+    model_box: list = []
+    out: Dict = {"variants": {}}
+    checks: Dict[str, bool] = {}
+    for mode, (sp, rng) in samplers.items():
+        tier_root = tempfile.mkdtemp(prefix=f"tier_chaos_{mode}_")
+
+        def eng_factory(tag, tiered=True):
+            kw = {}
+            if tiered:
+                kw = dict(kv_tier="on", kv_tier_ram_mb=0.009,
+                          kv_tier_dir=os.path.join(tier_root, tag))
+            eng, m = build_engine(
+                None, model=model_box[0] if model_box else None,
+                prefix_cache="on",
+                failure=FailureConfig(dispatch_timeout_ms=None), **kw)
+            if not model_box:
+                model_box.append(m)
+            return eng
+
+        from deepspeed_tpu.serving import FleetRouter
+        router = FleetRouter(
+            {"r0": eng_factory("r0"), "r1": eng_factory("r1")},
+            FleetConfig(placement="affinity"))
+        ref = eng_factory("ref", tiered=False)
+        ref_tokens: Dict[int, List[int]] = {}
+        statuses: Dict[int, str] = {}
+
+        def phase(trace, faults=()):
+            res = replay_fleet(router, trace, list(faults), sampling=sp,
+                               rng=rng, check_invariants=True)
+            statuses.update(res["status"])
+            ref_tokens.update(
+                replay(ref, trace, [], sampling=sp, rng=rng)["tokens"])
+            return res
+
+        # ---- phase A: warm the family on r0, churn its pool --------
+        warm = [fam_req(0, 0, seed + 100)] \
+            + spaced(fillers(8, seed + 200), uid0=10)
+        res_a = phase(warm)
+        eng0 = router.replica("r0").engine
+        tier0 = eng0.state.tier
+        tier0._drain_io()           # pending spill writes land first
+        spilled = [h for h in fam_digests if h in tier0._nvme]
+        checks[f"{mode}_family_chain_spilled"] = bool(spilled)
+        # ---- corrupt ONE family spill file on disk ------------------
+        detected_before = int(eng0.timings["kv_tier_verify_failures"])
+        if spilled:
+            target = spilled[0]
+            path = tier0._nvme[target].path
+            with open(path, "r+b") as f:
+                f.seek(os.path.getsize(path) // 2)
+                b = f.read(1)
+                f.seek(-1, os.SEEK_CUR)
+                f.write(bytes([b[0] ^ 0xFF]))
+            # the corrupted block must be REACHABLE: every ancestor
+            # digest still resident or tiered on r0, or the revive run
+            # stops short and the flip is never read
+            k = fam_digests.index(target)
+            idx = router.replica("r0").digest_index()
+            checks[f"{mode}_corrupt_block_reachable"] = all(
+                h in idx for h in fam_digests[:k])
+        # ---- phase B: the family returns; revive must reject --------
+        res_b = phase([fam_req(200, 0, seed + 300)])
+        detected = int(eng0.timings["kv_tier_verify_failures"]) \
+            - detected_before
+        checks[f"{mode}_corruption_detected"] = detected >= 1
+        checks[f"{mode}_corruption_never_served"] = \
+            ref_tokens.get(200) == res_b["tokens"].get(200)
+        # ---- phase C: churn the chain back out, kill r0 mid-restage -
+        phase(spaced(fillers(6, seed + 400), uid0=220, start=0))
+        checks[f"{mode}_rechurn_tiered"] = len(tier0) > 0 \
+            and any(h in tier0 for h in fam_digests)
+        res_c = phase([fam_req(300, 0, seed + 500)],
+                      faults=[Fault("kill", step=1, replica="r0")])
+        h = router.health()
+        checks[f"{mode}_failover"] = h["failovers"] == 1
+        checks[f"{mode}_zero_lost"] = all(
+            s == "finished" for s in statuses.values())
+        checks[f"{mode}_parity"] = all(
+            ref_tokens.get(u) == toks for phase_res in
+            (res_a, res_b, res_c) for u, toks in
+            phase_res["tokens"].items())
+        tm0 = eng0.timings
+        checks[f"{mode}_demote_revive_flow"] = \
+            int(tm0["kv_tier_demotions"]) >= 1 \
+            and int(tm0["kv_tier_spills"]) >= 1 \
+            and (int(tm0["kv_tier_revives_ram"])
+                 + int(tm0["kv_tier_revives_nvme"])) >= 1
+        # survivors fully reclaim their pools
+        clean = True
+        for n in router.replica_names:
+            rep = router.replica(n)
+            if rep.dead:
+                continue
+            al = rep.engine.state.allocator
+            al.assert_invariants()
+            clean &= al.free_blocks == al.total_blocks
+        checks[f"{mode}_no_leak"] = clean
+        out["variants"][mode] = {
+            "steps": res_a["steps"] + res_b["steps"] + res_c["steps"],
+            "verify_failures": detected,
+            "tier_counters": {k: int(tm0[k]) for k in (
+                "kv_tier_demotions", "kv_tier_spills",
+                "kv_tier_revives_ram", "kv_tier_revives_nvme",
+                "kv_tier_revives_remote", "kv_tier_verify_failures")},
+            "failovers": h["failovers"],
+        }
+    out["checks"] = checks
+    out["ok"] = all(checks.values())
+    if not out["ok"]:
+        raise AssertionError(
+            "tier chaos smoke failed: "
+            f"{json.dumps({k: v for k, v in checks.items() if not v})}")
+    return out
+
+
 def _fleet_prefix_trace(seed: int, n_requests: int, n_families: int = 3,
                         prefix_blocks: int = 4, block: int = 8,
                         max_new: int = 4) -> List[Request]:
@@ -1350,6 +1551,186 @@ def fleet_bench(seed: int = 0, n_requests: int = 18) -> Dict:
             "kill_step": kill_step,
             "single": single, "affinity": affinity,
             "round_robin": rr}
+
+
+def tiered_kv_bench(seed: int = 0) -> Dict:
+    """BENCH leg for the tiered KV cache (docs/KV_TIERING.md): a
+    revisit-heavy shared-prefix workload whose prefix working set is
+    several times the KV pool, through three arms at identical shapes —
+    ``baseline``: discard-on-evict (``kv_tier`` off) on the tight pool,
+    the behavior the tier replaces; ``tiered``: the tier on the SAME
+    tight pool, so evicted chains demote to the host ring and revive on
+    revisit; ``allhbm``: a pool big enough that nothing ever evicts —
+    the ceiling the tiered arm's p95 TTFT is compared against
+    (``ttft_vs_allhbm``, the 1.25x acceptance bar).  Greedy outputs are
+    asserted token-identical across all three arms before anything is
+    recorded, and the tier counters must reconcile (revives never
+    outrun demotions, zero verify failures).
+
+    A fourth FLEET arm measures the remote-restage path ("The tier as
+    a fleet asset"): replica r0 serves a long chain, churn demotes it
+    into r0's tier, and the chain's return lands on r1 (round-robin
+    rotation) — once with the tier on (the router's cross-replica
+    fetch + r1 restaging) and once with it off (r1 re-prefills the
+    chain cold).  ``remote_restage_speedup`` is the re-prefill TTFT
+    over the restage TTFT: > 1 means fetching spilled KV beats
+    recomputing it."""
+    from deepspeed_tpu.inference import FailureConfig, SamplingParams
+    from deepspeed_tpu.serving import FleetConfig
+
+    sp = SamplingParams(max_new_tokens=1 << 30)
+    block, fam_blocks, tail_len = 8, 10, 8
+    n_fams, n_rounds = 6, 3
+
+    def mk_trace(seed_off: int, uid0: int) -> List[Request]:
+        """Families revisited round-robin: between any family's visits
+        the other five churn the pool, so on the tight pool every
+        revisit finds its chain evicted (baseline) or demoted (tiered).
+        ``seed_off`` varies the CONTENT at identical shapes/arrivals —
+        the warmup replays a shape-identical trace so every program
+        bucket (prefill chunks, restage upload, fetch path) compiles
+        outside the timed window."""
+        r = np.random.RandomState(seed + seed_off)
+        fams = [[int(x) for x in
+                 np.random.RandomState(700 + seed + seed_off + i)
+                 .randint(1, 120, fam_blocks * block)]
+                for i in range(n_fams)]
+        out = []
+        k = 0
+        for _ in range(n_rounds):
+            for i in range(n_fams):
+                tail = [int(x) for x in r.randint(1, 120, tail_len)]
+                out.append(Request(uid=uid0 + k, step=12 * k,
+                                   prompt=fams[i] + tail, max_new=4))
+                k += 1
+        return out
+
+    trace = mk_trace(0, 0)
+    warm_trace = mk_trace(77, 90_000)
+    model_box: list = []
+
+    def arm(name, **kw):
+        eng, m = build_engine(
+            None, model=model_box[0] if model_box else None,
+            max_seq_len=128, prefix_cache="on",
+            failure=FailureConfig(dispatch_timeout_ms=None), **kw)
+        if not model_box:
+            model_box.append(m)
+        # warm at the measured shapes, then reset so TTFT/hit-rate
+        # measure steady state (the residual warm chains are exactly
+        # the pool pressure the measured trace churns against, and
+        # they are identical across arms)
+        replay(eng, warm_trace, [], sampling=sp)
+        eng.reset_metrics()
+        t0 = time.perf_counter()
+        res = replay(eng, trace, [], sampling=sp)
+        wall = time.perf_counter() - t0
+        tm = eng.timings
+        out = {
+            "goodput_tok_s": round(
+                sum(len(t) for t in res["tokens"].values())
+                / max(wall, 1e-9), 2),
+            "hit_rate": round(int(tm["cached_tokens"])
+                              / max(int(tm["prompt_tokens"]), 1), 4),
+            **{k: v for k, v in summarize(eng, res, trace).items()
+               if k in ("ttft_ms_p95", "ttft_ms_p50", "ttft_steps_p95",
+                        "statuses", "preemptions")},
+            "tier_counters": {k: int(tm[k]) for k in tm
+                              if k.startswith("kv_tier_")},
+        }
+        return out, res["tokens"]
+
+    # pool 16 blocks = 128 tokens; the prefix working set is 6 families
+    # x 11+ blocks ≈ 66 blocks — >4x the pool
+    baseline, toks_base = arm("baseline", num_kv_blocks=16)
+    tiered, toks_tier = arm("tiered", num_kv_blocks=16, kv_tier="on")
+    allhbm, toks_hbm = arm("allhbm", num_kv_blocks=96)
+    assert toks_base == toks_tier == toks_hbm, \
+        "tiering changed greedy outputs"
+    tc = tiered["tier_counters"]
+    assert tc["kv_tier_demotions"] >= 1, "tight pool never demoted"
+    assert tc["kv_tier_revives_ram"] + tc["kv_tier_revives_nvme"] >= 1, \
+        "revisits never revived a tiered chain"
+    assert tc["kv_tier_revives_ram"] + tc["kv_tier_revives_nvme"] \
+        <= tc["kv_tier_demotions"]
+    assert tc["kv_tier_verify_failures"] == 0
+    assert tiered["hit_rate"] > baseline["hit_rate"], \
+        (tiered["hit_rate"], baseline["hit_rate"])
+
+    # ---- fleet arm: remote restage vs re-prefill ----------------------
+    def mk_ftrace(seed_off: int, uid0: int):
+        """r0 serves the family chain, six 44-token churners alternate
+        replicas (three land on r0 — enough to demote the chain), and
+        the family's return is the 8th arrival: the round-robin cursor
+        puts it on r1."""
+        fam = [int(x) for x in np.random.RandomState(700 + seed
+                                                     + seed_off)
+               .randint(1, 120, fam_blocks * block)]
+        out = [Request(uid=uid0, step=0, prompt=fam + [5, 6, 7],
+                       max_new=4)]
+        for i in range(6):
+            rf = np.random.RandomState(800 + seed_off + i)
+            out.append(Request(
+                uid=uid0 + 1 + i, step=12 * (1 + i),
+                prompt=[int(x) for x in rf.randint(1, 120, 44)],
+                max_new=4))
+        out.append(Request(uid=uid0 + 100, step=12 * 8,
+                           prompt=fam + [5, 6, 9], max_new=4))
+        return out
+
+    ftrace = mk_ftrace(0, 0)
+    fwarm = mk_ftrace(77, 90_000)
+
+    def fleet_arm(tier_on):
+        router, _ = build_fleet(
+            2, model=model_box[0],
+            fleet_cfg=FleetConfig(placement="round_robin",
+                                  telemetry="on"),
+            num_kv_blocks=16, max_seq_len=128, prefix_cache="on",
+            failure=FailureConfig(dispatch_timeout_ms=None),
+            **(dict(kv_tier="on") if tier_on else {}))
+        # warm at the measured shapes — including the warm trace's own
+        # demote -> fetch -> restage cycle, so the restage upload
+        # program and the fetch path compile outside the timed window
+        # (8 warm arrivals keep the round-robin parity even: the
+        # measured placements are unchanged)
+        replay_fleet(router, fwarm, sampling=sp)
+        for n in router.replica_names:
+            router.replica(n).engine.reset_metrics()
+        f0 = int(router._c_tier_fetches.value())
+        b0 = int(router._c_tier_fetch_blocks.value())
+        res = replay_fleet(router, ftrace, sampling=sp,
+                           check_invariants=True)
+        assert res["placements"][100] == "r1", res["placements"]
+        assert all(s == "finished" for s in res["status"].values())
+        eng1 = router.replica("r1").engine
+        return {
+            "return_ttft_ms": res["ttft_ms"][100],
+            "return_ttft_steps": res["ttft_steps"][100],
+            "remote_revives": int(eng1.timings["kv_tier_revives_remote"]),
+            "fetches": int(router._c_tier_fetches.value()) - f0,
+            "fetch_blocks":
+                int(router._c_tier_fetch_blocks.value()) - b0,
+        }, res["tokens"][100]
+
+    restage, ret_on = fleet_arm(tier_on=True)
+    reprefill, ret_off = fleet_arm(tier_on=False)
+    assert ret_on == ret_off, "remote restage changed greedy outputs"
+    assert restage["fetches"] >= 1 and restage["remote_revives"] >= 1, \
+        restage
+    assert reprefill["fetches"] == 0
+
+    return {
+        "seed": seed, "requests": len(trace),
+        "pool_blocks": 16, "working_set_blocks": n_fams * (fam_blocks + 1),
+        "baseline": baseline, "tiered": tiered, "allhbm": allhbm,
+        "ttft_vs_allhbm": round(
+            tiered["ttft_ms_p95"] / max(allhbm["ttft_ms_p95"], 1e-9), 4),
+        "fleet": {"restage": restage, "reprefill": reprefill},
+        "remote_restage_speedup": round(
+            reprefill["return_ttft_ms"]
+            / max(restage["return_ttft_ms"], 1e-9), 4),
+    }
 
 
 # --------------------------------------------------------------------------
@@ -1889,9 +2270,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="replica-fleet chaos leg: quarantine + live "
                     "migration + mid-traffic replica kill, parity vs a "
                     "fault-free single-engine run")
+    ap.add_argument("--tier-chaos", action="store_true",
+                    help="tiered-KV chaos leg: spill-file corruption "
+                         "rejected by checksum + replica killed "
+                         "mid-restage, zero lost, exact parity")
     ap.add_argument("--fleet-bench", action="store_true",
                     help="fleet bench sweep: 1 vs 3 replicas with a "
                     "mid-sweep kill, affinity vs round-robin")
+    ap.add_argument("--tier-bench", action="store_true",
+                    help="tiered-KV bench: pool << prefix working set, "
+                    "tier on/off/all-HBM arms + the fleet "
+                    "remote-restage-vs-re-prefill arm")
     ap.add_argument("--http", action="store_true",
                     help="sockets leg: the same seeded trace over real "
                     "loopback HTTP through a spawned gateway, token "
@@ -1914,10 +2303,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--out", default=None, metavar="OUT.json")
     args = ap.parse_args(argv)
 
-    if args.fleet_chaos:
+    if args.tier_chaos:
+        result = tier_chaos_smoke(args.seed)
+    elif args.fleet_chaos:
         result = fleet_chaos_smoke(args.seed)
     elif args.fleet_bench:
         result = fleet_bench(args.seed)
+    elif args.tier_bench:
+        result = tiered_kv_bench(args.seed)
     elif args.http:
         result = http_smoke(args.seed)
     elif args.http_chaos:
