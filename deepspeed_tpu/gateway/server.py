@@ -162,7 +162,7 @@ class Gateway:
         # ONE engine thread: every backend touch is serialized here
         self._exec = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="gateway-engine")
-        self._streams: Dict[int, _Stream] = {}
+        self._streams: Dict[int, _Stream] = {}  # tpulint: live-set
         self._uid_iter = itertools.count(1)
         self._journeys: Dict[int, List[Dict]] = {}
         # _journeys is written on the event loop but read from the
@@ -319,8 +319,10 @@ class Gateway:
         if not self._dead:
             try:
                 if self._is_fleet:
-                    # the router has no fleet-wide drain (replicas
-                    # outlive the gateway); leftover wire requests are
+                    # deliberately NOT router.drain(): that ends the
+                    # FLEET's serving life (every replica drains and
+                    # its breaker dies), but replicas outlive one
+                    # gateway's shutdown; leftover wire requests are
                     # shed here and stay re-placeable on the fleet
                     for s in leftovers:
                         await self._call(self.backend.cancel, s.uid)
@@ -518,7 +520,7 @@ class Gateway:
         else:
             fb.append((s.uid, tok))
 
-    def _close_stream(self, s: _Stream, reason: str) -> None:
+    def _close_stream(self, s: _Stream, reason: str) -> None:  # tpulint: close-out
         if s.finished:
             return
         s.finished = True

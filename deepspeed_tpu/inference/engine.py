@@ -420,7 +420,8 @@ class InferenceEngine:
                                "under a multi-device topology")
             else:
                 self._offload_kv()
-        self._pending: Dict[int, List[int]] = {}   # uid -> unprocessed toks
+        # tpulint: live-set — uid -> unprocessed toks
+        self._pending: Dict[int, List[int]] = {}
         self._ctx_exhausted: set = set()
         self._rng = jax.random.PRNGKey(0)
         self._cow_fn = None           # lazy jitted prefix-cache block copy
@@ -458,7 +459,8 @@ class InferenceEngine:
         self._inflight_sched: Dict[int, int] = {} # uid -> uncollected steps
         self._preempting: set = set()             # release() = preemption
         self._preempt_gen: Dict[int, List[int]] = {}  # pre-eviction tokens
-        self._closing: Dict[int, str] = {}   # uid -> staged terminal status
+        # tpulint: live-set — uid -> staged terminal status
+        self._closing: Dict[int, str] = {}
         self._reaped: set = set()   # engine-closed uids drivers must drop
         self._setup_telemetry()
         # --- failure-domain state (inference/failures.py) --------------
@@ -526,6 +528,7 @@ class InferenceEngine:
             # per-request records bump at the SAME statements, so
             # sum(per-request) reconciles with these by construction
             # (tests/test_spec_decode.py holds the invariant)
+            # tpulint: pair=spec_drafted_tokens/spec_accepted_tokens
             "spec_drafted_tokens": reg.counter(
                 "serving_spec_drafted_tokens_total",
                 "draft tokens scored by verify steps", int_valued=True),

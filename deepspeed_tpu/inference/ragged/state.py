@@ -291,6 +291,7 @@ class StateManager:
         self.prefix_cache = prefix_cache
         self.allocator = BlockedAllocator(cfg.num_blocks,
                                           on_evict=self._on_evict)
+        # tpulint: ledger=allocator — every live descriptor owns blocks
         self.seqs: Dict[int, SequenceDescriptor] = {}
         self._slots: Dict[int, int] = {}       # uid -> batch row
         self._free_slots = list(range(max_seqs))

@@ -382,11 +382,16 @@ class KVBlockTier:
 
     def _drop_nvme_entry(self, ent: _Entry) -> None:
         self._nvme_used -= ent.nbytes
-        if ent.iobuf is None:
-            try:
-                os.remove(ent.path)
-            except OSError:
-                pass
+        if ent.iobuf is not None:
+            # the spill write is still in flight: land it first, then
+            # unlink — dropping the index entry alone would leak the
+            # file on disk forever (the entry left self._nvme, so no
+            # later evict/drop pass can ever see it again)
+            self._drain_io()
+        try:
+            os.remove(ent.path)
+        except OSError:
+            pass  # already gone — the index entry is what matters
 
     @staticmethod
     def verify_record(rec: dict) -> bool:

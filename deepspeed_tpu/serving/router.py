@@ -983,6 +983,111 @@ class FleetRouter:
         self._pump_migrations()
         return snap
 
+    def drain(self, deadline_ms: Optional[float] = None,
+              sampling: SamplingParams = SamplingParams(),
+              rng=None) -> Dict:
+        """Fleet-wide graceful drain — the seam verb the single engine
+        already speaks, so a front-end (the gateway's SIGTERM path)
+        shuts either backend down through one code path.  Every live
+        replica runs its own ``engine.drain()`` (step-bounded, splits
+        any ``deadline_ms`` across replicas), records still waiting in
+        the migration queue close ``shed`` fleet-side (there is no
+        surviving replica to re-place onto — the whole fleet is going
+        away), and the merged final :meth:`snapshot` is the warm-
+        restart hand-off — every shed record rides along in
+        ``requests`` tagged ``replica: None``, exactly like the
+        engine-level drain keeps its shed records restorable.  Unlike
+        :meth:`scale_down` nothing is re-placed: a fleet drain ends
+        the fleet's serving life."""
+        t0 = time.perf_counter()
+        live = [rep for rep in self._reps.values() if not rep.dead]
+        shed: set = set()
+        completed: set = set()
+        shed_records: List[Dict] = []
+        for i, rep in enumerate(live):
+            per_rep = None
+            if deadline_ms is not None:
+                left = deadline_ms - (time.perf_counter() - t0) * 1e3
+                per_rep = max(0.0, left)
+            try:
+                part = rep.engine.drain(deadline_ms=per_rep,
+                                        sampling=sampling, rng=rng)
+            except EngineDeadError:
+                continue
+            # the engine's hand-off snapshot carries the shed records
+            # (taken before the close); keep them — the merged fleet
+            # snapshot below is built AFTER every breaker dies, so it
+            # cannot see them on its own
+            by_uid = {int(r["uid"]): r for r in part["requests"]}
+            for u in part.get("shed_uids", ()):
+                shed.add(int(u))
+                rec = by_uid.get(int(u))
+                if rec is not None:
+                    rec = dict(rec)
+                    rec["replica"] = None
+                    shed_records.append(rec)
+            completed.update(int(u)
+                             for u in part.get("completed_uids", ()))
+            rep.breaker.kill()
+            for uid in rep.engine._drain_reaped():
+                self._note_engine_close(rep, uid)
+        # queued migrations have no destination anymore: fleet-shed
+        while self._migrations:
+            m = self._migrations.pop()
+            self._close_queued(m, "shed")
+            # surfaces through drain_reaped() like every other fleet
+            # shed (cancel, retry exhaustion) — a driver still watching
+            # its active set must see the closure
+            self._reaped.add(int(m.rec["uid"]))
+            shed.add(int(m.rec["uid"]))
+            rec = dict(m.rec)
+            rec["replica"] = None
+            shed_records.append(rec)
+        snap = self.snapshot()
+        snap["requests"] = snap["requests"] + shed_records
+        snap["shed_uids"] = sorted(shed)
+        snap["completed_uids"] = sorted(
+            u for u in completed if u not in shed)
+        return snap
+
+    def snapshot(self) -> Dict:
+        """Fleet-merged host truth, schema-compatible with
+        ``engine.snapshot()`` (seam verb): every live replica's open
+        request records (tagged ``replica``), records in flight in the
+        migration queue (tagged ``replica: None``), summed engine
+        counters, and the union prefix-cache index.  Like the engine's,
+        it is valid with dead replicas in the fleet — their open work
+        is whatever failover already queued."""
+        from .. import __version__
+        reqs: List[Dict] = []
+        counters: Dict[str, int] = {}
+        prefix: set = set()
+        for name, rep in self._reps.items():
+            if rep.dead:
+                continue
+            part = rep.engine.snapshot()
+            for rec in part["requests"]:
+                rec = dict(rec)
+                rec["replica"] = name
+                reqs.append(rec)
+            for k, v in part["counters"].items():
+                counters[k] = counters.get(k, 0) + v
+            prefix.update(part["prefix_index"])
+        for m in self._migrations:
+            rec = dict(m.rec)
+            rec["replica"] = None
+            reqs.append(rec)
+        return {
+            "version": InferenceEngine.SNAPSHOT_VERSION,
+            "engine_version": __version__,
+            "health": self.health_state(),
+            "counters": counters,
+            "requests": reqs,
+            "prefix_index": sorted(prefix),
+            "replicas": sorted(name for name, rep in self._reps.items()
+                               if not rep.dead),
+        }
+
     # ------------------------------------------------------------------
     # observability
     # ------------------------------------------------------------------

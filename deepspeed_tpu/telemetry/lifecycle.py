@@ -179,7 +179,7 @@ class RequestTracker:
     def __init__(self, registry: MetricsRegistry,
                  max_finished: int = 4096):
         self.registry = registry
-        self.open: Dict[int, RequestRecord] = {}
+        self.open: Dict[int, RequestRecord] = {}  # tpulint: live-set
         self.finished: Deque[RequestRecord] = deque(maxlen=max_finished)
         self._h_ttft = registry.histogram(
             "serving_ttft_ms", TTFT_BUCKETS_MS,
@@ -193,6 +193,7 @@ class RequestTracker:
         self._c_arrived = registry.counter(
             "serving_requests_total", "requests ever opened",
             int_valued=True)
+        # tpulint: pair=_c_finished/_c_terminal
         self._c_finished = registry.counter(
             "serving_requests_finished_total",
             "requests closed with any terminal status", int_valued=True)

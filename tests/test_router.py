@@ -482,3 +482,109 @@ class TestFailoverMigration:
         # the exposition round-trips like every engine registry
         text = router.metrics.prometheus_text()
         assert "serving_fleet_placements_total" in text
+
+
+# --------------------------------------------------------------------------
+# drain / snapshot — the engine-shaped seam verbs
+# --------------------------------------------------------------------------
+
+class TestDrainSnapshotSeam:
+    """Regression for the tpulint seam-conformance finding (docs/
+    TPULINT.md bug table): ``FleetRouter`` sat behind the gateway's
+    engine-shaped seam without ``drain``/``snapshot``, so a fleet-
+    backed gateway SIGTERM had no warm-restart hand-off and ``isinstance``
+    -free callers crashed on the missing verbs."""
+
+    def _fleet_with_parked_record(self, model):
+        """Two replicas; uid 0 decoding on r0, uid 1 open on r1; then
+        r1 quarantined and r0 killed so uid 0's failover record parks
+        in the migration queue with no routable destination."""
+        router = FleetRouter(
+            {"r0": make_engine(model), "r1": make_engine(model)},
+            FleetConfig(probe_interval_steps=1000))
+        router.put(0, [1, 2, 3, 4])
+        outs = router.step()
+        router.put(0, [outs[0]])             # keep it decoding
+        router.put(1, [5, 6, 7])
+        b = router.replica("r1").breaker
+        b.record_failure(1)
+        b.record_failure(2)
+        router.replica("r0").engine.failures.inject("fatal")
+        router.step()
+        assert router.query(0)["status"] == "migrating"
+        return router
+
+    def test_snapshot_merges_replicas_and_tags_migration_queue(self, model):
+        router = FleetRouter(
+            {"r0": make_engine(model), "r1": make_engine(model)})
+        router.put(0, [1, 2, 3, 4])
+        outs = router.step()
+        router.put(0, [outs[0]])
+        router.put(1, [9, 8, 7])
+        snap = router.snapshot()
+        # schema-compatible with engine.snapshot(): same version tag,
+        # same top-level keys, PLUS the fleet-only replica facts
+        assert snap["version"] == InferenceEngine.SNAPSHOT_VERSION
+        assert snap["replicas"] == ["r0", "r1"]
+        assert snap["health"] == "healthy"
+        by_uid = {int(r["uid"]): r for r in snap["requests"]}
+        assert set(by_uid) == {0, 1}
+        assert {by_uid[0]["replica"], by_uid[1]["replica"]} <= \
+            {"r0", "r1"}
+        # counters are the per-replica engine sums
+        parts = [router.replica(n).engine.snapshot()["counters"]
+                 for n in ("r0", "r1")]
+        for k, v in snap["counters"].items():
+            assert v == sum(p.get(k, 0) for p in parts)
+
+    def test_snapshot_valid_with_dead_replica_and_queued_record(self, model):
+        router = self._fleet_with_parked_record(model)
+        snap = router.snapshot()
+        assert snap["replicas"] == ["r1"]        # r0 died
+        assert snap["health"] == "degraded"      # survivor quarantined
+        rec = next(r for r in snap["requests"] if int(r["uid"]) == 0)
+        assert rec["replica"] is None            # queued, owned by no one
+
+    def test_drain_sheds_queued_records_and_keeps_them_restorable(
+            self, model):
+        router = self._fleet_with_parked_record(model)
+        snap = router.drain(deadline_ms=10_000.0)
+        # uid 0's queued record had no surviving destination and uid
+        # 1's decode had no driver left to feed it: both close shed,
+        # but their records RIDE ALONG in the hand-off snapshot (the
+        # fleet snapshot alone cannot see them — every breaker is dead
+        # by the time it is taken)
+        assert snap["shed_uids"] == [0, 1]
+        assert snap["completed_uids"] == []
+        assert router.query(0)["status"] == "shed"
+        assert router.query(1)["status"] == "shed"
+        recs = {int(r["uid"]): r for r in snap["requests"]}
+        assert set(recs) == {0, 1}
+        assert recs[0]["replica"] is None
+        assert recs[1]["replica"] is None
+        assert snap["replicas"] == []            # every breaker killed
+        assert snap["health"] == "dead"
+        # BOTH closures surface through drain_reaped — the queue shed
+        # used to bypass the reaped set and wedge a watching driver
+        assert {0, 1} <= router.drain_reaped()
+        # drain ends the fleet's serving life
+        assert router.health_state() == "dead"
+
+    def test_drain_outcome_split_completed_is_not_replayable(self, model):
+        """A request that reaches its OWN terminal during the drain's
+        steps (here: an expired deadline the drain reaps) reports
+        ``completed``, never ``shed`` — restoring the hand-off must
+        not double-run already-settled work."""
+        router = FleetRouter({"r0": make_engine(model)})
+        router.put(0, [1, 2, 3, 4])
+        router.put(1, [5, 6, 7], deadline_ms=0.0)
+        snap = router.drain(deadline_ms=10_000.0)
+        assert snap["shed_uids"] == [0]
+        assert snap["completed_uids"] == [1]
+        assert router.query(0)["status"] == "shed"
+        assert router.query(1)["status"] == "deadline_exceeded"
+        # only the replayable record is in the hand-off
+        recs = {int(r["uid"]): r for r in snap["requests"]}
+        assert set(recs) == {0}
+        assert {0, 1} <= router.drain_reaped()
+

@@ -498,7 +498,16 @@ class TestEngineTelemetry:
                            "prefix_hits", "generated_tokens",
                            "spec_drafted_tokens", "spec_accepted_tokens",
                            "spec_rejected_tokens", "spec_windows",
-                           "step_retries", "requests_failed"}
+                           "step_retries", "requests_failed",
+                           "kv_tier_demotions", "kv_tier_spills",
+                           "kv_tier_drops", "kv_tier_revives_ram",
+                           "kv_tier_revives_nvme",
+                           "kv_tier_revives_remote",
+                           "kv_tier_restage_overlap_hits",
+                           "kv_tier_verify_failures",
+                           "kv_tier_demoted_bytes",
+                           "kv_tier_spilled_bytes",
+                           "kv_tier_remote_blocks"}
         assert tm["steps"] > 0 and isinstance(tm["steps"], int)
         assert dict(tm)["steps"] == tm["steps"]
         # the registry sees the same number

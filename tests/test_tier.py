@@ -197,6 +197,28 @@ class TestNvmeSpill:
         op = tier.begin_revive(d0)
         assert op is not None and tier.resolve(op) is None
 
+    def test_drop_with_write_in_flight_leaves_no_spill_file(self, tmp_path):
+        """Regression (pass-4 acquire-release audit): ``_drop`` on an
+        entry whose spill write was still queued used to skip the
+        unlink entirely — the entry left the NVMe index (so no later
+        evict/drop pass could ever see it again) while the async write
+        landed the file on disk forever.  The drop must land the
+        in-flight write first, then unlink, like ``_evict_nvme``
+        always did."""
+        tier, _ = self._tier(tmp_path)
+        d0, *_ = _put(tier, 300)
+        _put(tier, 310)                     # pushes d0's write to NVMe
+        ent = tier._nvme[d0]
+        assert ent.iobuf is not None        # the write is still queued
+        tier._drop(d0)
+        assert d0 not in tier
+        # the drop itself landed the write and unlinked — nothing left
+        # pending, and a later drain must not resurrect the file
+        assert not tier._io_pending
+        tier._drain_io()
+        assert not os.path.exists(ent.path), \
+            "spill file leaked: dropped while its write was in flight"
+
     def test_nvme_budget_evicts_oldest_file(self, tmp_path):
         one = sum(a.nbytes for a in _leaves(0))
         tier = KVBlockTier(ram_bytes=one,

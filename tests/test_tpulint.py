@@ -118,17 +118,22 @@ def test_fleet_metric_label_fixtures():
 
 def test_whole_tree_is_clean_fast_and_jax_free():
     """The enforced gate, every invariant in ONE whole-tree run (the
-    three-pass analyzer costs ~9-11 s — running it once keeps the gate
+    four-pass analyzer costs ~10 s — running it once keeps the gate
     itself inside the suite's time budget):
 
-    * the pass-3 concurrency families are registered and armed;
-    * deepspeed_tpu + tests carry zero findings (all 22 rules,
-      concurrency included);
-    * the run stays under 15 s wall — measured ~9 s (per-file rules
-      ~4 s + program passes ~5 s); the assert leaves headroom without
+    * the pass-3 concurrency families AND the pass-4 contract families
+      are registered and armed;
+    * deepspeed_tpu + tests carry zero findings (all 27 rules,
+      concurrency and contracts included);
+    * the run stays under 15 s wall — measured ~10 s (per-file rules
+      ~4 s + program passes ~6 s); the assert leaves headroom without
       letting the analyzer quietly become a multi-minute tax;
     * the analyzer never imports JAX (pure ast), checked in a fresh
       interpreter where nothing else has imported it.
+
+    (tools/lint_gate.sh runs the same analyzer over deepspeed_tpu +
+    tests + tools as the CI entry point; the tools/ files are linted by
+    their own fixture-free pass and stay out of this timed run.)
     """
     code = (
         "import sys, time; t0 = time.perf_counter()\n"
@@ -136,6 +141,10 @@ def test_whole_tree_is_clean_fast_and_jax_free():
         "conc = {'shared-state-race', 'lock-order-cycle',\n"
         "        'await-under-lock', 'seam-freeze'}\n"
         "assert conc <= set(RULES), 'concurrency pass not armed'\n"
+        "contracts = {'seam-conformance', 'terminal-exhaustive',\n"
+        "             'acquire-release', 'counter-pairing',\n"
+        "             'raise-escape'}\n"
+        "assert contracts <= set(RULES), 'contract pass not armed'\n"
         "fs = lint_paths(['deepspeed_tpu', 'tests'])\n"
         "dt = time.perf_counter() - t0\n"
         "assert 'jax' not in sys.modules, 'tpulint imported JAX'\n"
@@ -222,6 +231,106 @@ def test_concurrency_rule_families_present():
     edges, not one file's AST)."""
     assert {"shared-state-race", "lock-order-cycle",
             "await-under-lock", "seam-freeze"} <= set(PROGRAM_RULES)
+
+
+def test_contract_rule_families_present():
+    """The five pass-4 contract families exist, are program-scoped
+    (seam conformance and raise-escape walk the cross-file call graph)
+    and library-only (contracts bind the runtime, not the tests)."""
+    contracts = {"seam-conformance", "terminal-exhaustive",
+                 "acquire-release", "counter-pairing", "raise-escape"}
+    assert contracts <= set(PROGRAM_RULES)
+    assert all(RULES[n].library_only for n in contracts)
+
+
+def test_fixture_corpus_is_complete_and_isolated():
+    """Corpus meta-test: every registered rule has its bad_/good_ pair,
+    every bad fixture in the directory fires EXACTLY ONE rule, and —
+    for the per-rule pairs — that rule is the one named by the file
+    stem.  A fixture that trips a second rule is cross-contamination:
+    the per-rule tests would then prove nothing about isolation."""
+    stems = {p.stem for p in FIXTURES.glob("*.py")}
+    for rule in ALL_RULES:
+        base = rule.replace("-", "_")
+        assert f"bad_{base}" in stems, f"no bad fixture for {rule}"
+        assert f"good_{base}" in stems, f"no good fixture for {rule}"
+    registered = {r.replace("-", "_"): r for r in ALL_RULES}
+    for bad in sorted(FIXTURES.glob("bad_*.py")):
+        findings = _lint(bad)
+        fired = {f.rule for f in findings}
+        assert len(fired) == 1, \
+            f"{bad.name} fires {sorted(fired) or 'nothing'} " \
+            f"(want exactly one rule)"
+        stem = bad.stem[len("bad_"):]
+        if stem in registered:
+            assert fired == {registered[stem]}, \
+                f"{bad.name} fires {fired}, not its own rule"
+        # scenario fixtures (bad_rng_draft_window, ...) are pinned to
+        # their rule by their dedicated tests; singleton-fired is the
+        # corpus-wide invariant
+        assert (FIXTURES / f"good_{stem}.py").exists(), \
+            f"{bad.name} has no good_ twin"
+
+
+# --------------------------------------------------------------------------
+# pass 4 contracts: mutation tests — deleting the pairing half of a real
+# contract in the REAL tree must produce exactly the expected finding
+# --------------------------------------------------------------------------
+
+def _mutate_and_lint(tmp_path, src_rel, needle, rule):
+    """Copy one real module, assert the rule is quiet on the pristine
+    copy, replace the single line containing ``needle`` with ``pass``
+    (deleting the call while keeping the file parseable), and return
+    the findings the rule produces on the mutant."""
+    src = (REPO / src_rel).read_text()
+    lines = src.splitlines(keepends=True)
+    hits = [i for i, ln in enumerate(lines) if needle in ln]
+    assert len(hits) == 1, \
+        f"expected exactly one '{needle}' line in {src_rel}, " \
+        f"got {len(hits)} — update the mutation test"
+    clean = tmp_path / "clean.py"
+    clean.write_text(src)
+    assert lint_paths([str(clean)], rules=[rule]) == [], \
+        f"{rule} not quiet on pristine {src_rel}"
+    ln = lines[hits[0]]
+    indent = ln[:len(ln) - len(ln.lstrip())]
+    lines[hits[0]] = indent + "pass\n"
+    mutant = tmp_path / "mutant.py"
+    mutant.write_text("".join(lines))
+    return lint_paths([str(mutant)], rules=[rule])
+
+
+def test_mutation_deleted_close_out_is_caught(tmp_path):
+    """Delete the terminal ``on_finish`` from the engine's ``_forget``
+    teardown: ``_forget`` falls out of the close-out family, so its pop
+    of the ``self._pending`` live set becomes a uid vanishing without a
+    terminal status — terminal-exhaustive must see the severed pairing.
+    This is the PR-13/PR-15 leak shape: a request dropped from live
+    tracking with no lifecycle close."""
+    findings = _mutate_and_lint(
+        tmp_path, "deepspeed_tpu/inference/engine.py",
+        "self.requests.on_finish(uid, status=status)",
+        "terminal-exhaustive")
+    assert len(findings) == 1, [f.human() for f in findings]
+    f = findings[0]
+    assert "_pending" in f.message and "_forget" in f.message
+    assert f.end_line is not None      # points back at the live-set decl
+
+
+def test_mutation_deleted_allocator_free_is_caught(tmp_path):
+    """Delete the allocator release from ``RaggedState.release``: the
+    descriptor leaves the ``ledger=allocator``-marked ``self.seqs``
+    with its blocks never freed — acquire-release must flag the
+    removal site (the PR-17 revive over-commit shape: blocks leaking
+    on a lifecycle path)."""
+    findings = _mutate_and_lint(
+        tmp_path, "deepspeed_tpu/inference/ragged/state.py",
+        "self.allocator.free(list(reversed(seq.blocks)))",
+        "acquire-release")
+    assert len(findings) == 1, [f.human() for f in findings]
+    f = findings[0]
+    assert "seqs" in f.message and "allocator" in f.message
+    assert f.end_line is not None      # points back at the ledger decl
 
 
 # --------------------------------------------------------------------------
@@ -468,6 +577,47 @@ def test_changed_mode_in_git_repo(tmp_path, monkeypatch):
     subprocess.run(git + ["add", "."], cwd=tmp_path, check=True)
     subprocess.run(git + ["commit", "-qm", "x"], cwd=tmp_path, check=True)
     assert cli(["--changed", "mod.py"]) == 0            # clean tree: green
+
+
+def test_changed_mode_sees_both_sides_of_a_rename(tmp_path, monkeypatch):
+    """The rename blind spot: ``git status --porcelain`` renders a
+    rename as ``R  old -> new`` and the old parser kept only the new
+    side — a finding anchored at the OLD path (baseline entries,
+    cross-file endpoints) silently left the changed set.  The ``-z``
+    record parser must surface BOTH paths, plus ordinary adds and
+    untracked files around the rename record."""
+    from tools.tpulint.__main__ import git_dirty_files
+    git = ["git", "-c", "user.email=t@t", "-c", "user.name=t"]
+    subprocess.run(["git", "init", "-q"], cwd=tmp_path, check=True)
+    (tmp_path / "orig.py").write_text("def go():\n    return 1\n")
+    (tmp_path / "keep.py").write_text("def keep():\n    return 2\n")
+    subprocess.run(git + ["add", "."], cwd=tmp_path, check=True)
+    subprocess.run(git + ["commit", "-qm", "x"], cwd=tmp_path, check=True)
+    subprocess.run(git + ["mv", "orig.py", "moved.py"],
+                   cwd=tmp_path, check=True)
+    (tmp_path / "fresh.py").write_text("def fresh():\n    return 3\n")
+    monkeypatch.chdir(tmp_path)
+    dirty = git_dirty_files()
+    names = {Path(p).name for p in dirty}
+    assert {"orig.py", "moved.py", "fresh.py"} <= names, names
+    assert "keep.py" not in names                       # clean file stays out
+
+
+def test_lint_gate_script_shape():
+    """tools/lint_gate.sh is the CI entry point: it must cover all
+    three roots (library, tests, tools — the timed in-suite gate only
+    runs the first two), emit SARIF, and honor a baseline snapshot
+    when one exists.  Content-checked, not executed: running the
+    four-pass analyzer a second time would double the suite's lint
+    cost for no added coverage."""
+    gate = REPO / "tools" / "lint_gate.sh"
+    assert gate.exists()
+    assert gate.stat().st_mode & 0o111, "lint_gate.sh not executable"
+    src = gate.read_text()
+    assert "deepspeed_tpu tests tools" in src
+    assert "--format sarif" in src
+    assert "tpulint_baseline.json" in src
+    assert '"$@"' in src               # passthrough for --changed etc.
 
 
 def test_cli_exit_codes():

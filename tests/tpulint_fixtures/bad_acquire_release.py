@@ -1,0 +1,55 @@
+"""Known-bad: acquire/release pairing violations (tpulint:
+acquire-release).
+
+Every acquisition below leaks on some path: a ledger entry removed
+without releasing what it owns, allocator results dropped or bound and
+forgotten, a bare fd, a worker thread nobody joins, a profiler capture
+armed but never finished, and a revive op that is never resolved.
+"""
+import threading
+
+
+class StateTable:
+    def __init__(self, allocator):
+        self.allocator = allocator
+        # tpulint: ledger=allocator — every live descriptor owns blocks
+        self.seqs = {}
+
+    def admit(self, uid, seq):
+        self.seqs[uid] = seq
+
+    def evict(self, uid):
+        return self.seqs.pop(uid)        # BAD: entry's blocks never given back
+
+    def grow(self):
+        self.allocator.allocate(4)       # BAD: result dropped, blocks unreleasable
+
+    def reserve(self):
+        blocks = self.allocator.allocate(4)  # BAD: bound but never used again
+        return None
+
+    def revive(self, tier, uid):
+        tier.begin_revive(uid)           # BAD: revive op dropped, never resolved
+
+
+class TraceDump:
+    def dump(self, data):
+        f = open("/tmp/trace.bin", "wb")  # BAD: fd neither closed nor stored
+        f.write(data)
+
+
+class Watchdog:
+    def start(self):
+        self._t = threading.Thread(target=self._loop)  # BAD: no daemon, no join
+        self._t.start()
+
+    def _loop(self):
+        return None
+
+
+class CaptureOwner:
+    def __init__(self, cap):
+        self._cap = cap
+
+    def begin(self):
+        self._cap.arm(steps=3)           # BAD: armed capture never finished

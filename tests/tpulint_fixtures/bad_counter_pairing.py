@@ -1,0 +1,27 @@
+"""Known-bad: one-sided bumps of declared counter pairs (tpulint:
+counter-pairing).
+
+The pair declarations say these counters move together — that is what
+keeps sum(per-request) == engine-counter invariants true.  Both
+functions below bump exactly one side.
+"""
+
+
+class _Counter:
+    def inc(self, **labels):
+        return None
+
+
+class Metrics:
+    # tpulint: pair=_c_finished/_c_terminal
+    # tpulint: pair=drafted/accepted
+    def __init__(self):
+        self._c_finished = _Counter()
+        self._c_terminal = _Counter()
+        self.tm = {"drafted": 0, "accepted": 0}
+
+    def note_finish(self):
+        self._c_finished.inc()           # BAD: pair _c_terminal never bumps here
+
+    def note_draft(self, n):
+        self.tm["drafted"] += n          # BAD: pair 'accepted' never bumps here

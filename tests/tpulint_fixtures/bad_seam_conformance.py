@@ -1,0 +1,98 @@
+"""Known-bad: engine-seam contract violations (tpulint:
+seam-conformance).
+
+``InferenceEngine`` below is the in-file reference (full verb set).
+``QuotaFront`` is engine-shaped (6/8 verbs) but dropped ``drain`` and
+``snapshot``; ``DriftFront`` has every verb but drifted two signatures;
+``ThinFront`` (2 verbs, NOT engine-shaped) is caught only because it
+flows into the ``Gateway(...)`` backend position.
+"""
+
+
+class InferenceEngine:
+    """The reference seam: the verb set every backend must speak."""
+
+    def put(self, uid, tokens):
+        return uid
+
+    def step(self, sampling=None):
+        return {}
+
+    def flush(self):
+        return None
+
+    def cancel(self, uid):
+        return uid
+
+    def query(self, uid):
+        return None
+
+    def drain(self, deadline_ms=None):
+        return {}
+
+    def snapshot(self):
+        return {}
+
+    def health_state(self):
+        return "healthy"
+
+
+class QuotaFront:                        # BAD: engine-shaped, missing drain + snapshot
+    def put(self, uid, tokens):
+        return uid
+
+    def step(self, sampling=None):
+        return {}
+
+    def flush(self):
+        return None
+
+    def cancel(self, uid):
+        return uid
+
+    def query(self, uid):
+        return None
+
+    def health_state(self):
+        return "healthy"
+
+
+class DriftFront:
+    def put(self, uid, tokens, priority):  # BAD: extra required arg vs reference
+        return uid
+
+    def step(self, sampling=None):
+        return {}
+
+    def flush(self):
+        return None
+
+    def cancel(self):                    # BAD: cannot accept the uid seam callers pass
+        return None
+
+    def query(self, uid):
+        return None
+
+    def drain(self, deadline_ms=None):
+        return {}
+
+    def snapshot(self):
+        return {}
+
+    def health_state(self):
+        return "healthy"
+
+
+class ThinFront:
+    """Two verbs only — below the engine-shaped threshold, so only the
+    position-flow check can see it."""
+
+    def put(self, uid, tokens):
+        return uid
+
+    def query(self, uid):
+        return None
+
+
+def build_front():
+    return Gateway(ThinFront())          # BAD: 2/8-verb class in the backend seat  # noqa: F821

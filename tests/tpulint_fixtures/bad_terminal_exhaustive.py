@@ -1,0 +1,40 @@
+"""Known-bad: terminal-status discipline violations (tpulint:
+terminal-exhaustive).
+
+``reap`` drops a uid from the declared live set without any close-out;
+``shed`` closes with a literal that is not in TERMINAL_STATUSES; and
+the declared ``"zombie"`` status is never emitted by anything — a dead
+contract surface.
+"""
+
+TERMINAL_STATUSES = (
+    "finished",
+    "cancelled",
+    "zombie",                            # BAD: declared but never emitted
+)
+
+
+class Tracker:
+    def __init__(self):
+        # tpulint: live-set — uid -> prompt tokens
+        self.open = {}
+
+    def put(self, uid, tokens):
+        self.open[uid] = tokens
+
+    def on_finish(self, uid, status):
+        self.open.pop(uid, None)
+        return status
+
+    def close(self, uid):
+        self.on_finish(uid, "finished")
+
+    def cancel(self, uid):
+        self.on_finish(uid, "cancelled")
+
+    def reap(self, stale):
+        for uid in stale:
+            self.open.pop(uid, None)     # BAD: uid vanishes, no terminal status
+
+    def shed(self, uid):
+        self.on_finish(uid, "evicted")   # BAD: 'evicted' not in TERMINAL_STATUSES

@@ -1,0 +1,87 @@
+"""Known-good twin of bad_acquire_release: every acquisition is
+released in-function, parked on a ledger/attribute (ownership
+transfer), handed to the caller, or covered by the declared release
+receiver.
+"""
+import threading
+
+
+class StateTable:
+    def __init__(self, allocator):
+        self.allocator = allocator
+        # tpulint: ledger=allocator — every live descriptor owns blocks
+        self.seqs = {}
+
+    def admit(self, uid, seq):
+        self.seqs[uid] = seq
+
+    def evict(self, uid):
+        seq = self.seqs.pop(uid)
+        self.allocator.free(seq.blocks)
+        return seq
+
+    def grow(self):
+        blocks = self.allocator.allocate(4)
+        self.allocator.free(blocks)
+
+    def reserve(self):
+        # ownership transfer: the blocks land on the ledger attribute
+        self.spare = self.allocator.allocate(4)
+
+    def lease(self):
+        # handed to the caller — the caller owns the release
+        return self.allocator.allocate(4)
+
+    def revive(self, tier, uid):
+        op = tier.begin_revive(uid)
+        op.resolve()
+
+
+class TraceDump:
+    def __init__(self):
+        self._sink = None
+
+    def dump(self, data):
+        with open("/tmp/trace.bin", "wb") as f:
+            f.write(data)
+
+    def attach(self):
+        # stored on an attribute: close() owns the descriptor now
+        self._sink = open("/tmp/trace.bin", "ab")
+
+    def close(self):
+        if self._sink is not None:
+            self._sink.close()
+            self._sink = None
+
+
+class Watchdog:
+    def start(self):
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        return None
+
+
+class Poller:
+    def start(self):
+        self._t = threading.Thread(target=self._loop)
+        self._t.start()
+
+    def _loop(self):
+        return None
+
+    def stop(self):
+        self._t.join()
+
+
+class CaptureOwner:
+    def __init__(self, cap):
+        self._cap = cap
+
+    def begin(self):
+        self._cap.arm(steps=3)
+
+    def end(self):
+        self._cap.finish_now()

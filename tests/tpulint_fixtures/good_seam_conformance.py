@@ -1,0 +1,100 @@
+"""Known-good twin of bad_seam_conformance: every class flowing into a
+seam position (or simply engine-shaped) speaks the full verb set with
+reference-compatible arities — extra OPTIONAL parameters and varargs
+are fine, only required-arity drift is a violation.
+"""
+
+
+class InferenceEngine:
+    def put(self, uid, tokens):
+        return uid
+
+    def step(self, sampling=None):
+        return {}
+
+    def flush(self):
+        return None
+
+    def cancel(self, uid):
+        return uid
+
+    def query(self, uid):
+        return None
+
+    def drain(self, deadline_ms=None):
+        return {}
+
+    def snapshot(self):
+        return {}
+
+    def health_state(self):
+        return "healthy"
+
+
+class ConformingFront:
+    """Full verb set; optional extras do not drift the seam."""
+
+    def put(self, uid, tokens, priority=0):
+        return uid
+
+    def step(self, sampling=None, rng=None):
+        return {}
+
+    def flush(self):
+        return None
+
+    def cancel(self, uid):
+        return uid
+
+    def query(self, uid):
+        return None
+
+    def drain(self, deadline_ms=None):
+        return {}
+
+    def snapshot(self):
+        return {}
+
+    def health_state(self):
+        return "healthy"
+
+
+class VarargFront:
+    """A forwarding proxy: *args absorbs whatever the seam passes."""
+
+    def put(self, *args, **kwargs):
+        return None
+
+    def step(self, *args, **kwargs):
+        return {}
+
+    def flush(self, *args, **kwargs):
+        return None
+
+    def cancel(self, *args, **kwargs):
+        return None
+
+    def query(self, *args, **kwargs):
+        return None
+
+    def drain(self, *args, **kwargs):
+        return {}
+
+    def snapshot(self, *args, **kwargs):
+        return {}
+
+    def health_state(self, *args, **kwargs):
+        return "healthy"
+
+
+def make_engine():
+    return ConformingFront()
+
+
+def build_front():
+    return Gateway(ConformingFront())    # full verb set in the backend seat  # noqa: F821
+
+
+def build_fleet(serve):
+    # factory seam: the zero-state constructor returns a conforming class
+    return serve(engine_factory=make_engine)

@@ -1,0 +1,40 @@
+"""Known-good twin of bad_terminal_exhaustive: every removal from a
+live set reaches a close-out root (directly, via the call graph, or as
+a transfer into another live set), every close-out literal is a
+declared terminal status, and every declared status is emitted.
+"""
+
+TERMINAL_STATUSES = ("finished", "cancelled", "shed")
+
+
+class Tracker:
+    def __init__(self):
+        # tpulint: live-set — uid -> prompt tokens
+        self.open = {}
+        # tpulint: live-set — uid -> tokens parked for migration
+        self.parked = {}
+
+    def put(self, uid, tokens):
+        self.open[uid] = tokens
+
+    def _close(self, uid, status):       # tpulint: close-out
+        self.open.pop(uid, None)
+        return status
+
+    def on_finish(self, uid):
+        self._close(uid, "finished")
+
+    def cancel(self, uid):
+        self._close(uid, "cancelled")
+
+    def reap(self, stale):
+        # removal is fine here: this function reaches a close-out root
+        for uid in stale:
+            self._close(uid, "shed")
+
+    def park(self, uid):
+        # transfer, not a leak: the uid moves to another live set
+        self.parked[uid] = self.open.pop(uid)
+
+    def unpark(self, uid):
+        self.open[uid] = self.parked.pop(uid)

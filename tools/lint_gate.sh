@@ -1,0 +1,22 @@
+#!/bin/sh
+# tpulint CI gate — the ONE entry point CI calls.
+#
+# Runs all four analyzer passes (per-file rules, whole-program
+# dataflow, concurrency, contracts) over the library, the tests and
+# the tools themselves, emitting SARIF for CI annotators.  When a
+# baseline snapshot exists (tools/tpulint_baseline.json, written with
+# --write-baseline) it is subtracted so only NEW findings fail the
+# gate.  Extra flags pass through: e.g.  tools/lint_gate.sh --changed
+#
+# Exit code: 0 clean (or fully baselined), 1 on new findings —
+# documented in docs/TPULINT.md.
+set -eu
+cd "$(dirname "$0")/.."
+
+BASELINE="tools/tpulint_baseline.json"
+if [ -f "$BASELINE" ]; then
+    exec python -m tools.tpulint deepspeed_tpu tests tools \
+        --format sarif --baseline "$BASELINE" "$@"
+fi
+exec python -m tools.tpulint deepspeed_tpu tests tools \
+    --format sarif "$@"

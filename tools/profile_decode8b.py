@@ -15,6 +15,8 @@ constants.
 Run on the real chip:  python tools/profile_decode8b.py
 Artifacts: /tmp/decode8b_trace (xplane), /tmp/decode8b_hlo_stats.tsv
 """
+# tpulint: disable-file=print — profiling CLI: the fusion table and
+# step accounting ARE the tool's stdout deliverable
 
 import glob
 import json
@@ -141,7 +143,8 @@ def main():
     data, _ = rtd.xspace_to_tool_data([paths[-1]], "hlo_stats", {})
     if isinstance(data, bytes):
         data = data.decode()
-    open("/tmp/decode8b_hlo_stats.tsv", "w").write(data)
+    with open("/tmp/decode8b_hlo_stats.tsv", "w") as out:
+        out.write(data)
     # the tool emits json-ish rows; print the top self-time entries
     import csv
     import io

@@ -12,6 +12,7 @@ from .core import (Finding, RULES, collect_files, find_mesh_axes,
 from . import rules as _rules  # noqa: F401  (register the builtin rules)
 from . import dataflow as _dataflow  # noqa: F401  (whole-program rules)
 from . import concurrency as _concurrency  # noqa: F401  (pass 3)
+from . import contracts as _contracts  # noqa: F401  (pass 4)
 
 __all__ = ["Finding", "RULES", "collect_files", "find_mesh_axes",
            "lint_file", "lint_paths", "rule"]

@@ -13,6 +13,9 @@ Gate-scaling modes for a growing tree:
   whole-program pass still analyzes every file — cross-file context is
   never truncated — only the report is filtered.
 """
+# tpulint: disable-file=print — this IS the CLI: findings, SARIF and
+# baselines go to stdout by contract; utils.logging would wrap the
+# machine-readable output CI parses
 
 from __future__ import annotations
 
@@ -56,27 +59,45 @@ def apply_baseline(findings: List[Finding],
 
 def git_dirty_files(repo_cwd: str = ".") -> Optional[Set[str]]:
     """Absolute paths of modified/added/untracked .py files, or None
-    when git is unavailable (callers fall back to a full run)."""
+    when git is unavailable (callers fall back to a full run).
+
+    ``-z`` (NUL-terminated records) instead of line splitting: a rename
+    record carries TWO paths (new, then original) and the textual
+    ``old -> new`` form is ambiguous for paths containing the arrow or
+    quotes.  Both sides of a rename count as dirty — findings anchored
+    at the OLD path (baselines, cross-file endpoints) must not silently
+    drop out of the changed set just because the file moved."""
     try:
         # --untracked-files=all: a brand-new package must list its .py
         # files, not collapse to one "?? dir/" entry
         r = subprocess.run(
-            ["git", "status", "--porcelain", "--untracked-files=all"],
+            ["git", "status", "--porcelain=v1", "-z",
+             "--untracked-files=all"],
             cwd=repo_cwd, capture_output=True, text=True, timeout=30)
     except (OSError, subprocess.TimeoutExpired):
         return None
     if r.returncode != 0:
         return None
     out: Set[str] = set()
-    for line in r.stdout.splitlines():
-        if len(line) < 4:
-            continue
-        path = line[3:].strip()
-        if " -> " in path:                     # rename: take the new side
-            path = path.split(" -> ", 1)[1]
-        path = path.strip('"')
+
+    def add(path: str) -> None:
         if path.endswith(".py"):
             out.add(str((Path(repo_cwd) / path).resolve()))
+
+    fields = r.stdout.split("\0")
+    i = 0
+    while i < len(fields):
+        entry = fields[i]
+        i += 1
+        if len(entry) < 4:
+            continue
+        status, path = entry[:2], entry[3:]
+        add(path)
+        # rename/copy records are followed by the ORIGINAL path as its
+        # own NUL-separated field (no status prefix)
+        if ("R" in status or "C" in status) and i < len(fields):
+            add(fields[i])
+            i += 1
     return out
 
 
@@ -135,9 +156,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="tpulint",
         description="JAX/TPU-aware static analysis (pure AST, no "
-                    "imports of the target modules; three passes: "
-                    "per-file rules, whole-program dataflow, and "
-                    "whole-program concurrency)")
+                    "imports of the target modules; four passes: "
+                    "per-file rules, whole-program dataflow, "
+                    "concurrency, and contract conformance)")
     ap.add_argument("paths", nargs="*", default=["deepspeed_tpu", "tests"],
                     help="files or directories to lint "
                          "(default: deepspeed_tpu tests)")

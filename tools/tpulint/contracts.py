@@ -1,0 +1,1022 @@
+"""tpulint pass 4 — contract conformance & resource lifecycle.
+
+The serving tiers lean on a handful of hand-enforced runtime contracts:
+the engine-shaped seam (``put/step/flush/cancel/query/drain/snapshot/
+health_state``), the "every exit path reaches exactly one terminal
+status in ``TERMINAL_STATUSES``" discipline, refcounted acquire/release
+across allocator -> tier -> restage, paired counter bumps ("sum of
+per-request == engine counter by construction"), and "every device
+touch routes through the classifier seam".  This pass makes each of
+them a lint-time failure instead of a chaos-smoke finding.
+
+Five program-scope families ride the PR-3 module table / call graph:
+
+* ``seam-conformance``   — any class flowing into a backend/engine
+  position must implement the full seam verb set with arities
+  compatible with the reference (``InferenceEngine``).
+* ``terminal-exhaustive`` — removals from declared live-tracking
+  structures must close the request out; every close-out status
+  literal must be a member of ``lifecycle.TERMINAL_STATUSES`` (read
+  from source at lint time), and every declared status must be
+  emitted somewhere.
+* ``acquire-release``    — allocator blocks, tier revive ops, profiler
+  captures, worker threads and file descriptors must be released,
+  finished, joined or transferred to a recognized ledger on every
+  acquiring path.
+* ``counter-pairing``    — counters declared as a pair must bump in
+  the same statement region (same function), never one-sided.
+* ``raise-escape``       — interprocedural upgrade of the syntactic
+  ``serving-except``: a call chain reachable from a serving-loop-marked
+  method that can raise a device-ish exception with no catching
+  handler anywhere between is a finding.
+
+Declaration markers (comments, like ``serving-loop``; grammar in
+docs/TPULINT.md):
+
+* ``# tpulint: live-set``        on a ``self.attr = ...`` init line —
+  the attr is a uid-keyed live tracking structure.
+* ``# tpulint: close-out``       on a ``def`` header — the function is
+  a terminal close-out root (``on_finish`` is implicit).
+* ``# tpulint: ledger=<hint>``   on a ``self.attr = ...`` init line —
+  removal from the attr must be paired with a release call on a
+  receiver containing ``<hint>`` in the same function.
+* ``# tpulint: pair=<a>/<b>``    anywhere — counters ``a`` and ``b``
+  must always bump together.
+
+Everything here is best-effort static analysis over the shared
+``graph.Program``: unresolvable receivers are skipped, never guessed,
+so every finding is actionable.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import Finding, rule
+from .graph import FunctionInfo, ModuleInfo, Program
+from .rules import _BROAD, _exc_names, _serving_marked_lines, dotted
+
+# --------------------------------------------------------------------------
+# declaration markers
+# --------------------------------------------------------------------------
+
+_MARK_RE = re.compile(
+    r"#\s*tpulint:\s*"
+    r"(?:(?P<live>live-set)\b"
+    r"|(?P<close>close-out)\b"
+    r"|ledger=(?P<ledger>[A-Za-z_][A-Za-z0-9_]*)"
+    r"|pair=(?P<pa>[A-Za-z0-9_]+)/(?P<pb>[A-Za-z0-9_]+))")
+
+# the engine-shaped seam (docs/SERVING.md, docs/GATEWAY.md): every
+# backend reachable through the gateway / fleet / loadgen seam speaks
+# exactly these verbs
+_SEAM_VERBS = ("put", "step", "flush", "cancel", "query",
+               "drain", "snapshot", "health_state")
+# a class defining at least this many verbs is engine-shaped and owes
+# the full set
+_MIN_VERBS = 6
+_REFERENCE_CLASS = "InferenceEngine"
+
+# construction sites that place a value into the engine/backend seam
+# position: callee last-segment -> (keyword name, positional index)
+_SEAM_POSITIONS = {"Gateway": ("backend", 0),
+                   "spawn_gateway": ("backend", 0),
+                   "ReplicaHandle": ("engine", 1)}
+_FACTORY_KWARG = "engine_factory"
+
+# classifier-seam inputs (inference/failures.py): exceptions a device
+# dispatch can surface.  EngineDeadError is a post-classification
+# verdict and deliberately escapes, so it is NOT in this set.
+_DEVICE_EXC = {"DispatchTimeoutError", "InjectedTimeout", "InjectedFault"}
+# receivers whose ``.run(...)`` is the watchdog dispatch seam — a
+# virtual DispatchTimeoutError source even when unresolvable
+_SEAM_RUN_RECV = {"failures", "watchdog"}
+_CATCHING = _BROAD | {"RuntimeError"} | _DEVICE_EXC
+
+# value-carrying acquisitions: method name -> releasing method names
+_ACQ_RELEASE = {"allocate": {"free", "release"},
+                "begin_revive": {"resolve", "abort_revive"}}
+# class-level paired surfaces: (attr names that acquire, receiver hint,
+# attr names that release, what leaked)
+_CLASS_PAIRS = (
+    ({"arm"}, ("cap", "profil"), {"finish_now", "end_step",
+                                  "finish_capture"},
+     "profiler capture armed"),
+    ({"async_pwrite", "async_pread"}, ("aio",), {"wait"},
+     "aio operation issued"),
+)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` -> ``X`` (else None)."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _shallow_walk(fn_node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that does not descend into nested function/class
+    defs — their bodies belong to their own scope."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _stmt_of(parents: Dict[int, ast.AST], node: ast.AST) -> ast.AST:
+    """The enclosing statement of an expression node."""
+    cur = node
+    while not isinstance(cur, ast.stmt):
+        parent = parents.get(id(cur))
+        if parent is None:
+            break
+        cur = parent
+    return cur
+
+
+def _in_withitem(parents: Dict[int, ast.AST], node: ast.AST) -> bool:
+    cur = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        if isinstance(cur, ast.withitem):
+            return True
+        cur = parents.get(id(cur))
+    return False
+
+
+def _caught_locally(parents: Dict[int, ast.AST], node: ast.AST) -> bool:
+    """True when ``node`` sits in the body of a try whose handlers
+    catch device-ish exceptions (broad, RuntimeError, or a named
+    device exception) within the same function."""
+    prev, cur = node, parents.get(id(node))
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef, ast.Module)):
+            return False
+        if isinstance(cur, ast.Try) and prev in cur.body:
+            for h in cur.handlers:
+                names = _exc_names(h.type)
+                if h.type is None or any(
+                        n.split(".")[-1] in _CATCHING for n in names):
+                    return True
+        prev, cur = cur, parents.get(id(cur))
+    return False
+
+
+# --------------------------------------------------------------------------
+# shared analysis (built once per Program, cached like pass 3 does)
+# --------------------------------------------------------------------------
+
+class _Analysis:
+    def __init__(self, program: Program) -> None:
+        self.program = program
+        # (mod_name, cls_name) -> {attr}
+        self.live_sets: Dict[Tuple[str, str], Set[str]] = {}
+        # (mod_name, cls_name, attr) -> (path, line)
+        self.live_decl: Dict[Tuple[str, str, str], Tuple[str, int]] = {}
+        # (mod_name, cls_name, attr) -> (hint, path, line)
+        self.ledgers: Dict[Tuple[str, str, str],
+                           Tuple[str, str, int]] = {}
+        self.pairs: List[Tuple[str, str, str, int]] = []
+        self.closeout_quals: Set[str] = set()
+        self.closeout_names: Set[str] = {"on_finish"}
+        # statuses and where each literal lives
+        self.terminal: Dict[str, Tuple[str, int]] = {}
+        self.terminal_site: Optional[Tuple[str, int]] = None
+        # functions per module path (top-level defs and methods)
+        self.by_module: Dict[str, List[FunctionInfo]] = {}
+        for fi in program.functions.values():
+            self.by_module.setdefault(fi.module.path, []).append(fi)
+        self._collect_markers()
+        self._collect_terminal()
+        self.family = self._build_family()
+        self._serving: Optional[List[FunctionInfo]] = None
+        self._escape_cache: Dict[
+            str, List[Tuple[str, str, int]]] = {}
+
+    # -- markers ----------------------------------------------------------
+
+    def _collect_markers(self) -> None:
+        for mod in self.program.modules.values():
+            if mod.ctx.is_test:
+                continue
+            live_lines: Set[int] = set()
+            close_lines: Set[int] = set()
+            ledger_lines: Dict[int, str] = {}
+            for line, text in mod.ctx.comments:
+                m = _MARK_RE.search(text)
+                if not m:
+                    continue
+                if m.group("live"):
+                    live_lines.add(line)
+                elif m.group("close"):
+                    close_lines.add(line)
+                elif m.group("ledger"):
+                    ledger_lines[line] = m.group("ledger")
+                else:
+                    self.pairs.append((m.group("pa"), m.group("pb"),
+                                       mod.path, line))
+            if live_lines or ledger_lines:
+                self._bind_attr_marks(mod, live_lines, ledger_lines)
+            if close_lines:
+                for fi in self.by_module.get(mod.path, ()):
+                    header = range(fi.node.lineno,
+                                   fi.node.body[0].lineno + 1)
+                    if close_lines & set(header):
+                        self.closeout_quals.add(fi.qual)
+                        self.closeout_names.add(fi.name)
+
+    def _bind_attr_marks(self, mod: ModuleInfo, live_lines: Set[int],
+                         ledger_lines: Dict[int, str]) -> None:
+        # a marker binds to the ``self.X = ...`` on its own line
+        # (trailing comment) or — when the marker is a standalone
+        # comment line — to the assignment directly below it
+        by_line: Dict[int, ast.AST] = {}
+        for node in ast.walk(mod.ctx.tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                by_line.setdefault(node.lineno, node)
+
+        def target_of(line: int) -> Optional[ast.AST]:
+            node = by_line.get(line)
+            if node is None and line + 1 in by_line \
+                    and line not in by_line:
+                node = by_line[line + 1]
+            return node
+
+        marks = [(ln, None) for ln in live_lines] \
+            + [(ln, hint) for ln, hint in ledger_lines.items()]
+        for line, hint in marks:
+            node = target_of(line)
+            if node is None:
+                continue
+            owner = self.program.owner_of(mod, node)
+            if owner is None or owner.class_name is None:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                key = (mod.name, owner.class_name)
+                if hint is None:
+                    self.live_sets.setdefault(key, set()).add(attr)
+                    self.live_decl[key + (attr,)] = (mod.path,
+                                                     node.lineno)
+                else:
+                    self.ledgers[key + (attr,)] = (hint, mod.path,
+                                                   node.lineno)
+
+    # -- terminal statuses ------------------------------------------------
+
+    def _collect_terminal(self) -> None:
+        best = None
+        for mod in self.program.modules.values():
+            if mod.ctx.is_test:
+                continue
+            for stmt in mod.ctx.tree.body:
+                if not (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and stmt.targets[0].id == "TERMINAL_STATUSES"
+                        and isinstance(stmt.value, (ast.Tuple, ast.List))):
+                    continue
+                elems = {}
+                for e in stmt.value.elts:
+                    if isinstance(e, ast.Constant) \
+                            and isinstance(e.value, str):
+                        elems[e.value] = (mod.path, e.lineno)
+                if not elems:
+                    continue
+                cand = (elems, (mod.path, stmt.lineno))
+                if best is None or "lifecycle" in mod.name:
+                    best = cand
+        if best is not None:
+            self.terminal, self.terminal_site = best
+
+    # -- close-out family -------------------------------------------------
+
+    def _build_family(self) -> Set[str]:
+        program = self.program
+        family = set(self.closeout_quals)
+        for qual, fi in program.functions.items():
+            if fi.name in self.closeout_names:
+                family.add(qual)
+                continue
+            # name-seeded: a call to a close-out NAME joins the family
+            # even when the receiver is unresolvable (self.requests.
+            # on_finish)
+            for node in _shallow_walk(fi.node):
+                if isinstance(node, ast.Call):
+                    d = dotted(node.func) or ""
+                    if d.split(".")[-1] in self.closeout_names:
+                        family.add(qual)
+                        break
+        changed = True
+        while changed:
+            changed = False
+            for qual, callees in program.calls.items():
+                if qual not in family and callees & family:
+                    family.add(qual)
+                    changed = True
+        return family
+
+    # -- serving-marked functions -----------------------------------------
+
+    @property
+    def serving(self) -> List[FunctionInfo]:
+        if self._serving is None:
+            out = []
+            for mod in self.program.modules.values():
+                if mod.ctx.is_test:
+                    continue
+                marked = _serving_marked_lines(mod.ctx)
+                if not marked:
+                    continue
+                for fi in self.by_module.get(mod.path, ()):
+                    header = range(fi.node.lineno,
+                                   fi.node.body[0].lineno + 1)
+                    if marked & set(header):
+                        out.append(fi)
+            self._serving = out
+        return self._serving
+
+    # -- interprocedural device-raise escape ------------------------------
+
+    def escapes(self, qual: str) -> List[Tuple[str, str, int]]:
+        """Device-ish exceptions that can escape ``qual`` uncaught:
+        [(exc name, raise path, raise line)], memoized, cycle-safe."""
+        cached = self._escape_cache.get(qual)
+        if cached is not None:
+            return cached
+        self._escape_cache[qual] = []          # cycle guard
+        program = self.program
+        fi = program.functions.get(qual)
+        if fi is None:
+            return []
+        mod = fi.module
+        parents = program.parents(mod)
+        out: List[Tuple[str, str, int]] = []
+        for node in _shallow_walk(fi.node):
+            if isinstance(node, ast.Raise) and node.exc is not None:
+                exc = node.exc
+                d = dotted(exc.func) if isinstance(exc, ast.Call) \
+                    else dotted(exc)
+                last = (d or "").split(".")[-1]
+                if last in _DEVICE_EXC \
+                        and not _caught_locally(parents, node):
+                    out.append((last, mod.path, node.lineno))
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "run":
+                recv = dotted(node.func.value) or ""
+                if set(recv.split(".")) & _SEAM_RUN_RECV \
+                        and not _caught_locally(parents, node):
+                    out.append(("DispatchTimeoutError (via the "
+                                f"'{recv}.run' dispatch seam)",
+                                mod.path, node.lineno))
+        for call, callee in program.call_sites.get(qual, ()):
+            if _caught_locally(parents, call):
+                continue
+            for site in self.escapes(callee.qual)[:4]:
+                out.append(site)
+        seen: Set[Tuple[str, str, int]] = set()
+        uniq = []
+        for s in out:
+            if s not in seen:
+                seen.add(s)
+                uniq.append(s)
+        uniq = uniq[:8]
+        self._escape_cache[qual] = uniq
+        return uniq
+
+
+def _analysis(program: Program) -> _Analysis:
+    a = getattr(program, "_tpulint_contracts", None)
+    if a is None or a.program is not program:
+        a = _Analysis(program)
+        program._tpulint_contracts = a
+    return a
+
+
+def _library_modules(program: Program) -> List[ModuleInfo]:
+    return [m for m in program.modules.values() if not m.ctx.is_test]
+
+
+# --------------------------------------------------------------------------
+# rule: seam-conformance
+# --------------------------------------------------------------------------
+
+def _required(fi: FunctionInfo) -> List[str]:
+    names, defaults = fi.params()
+    return [n for n in names if n not in defaults]
+
+
+def _engine_shaped(program: Program):
+    """[(ClassInfo, verbs present)] over library modules."""
+    out = []
+    for mod in _library_modules(program):
+        for cls in mod.classes.values():
+            verbs = [v for v in _SEAM_VERBS if v in cls.methods]
+            if len(verbs) >= _MIN_VERBS:
+                out.append((cls, verbs))
+    return out
+
+
+def _expr_class(program: Program, mod: ModuleInfo,
+                owner: Optional[FunctionInfo], expr: ast.AST):
+    """Best-effort ClassInfo for a value flowing into a seam
+    position: a direct ``Cls(...)`` construction or a local var
+    constructed from a CamelCase class."""
+    if isinstance(expr, ast.Call):
+        d = dotted(expr.func)
+        if d and d.split(".")[-1][:1].isupper():
+            return program.resolve_class(mod, d.split(".")[-1])
+        return None
+    if isinstance(expr, ast.Name) and owner is not None:
+        cn = owner.constructed_class(expr.id)
+        if cn:
+            return program.resolve_class(mod, cn)
+    return None
+
+
+def _factory_returns(program: Program,
+                     factory: FunctionInfo):
+    """The class a zero-state factory constructs in its return."""
+    for node in _shallow_walk(factory.node):
+        if isinstance(node, ast.Return) \
+                and isinstance(node.value, ast.Call):
+            d = dotted(node.value.func)
+            if d and d.split(".")[-1][:1].isupper():
+                return program.resolve_class(factory.module,
+                                             d.split(".")[-1])
+    return None
+
+
+@rule("seam-conformance",
+      "a class in an engine/backend seam position (Gateway, "
+      "ReplicaHandle, engine_factory, or simply engine-shaped) must "
+      "implement the full put/step/flush/cancel/query/drain/snapshot/"
+      "health_state verb set with arities compatible with the "
+      "reference InferenceEngine — signature drift breaks every "
+      "caller written against the seam",
+      library_only=True, scope="program")
+def check_seam_conformance(program: Program) -> Iterator[Finding]:
+    shaped = _engine_shaped(program)
+    ref = None
+    for cls, verbs in shaped:
+        if cls.name == _REFERENCE_CLASS:
+            ref = cls
+            break
+    if ref is None and shaped:
+        ref = max(shaped, key=lambda cv: (len(cv[1]), cv[0].name))[0]
+    for cls, verbs in shaped:
+        if ref is None or cls is ref:
+            continue
+        missing = [v for v in _SEAM_VERBS
+                   if v not in verbs and v in ref.methods]
+        for v in missing:
+            rm = ref.methods[v]
+            yield Finding(
+                "seam-conformance", cls.module.path, cls.node.lineno,
+                cls.node.col_offset,
+                f"engine-shaped class '{cls.name}' "
+                f"({len(verbs)}/{len(_SEAM_VERBS)} seam verbs) is "
+                f"missing '{v}' — every backend behind the seam must "
+                f"implement the full verb set",
+                end_path=rm.module.path, end_line=rm.node.lineno)
+        for v in verbs:
+            if v not in ref.methods:
+                continue
+            im, rm = cls.methods[v], ref.methods[v]
+            req_i, req_r = _required(im), _required(rm)
+            cap_i = len(im.params()[0])
+            has_var = im.node.args.vararg is not None
+            if len(req_i) > len(req_r):
+                yield Finding(
+                    "seam-conformance", im.module.path, im.node.lineno,
+                    im.node.col_offset,
+                    f"signature drift: '{cls.name}.{v}' requires "
+                    f"{len(req_i)} args ({', '.join(req_i)}) but the "
+                    f"reference '{ref.name}.{v}' requires "
+                    f"{len(req_r)} ({', '.join(req_r) or 'none'}) — "
+                    f"seam callers pass the reference arity",
+                    end_path=rm.module.path, end_line=rm.node.lineno)
+            elif not has_var and cap_i < len(req_r):
+                yield Finding(
+                    "seam-conformance", im.module.path, im.node.lineno,
+                    im.node.col_offset,
+                    f"signature drift: '{cls.name}.{v}' accepts at "
+                    f"most {cap_i} args but the reference "
+                    f"'{ref.name}.{v}' requires {len(req_r)}",
+                    end_path=rm.module.path, end_line=rm.node.lineno)
+    # values flowing into explicit seam positions
+    for mod in _library_modules(program):
+        for node in ast.walk(mod.ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = (dotted(node.func) or "").split(".")[-1]
+            owner = program.owner_of(mod, node)
+            exprs = []
+            if d in _SEAM_POSITIONS:
+                kw_name, pos = _SEAM_POSITIONS[d]
+                expr = next((kw.value for kw in node.keywords
+                             if kw.arg == kw_name), None)
+                if expr is None and len(node.args) > pos:
+                    expr = node.args[pos]
+                if expr is not None:
+                    exprs.append((expr, d))
+            for kw in node.keywords:
+                if kw.arg == _FACTORY_KWARG:
+                    factory = program.resolve_callable_expr(
+                        mod, owner, kw.value)
+                    if factory is not None:
+                        cls = _factory_returns(program, factory)
+                        if cls is not None:
+                            exprs.append((None, _FACTORY_KWARG, cls))
+            for item in exprs:
+                if len(item) == 3:
+                    _, site, cls = item
+                else:
+                    expr, site = item
+                    cls = _expr_class(program, mod, owner, expr)
+                if cls is None:
+                    continue
+                missing = [v for v in _SEAM_VERBS
+                           if v not in cls.methods]
+                if missing:
+                    yield Finding(
+                        "seam-conformance", mod.path, node.lineno,
+                        node.col_offset,
+                        f"class '{cls.name}' flows into the engine "
+                        f"position of {site}(...) but implements only "
+                        f"{len(_SEAM_VERBS) - len(missing)}/"
+                        f"{len(_SEAM_VERBS)} seam verbs "
+                        f"(missing: {', '.join(missing)})",
+                        end_path=cls.module.path,
+                        end_line=cls.node.lineno)
+
+
+# --------------------------------------------------------------------------
+# rule: terminal-exhaustive
+# --------------------------------------------------------------------------
+
+def _status_literals(expr: ast.AST) -> Iterator[ast.Constant]:
+    """String constants inside a close-out argument that can BE the
+    status — subscript keys (``rec["uid"]``) and f-string fragments
+    are lookups, not statuses."""
+    if isinstance(expr, ast.Constant):
+        if isinstance(expr.value, str):
+            yield expr
+        return
+    if isinstance(expr, (ast.Subscript, ast.JoinedStr)):
+        return
+    for child in ast.iter_child_nodes(expr):
+        yield from _status_literals(child)
+
+
+@rule("terminal-exhaustive",
+      "every removal from a '# tpulint: live-set' tracking structure "
+      "must be paired with a terminal close-out (on_finish / a "
+      "'# tpulint: close-out' root) or a transfer back into a live "
+      "set; every close-out status literal must be a member of "
+      "TERMINAL_STATUSES, and every declared status must actually be "
+      "emitted by some close-out",
+      library_only=True, scope="program")
+def check_terminal_exhaustive(program: Program) -> Iterator[Finding]:
+    a = _analysis(program)
+    literal_names = set(a.closeout_names) | {"_finish", "_forget"}
+    used: Set[str] = set()
+    bad_literals: List[Tuple[str, str, int]] = []
+    for mod in _library_modules(program):
+        for node in ast.walk(mod.ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = (dotted(node.func) or "").split(".")[-1]
+            if d not in literal_names:
+                continue
+            values = list(node.args) + [k.value for k in node.keywords]
+            for v in values:
+                for c in _status_literals(v):
+                    used.add(c.value)
+                    if a.terminal and c.value not in a.terminal:
+                        bad_literals.append(
+                            (c.value, mod.path, c.lineno))
+    # defaults on the close-out roots count as emitted statuses
+    for qual in a.family:
+        fi = program.functions.get(qual)
+        if fi is None or fi.name not in a.closeout_names:
+            continue
+        for dflt in fi.params()[1].values():
+            if isinstance(dflt, ast.Constant) \
+                    and isinstance(dflt.value, str):
+                used.add(dflt.value)
+    if a.terminal:
+        tpath, tline = a.terminal_site
+        for status, path, line in bad_literals:
+            yield Finding(
+                "terminal-exhaustive", path, line, 0,
+                f"close-out status '{status}' is not a member of "
+                f"TERMINAL_STATUSES — add it there or use a declared "
+                f"terminal status",
+                end_path=tpath, end_line=tline)
+        if used:
+            for status, (spath, sline) in sorted(a.terminal.items()):
+                if status not in used:
+                    yield Finding(
+                        "terminal-exhaustive", spath, sline, 0,
+                        f"terminal status '{status}' is declared in "
+                        f"TERMINAL_STATUSES but no close-out ever "
+                        f"emits it — dead contract surface",
+                        end_path=tpath, end_line=tline)
+    # removals from live sets
+    for mod in _library_modules(program):
+        fns = a.by_module.get(mod.path, ())
+        for fi in fns:
+            if fi.class_name is None:
+                continue
+            marked = a.live_sets.get((mod.name, fi.class_name))
+            if not marked:
+                continue
+            removals = []
+            inserts = False
+            for node in _shallow_walk(fi.node):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("pop", "discard",
+                                               "remove") \
+                        and node.args:
+                    attr = _self_attr(node.func.value)
+                    if attr in marked:
+                        removals.append((attr, node.lineno,
+                                         node.col_offset))
+                elif isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        if isinstance(t, ast.Subscript):
+                            attr = _self_attr(t.value)
+                            if attr in marked:
+                                removals.append((attr, node.lineno,
+                                                 node.col_offset))
+                # transfers: insertion into any live set of this class
+                elif isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Subscript) \
+                                and _self_attr(t.value) in marked:
+                            inserts = True
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("add", "append",
+                                               "setdefault") \
+                        and _self_attr(node.func.value) in marked:
+                    inserts = True
+            if not removals or fi.qual in a.family or inserts:
+                continue
+            for attr, line, col in removals:
+                decl = a.live_decl.get((mod.name, fi.class_name, attr))
+                yield Finding(
+                    "terminal-exhaustive", mod.path, line, col,
+                    f"'{fi.class_name}.{fi.name}' removes a uid from "
+                    f"live set 'self.{attr}' without reaching a "
+                    f"terminal close-out (on_finish / close-out root) "
+                    f"or transferring to another live set — the "
+                    f"request vanishes without a terminal status",
+                    end_path=decl[0] if decl else mod.path,
+                    end_line=decl[1] if decl else line)
+
+
+# --------------------------------------------------------------------------
+# rule: acquire-release
+# --------------------------------------------------------------------------
+
+def _name_used_after(fn_node: ast.AST, names: Set[str],
+                     after_line: int) -> bool:
+    for node in _shallow_walk(fn_node):
+        if isinstance(node, ast.Name) and node.id in names \
+                and isinstance(node.ctx, ast.Load) \
+                and node.lineno > after_line:
+            return True
+    return False
+
+
+def _fd_transferred(fn_node: ast.AST, name: str,
+                    after_line: int) -> bool:
+    """A bound fd is OK when the function later closes it, stores it
+    into an attribute/container, or returns it."""
+    for node in _shallow_walk(fn_node):
+        if getattr(node, "lineno", 0) < after_line:
+            continue
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "close" \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == name:
+            return True
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, (ast.Attribute, ast.Subscript))
+                for t in node.targets):
+            for c in ast.walk(node.value):
+                if isinstance(c, ast.Name) and c.id == name:
+                    return True
+        if isinstance(node, ast.Return) and node.value is not None:
+            for c in ast.walk(node.value):
+                if isinstance(c, ast.Name) and c.id == name:
+                    return True
+    return False
+
+
+def _release_attrs_in(fn_node: ast.AST) -> Set[str]:
+    out = set()
+    for node in _shallow_walk(fn_node):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute):
+            out.add(node.func.attr)
+    return out
+
+
+def _bound_names(targets: List[ast.AST]) -> Optional[Set[str]]:
+    """Plain-name binding targets, or None when the assignment already
+    stores into an attribute/container (a transfer)."""
+    names: Set[str] = set()
+    for t in targets:
+        if isinstance(t, ast.Name):
+            names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            sub = _bound_names(list(t.elts))
+            if sub is None:
+                return None
+            names |= sub
+        else:
+            return None             # self.x = ... / d[k] = ... transfer
+    return names
+
+
+@rule("acquire-release",
+      "acquired resources (allocator blocks, tier revive ops, "
+      "profiler captures, worker threads, file descriptors) must be "
+      "released, finished, joined or transferred to a ledger on every "
+      "acquiring path; removal from a '# tpulint: ledger=' structure "
+      "must pair with a release on the declared receiver",
+      library_only=True, scope="program")
+def check_acquire_release(program: Program) -> Iterator[Finding]:
+    a = _analysis(program)
+    for mod in _library_modules(program):
+        src = mod.ctx.source
+        interesting = ("allocate" in src or "begin_revive" in src
+                       or "open(" in src or "Thread(" in src
+                       or ".arm(" in src or "async_p" in src)
+        if not interesting and not a.ledgers:
+            continue
+        parents = program.parents(mod)
+        for fi in a.by_module.get(mod.path, ()):
+            yield from _check_fn_acquires(program, a, mod, parents, fi)
+        for cls in mod.classes.values():
+            yield from _check_class_pairs(mod, cls)
+
+
+def _check_fn_acquires(program: Program, a: _Analysis, mod: ModuleInfo,
+                       parents: Dict[int, ast.AST],
+                       fi: FunctionInfo) -> Iterator[Finding]:
+    released = None                 # lazily computed attr-call set
+    for node in _shallow_walk(fi.node):
+        if not isinstance(node, ast.Call):
+            continue
+        # --- value-carrying acquisitions -----------------------------
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _ACQ_RELEASE:
+            recv = dotted(node.func.value) or "?"
+            verb = node.func.attr
+            stmt = _stmt_of(parents, node)
+            if released is None:
+                released = _release_attrs_in(fi.node)
+            if released & _ACQ_RELEASE[verb]:
+                continue
+            if isinstance(stmt, ast.Expr) and stmt.value is node:
+                yield Finding(
+                    "acquire-release", mod.path, node.lineno,
+                    node.col_offset,
+                    f"result of '{recv}.{verb}(...)' is dropped — the "
+                    f"acquired resource can never be released; bind "
+                    f"it, release it, or transfer it to a ledger",
+                    end_path=mod.path, end_line=fi.node.lineno)
+            elif isinstance(stmt, (ast.Assign,)) and stmt.value is node:
+                names = _bound_names(stmt.targets)
+                if names is None:
+                    continue        # stored into attr/container
+                if not _name_used_after(fi.node, names, stmt.lineno):
+                    yield Finding(
+                        "acquire-release", mod.path, node.lineno,
+                        node.col_offset,
+                        f"'{recv}.{verb}(...)' binds "
+                        f"{sorted(names)} but the name is never "
+                        f"used again — acquired resource leaks on "
+                        f"this path",
+                        end_path=mod.path, end_line=fi.node.lineno)
+        # --- bare file descriptors -----------------------------------
+        elif isinstance(node.func, ast.Name) and node.func.id == "open":
+            if _in_withitem(parents, node):
+                continue
+            stmt = _stmt_of(parents, node)
+            if isinstance(stmt, ast.Return):
+                continue            # handed to the caller
+            if isinstance(stmt, ast.Assign) and stmt.value is node:
+                names = _bound_names(stmt.targets)
+                if names is None:
+                    continue        # self._bin = open(...) — ledger
+                name = next(iter(names)) if len(names) == 1 else None
+                if name is not None and _fd_transferred(
+                        fi.node, name, stmt.lineno):
+                    continue
+                yield Finding(
+                    "acquire-release", mod.path, node.lineno,
+                    node.col_offset,
+                    f"file object from open(...) bound to "
+                    f"'{name or '?'}' is never closed, stored, or "
+                    f"returned — use 'with open(...)' or park it on a "
+                    f"ledger that close() drains",
+                    end_path=mod.path, end_line=fi.node.lineno)
+            else:
+                yield Finding(
+                    "acquire-release", mod.path, node.lineno,
+                    node.col_offset,
+                    "open(...) used inline — the descriptor is "
+                    "dropped without a deterministic close; use "
+                    "'with open(...)'",
+                    end_path=mod.path, end_line=fi.node.lineno)
+        # --- worker threads ------------------------------------------
+        elif (dotted(node.func) or "").split(".")[-1] == "Thread":
+            daemon = next((kw.value for kw in node.keywords
+                           if kw.arg == "daemon"), None)
+            if isinstance(daemon, ast.Constant) and daemon.value is True:
+                continue
+            joined = False
+            scope_fns = [f for f in a.by_module.get(mod.path, ())
+                         if f.class_name == fi.class_name] \
+                if fi.class_name else a.by_module.get(mod.path, ())
+            for other in scope_fns:
+                for n in _shallow_walk(other.node):
+                    if isinstance(n, ast.Call) \
+                            and isinstance(n.func, ast.Attribute) \
+                            and n.func.attr == "join":
+                        joined = True
+                        break
+                if joined:
+                    break
+            if not joined:
+                yield Finding(
+                    "acquire-release", mod.path, node.lineno,
+                    node.col_offset,
+                    "worker thread is neither daemon=True nor ever "
+                    "joined — it outlives shutdown with no lifecycle "
+                    "owner (watchdog workers need a poison-pill/join "
+                    "path)",
+                    end_path=mod.path, end_line=fi.node.lineno)
+        # --- ledger removals -----------------------------------------
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("pop", "remove", "discard") \
+                and node.args and fi.class_name is not None:
+            attr = _self_attr(node.func.value)
+            led = a.ledgers.get((mod.name, fi.class_name, attr)) \
+                if attr else None
+            if led is None:
+                continue
+            hint, lpath, lline = led
+            paired = False
+            for n in _shallow_walk(fi.node):
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute):
+                    recv = dotted(n.func.value) or ""
+                    if hint in recv.split("."):
+                        paired = True
+                        break
+            if not paired:
+                yield Finding(
+                    "acquire-release", mod.path, node.lineno,
+                    node.col_offset,
+                    f"'{fi.class_name}.{fi.name}' removes an entry "
+                    f"from ledger 'self.{attr}' without any call on "
+                    f"the declared release receiver '{hint}' — the "
+                    f"resources owned by the entry leak",
+                    end_path=lpath, end_line=lline)
+
+
+def _check_class_pairs(mod: ModuleInfo, cls) -> Iterator[Finding]:
+    sites: List[Tuple[str, str, int, int]] = []
+    for m in cls.methods.values():
+        for node in _shallow_walk(m.node):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                sites.append((dotted(node.func.value) or "",
+                              node.func.attr, node.lineno,
+                              node.col_offset))
+    for acq_attrs, hints, rel_attrs, what in _CLASS_PAIRS:
+        acq = [s for s in sites if s[1] in acq_attrs
+               and any(h in s[0].lower() for h in hints)]
+        if not acq:
+            continue
+        if any(s[1] in rel_attrs for s in sites):
+            continue
+        recv, attr, line, col = acq[0]
+        yield Finding(
+            "acquire-release", mod.path, line, col,
+            f"{what} via '{recv}.{attr}(...)' but class "
+            f"'{cls.name}' never calls any of "
+            f"{sorted(rel_attrs)} — the acquisition can never "
+            f"complete",
+            end_path=mod.path, end_line=cls.node.lineno)
+
+
+# --------------------------------------------------------------------------
+# rule: counter-pairing
+# --------------------------------------------------------------------------
+
+def _bump_tokens(fn_node: ast.AST) -> Dict[str, int]:
+    """counter token -> first bump line in this function."""
+    out: Dict[str, int] = {}
+    for node in _shallow_walk(fn_node):
+        token = None
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "inc":
+            recv = node.func.value
+            if isinstance(recv, ast.Attribute):
+                token = recv.attr
+            elif isinstance(recv, ast.Subscript) \
+                    and isinstance(recv.slice, ast.Constant) \
+                    and isinstance(recv.slice.value, str):
+                token = recv.slice.value
+            elif isinstance(recv, ast.Name):
+                token = recv.id
+        elif isinstance(node, ast.AugAssign) \
+                and isinstance(node.op, ast.Add):
+            t = node.target
+            if isinstance(t, ast.Subscript) \
+                    and isinstance(t.slice, ast.Constant) \
+                    and isinstance(t.slice.value, str):
+                token = t.slice.value
+            elif isinstance(t, ast.Attribute):
+                token = t.attr
+        if token is not None and token not in out:
+            out[token] = node.lineno
+    return out
+
+
+@rule("counter-pairing",
+      "counters declared '# tpulint: pair=a/b' must bump together in "
+      "the same function — a one-sided bump silently breaks the "
+      "documented sum(per-request) == engine-counter invariants",
+      library_only=True, scope="program")
+def check_counter_pairing(program: Program) -> Iterator[Finding]:
+    a = _analysis(program)
+    if not a.pairs:
+        return
+    for mod in _library_modules(program):
+        for fi in a.by_module.get(mod.path, ()):
+            tokens = None
+            for pa, pb, ppath, pline in a.pairs:
+                if tokens is None:
+                    tokens = _bump_tokens(fi.node)
+                has_a, has_b = pa in tokens, pb in tokens
+                if has_a == has_b:
+                    continue
+                present, absent = (pa, pb) if has_a else (pb, pa)
+                yield Finding(
+                    "counter-pairing", mod.path, tokens[present], 0,
+                    f"'{fi.qual.split('::')[-1]}' bumps '{present}' "
+                    f"without its declared pair '{absent}' — the "
+                    f"paired-bump contract says they move together",
+                    end_path=ppath, end_line=pline)
+
+
+# --------------------------------------------------------------------------
+# rule: raise-escape
+# --------------------------------------------------------------------------
+
+@rule("raise-escape",
+      "a call chain reachable from a '# tpulint: serving-loop' method "
+      "can raise a device-ish exception (DispatchTimeoutError / "
+      "injected faults) with no catching handler between — device "
+      "failures must route through the classifier seam, not unwind "
+      "the serving loop",
+      library_only=True, scope="program")
+def check_raise_escape(program: Program) -> Iterator[Finding]:
+    a = _analysis(program)
+    reported: Set[Tuple[str, int]] = set()
+    for fi in sorted(a.serving, key=lambda f: (f.module.path,
+                                               f.node.lineno)):
+        for name, rpath, rline in a.escapes(fi.qual):
+            if (rpath, rline) in reported:
+                continue
+            reported.add((rpath, rline))
+            yield Finding(
+                "raise-escape", fi.module.path, fi.node.lineno,
+                fi.node.col_offset,
+                f"serving-loop '{fi.name}' can see {name} escape "
+                f"uncaught — wrap the dispatch in try/except and "
+                f"route it through the failure classifier seam",
+                end_path=rpath, end_line=rline)

@@ -257,6 +257,7 @@ def lint_paths(paths: Iterable[str],
     from . import rules as _rules  # noqa: F401  (populate the registry)
     from . import dataflow as _dataflow  # noqa: F401
     from . import concurrency as _concurrency  # noqa: F401
+    from . import contracts as _contracts  # noqa: F401
     axes = mesh_axes if mesh_axes is not None else find_mesh_axes(paths)
     selected = list(RULES.values())
     if rules is not None:
