@@ -247,6 +247,8 @@ def main(trace_path=None, profile_dir=None):
     chaos = leg(chaos_serving_bench, on_tpu)
     fleet = leg(fleet_serving_bench, on_tpu)
     tiered = leg(tiered_kv_serving_bench, on_tpu)
+    disagg = leg(disagg_serving_bench, on_tpu)
+    autoscale = leg(autoscale_serving_bench, on_tpu)
     http = leg(http_serving_bench, on_tpu)
     llama_train = leg(llama_train_bench, on_tpu, peak)
     llama_serve = leg(llama8b_serving_bench, on_tpu)
@@ -271,7 +273,8 @@ def main(trace_path=None, profile_dir=None):
     }
     out.update(serve)
     print(json.dumps({**out, **pipe, **prefix, **spec, **overload,  # tpulint: disable=print — the bench's one JSON output line
-                      **chaos, **fleet, **tiered, **http, **llama_train,
+                      **chaos, **fleet, **tiered, **disagg, **autoscale,
+                      **http, **llama_train,
                       **llama_serve, **moe, **comm}))
 
 
@@ -416,6 +419,49 @@ def tiered_kv_serving_bench(on_tpu: bool):
             "tiered_kv_ttft_vs_allhbm": out["ttft_vs_allhbm"],
             "tiered_kv_remote_restage_speedup":
                 out["remote_restage_speedup"]}
+
+
+def disagg_serving_bench(on_tpu: bool):
+    """Disaggregation leg (docs/SERVING.md "Disaggregated pools &
+    elasticity"): ONE seeded mixed-SLO trace through a 3-mixed-replica
+    colocated fleet (chunked prefill — the strongest colocated
+    baseline) and a 2-prefill + 1-decode disaggregated fleet at EQUAL
+    replica count.  The headline metrics land top-level so
+    ``tools/benchdiff.py``'s existing direction rules gate them:
+    ``disagg_interactive_speedup`` (colocated p95 TTFT rounds over
+    disaggregated — the acceptance bar is > 1.0: pools win at
+    identical hardware) up-is-better, the ``disagg_*_ttft_*_ms`` pair
+    down-is-better, ``disagg_goodput_tok_s`` up-is-better."""
+    from tools.loadgen import disagg_bench
+
+    out = disagg_bench(seed=0)
+    return {"disagg_serving": out,
+            "disagg_interactive_speedup":
+                out["disagg_interactive_speedup"],
+            "disagg_ttft_p95_interactive_ms":
+                out["disagg"]["ttft_p95_interactive_ms"],
+            "disagg_colocated_ttft_p95_interactive_ms":
+                out["colocated"]["ttft_p95_interactive_ms"],
+            "disagg_goodput_tok_s": out["disagg"]["goodput_tok_s"],
+            "disagg_colocated_goodput_tok_s":
+                out["colocated"]["goodput_tok_s"]}
+
+
+def autoscale_serving_bench(on_tpu: bool):
+    """Elasticity leg (docs/SERVING.md "Disaggregated pools &
+    elasticity"): the loadgen scaling chaos smoke — a seeded load
+    swing through a disaggregated fleet with the signal-driven
+    actuator attached — run as a bench capture.  The acceptance
+    asserts run inside (pool scales up AND back down, zero lost
+    requests, exact token parity, handoff journeys); the JSON records
+    the decision log and swing telemetry."""
+    from tools.loadgen import scale_chaos_smoke
+
+    out = scale_chaos_smoke(seed=0)
+    return {"autoscale_serving": {
+        "ok": out["ok"],
+        "variants": out["variants"],
+    }}
 
 
 def http_serving_bench(on_tpu: bool):

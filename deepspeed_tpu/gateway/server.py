@@ -131,7 +131,7 @@ _STATUS_REASON = {"finished": "stop", "cancelled": "cancelled",
                   "deadline_exceeded": "deadline_exceeded",
                   "shed": "shed", "failed": "failed",
                   "context_exhausted": "length", "released": "released",
-                  "migrated": "migrated"}
+                  "migrated": "migrated", "handed_off": "handed_off"}
 
 
 class Gateway:
@@ -746,9 +746,19 @@ class Gateway:
                     f"uid {uid} is already known to the engine "
                     f"(status {st!r})")
         try:
-            verdict = await self._call(
-                self.backend.put, uid, req.prompt,
-                priority=priority, deadline_ms=deadline_ms)
+            if self._is_fleet:
+                # the fleet router routes the class itself too: a
+                # disaggregated fleet places interactive arrivals on
+                # the prefill pool and batch on decode (single engines
+                # don't take the kwarg — class already folded above)
+                verdict = await self._call(
+                    self.backend.put, uid, req.prompt,
+                    priority=priority, deadline_ms=deadline_ms,
+                    slo_class=cls)
+            else:
+                verdict = await self._call(
+                    self.backend.put, uid, req.prompt,
+                    priority=priority, deadline_ms=deadline_ms)
         except Exception:
             unreserve()
             raise

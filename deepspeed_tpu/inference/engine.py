@@ -2566,6 +2566,33 @@ class InferenceEngine:
             self._reaped.add(rec["uid"])
         return part
 
+    def handoff_out(self, uids: Sequence[int]) -> Dict:
+        """Prefill→decode handoff extraction (docs/SERVING.md
+        "Disaggregated pools & elasticity"): the same extract-and-close
+        composition as :meth:`migrate_out`, with two differences.  The
+        close status is ``handed_off`` — terminal here, a routing hop
+        at the fleet level — and BEFORE closing, each request's
+        still-indexed chain blocks are staged into the KV tier
+        (``stage_chain_demotes`` + an immediate demote drain, reading
+        the device while the blocks are guaranteed unrewritten), so the
+        router's :meth:`export_tier_chain` fetch on the decode side
+        ships the prefilled KV instead of re-prefilling it.  The same
+        destroy-avoidance rules apply: dispatched-but-uncollected and
+        non-replayable requests stay in place for a later boundary."""
+        eligible = [int(u) for u in uids
+                    if not self._inflight_sched.get(int(u), 0)]
+        part = self.snapshot_requests(eligible)
+        part["requests"] = [rec for rec in part["requests"]
+                            if rec["exact"] and rec["tokens"]]
+        staged = 0
+        for rec in part["requests"]:
+            staged += self.state.stage_chain_demotes(rec["uid"])
+            self._finish(rec["uid"], "handed_off")
+            self._reaped.add(rec["uid"])
+        if staged:
+            self._drain_tier_demote()
+        return part
+
     def export_tier_chain(self, digests: Sequence[bytes]) -> Optional[Dict]:
         """Extract the leading contiguous run of ``digests`` this
         engine's KV tier can serve, as a snapshot-v2-shaped partial

@@ -667,6 +667,29 @@ class StateManager:
         self.tier_pending_demote = []
         return out
 
+    def stage_chain_demotes(self, uid: int) -> int:
+        """Queue a device→tier COPY for every still-indexed full block
+        of ``uid``'s chain and return how many were queued — the
+        prefill→decode handoff's KV export (docs/SERVING.md
+        "Disaggregated pools & elasticity").  Unlike the eviction path
+        (``_on_evict``) the blocks stay indexed and cached-free on this
+        replica: the tier entry is a copy ``export_tier_chain`` can
+        ship, not a move.  Blocks already tiered (or never registered —
+        the partial tail, cache-off runs) are skipped; the destination
+        re-prefills whatever the exported run doesn't cover."""
+        seq = self.seqs.get(uid)
+        if seq is None or self.tier is None:
+            return 0
+        n = 0
+        for b in seq.blocks:
+            h = self._block_hash.get(b)
+            meta = self._block_meta.get(b)
+            if h is None or meta is None or h in self.tier:
+                continue
+            self.tier_pending_demote.append((meta[0], h, meta[1], b))
+            n += 1
+        return n
+
     def take_tier_restage(self) -> List[RestageEntry]:
         out = self.tier_pending_restage
         self.tier_pending_restage = []

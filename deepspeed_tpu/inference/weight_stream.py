@@ -94,6 +94,28 @@ class NVMeWeightStore:
             out.append(buf)
         return tuple(out)
 
+    def restore_stacked(self) -> Any:
+        """Read every layer back through the aio pool and rebuild the
+        stacked pytree RESIDENT — the scale-up cold-start path
+        (docs/SERVING.md "Disaggregated pools & elasticity"): a new
+        replica materializes its block weights from the store spilled
+        once at deploy instead of re-tracing checkpoint load, and
+        because the weights end resident (``icfg.weight_stream`` unset
+        on the new engine) none of the modes streaming forces off —
+        decode bursts, speculative decode — are forced on it."""
+        assert self._treedef is not None, "restore before spill"
+        leaves = []
+        for j, sds in enumerate(self._shapes):
+            arr = np.empty((self.num_layers,) + tuple(sds.shape),
+                           sds.dtype)
+            for li in range(self.num_layers):
+                self._aio.sync_pread(
+                    arr[li].view(np.uint8).reshape(-1),
+                    self._file(li, j),
+                    offset=self._offsets[(li, j)])
+            leaves.append(jnp.asarray(arr))
+        return jax.tree.unflatten(self._treedef, leaves)
+
     def fetch_layer(self, li):
         """In-graph: returns this layer's payload pytree (device arrays
         materialized from the host callback)."""

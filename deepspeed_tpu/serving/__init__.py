@@ -9,6 +9,8 @@ replicas as restore()-compatible per-request records
 (``engine.snapshot_requests`` / ``migrate_out`` /
 ``load_snapshot(merge=True)``)."""
 
+from .autoscaler import (Autoscaler, AutoscalerConfig,
+                         WeightStreamColdStart)
 from .fleet_telemetry import (FLEET_DUMP_VERSION, FleetRegistry,
                               FleetTelemetry, FleetTelemetryConfig,
                               default_fleet_detectors,
@@ -16,14 +18,17 @@ from .fleet_telemetry import (FLEET_DUMP_VERSION, FleetRegistry,
                               fleet_request_records,
                               reconciled_terminal_statuses,
                               validate_fleet_dump)
-from .placement import (PLACEMENT_POLICIES, affinity_chain_len,
-                        prompt_digests, rank_replicas)
+from .placement import (PLACEMENT_POLICIES, REPLICA_ROLES,
+                        affinity_chain_len, prompt_digests,
+                        rank_replicas, split_by_pool)
 from .replica import CircuitBreaker, ReplicaHandle
 from .router import FleetConfig, FleetRouter
 
 __all__ = ["FleetConfig", "FleetRouter", "ReplicaHandle",
-           "CircuitBreaker", "PLACEMENT_POLICIES", "prompt_digests",
-           "affinity_chain_len", "rank_replicas",
+           "CircuitBreaker", "PLACEMENT_POLICIES", "REPLICA_ROLES",
+           "prompt_digests", "affinity_chain_len", "rank_replicas",
+           "split_by_pool",
+           "Autoscaler", "AutoscalerConfig", "WeightStreamColdStart",
            "FleetTelemetry", "FleetTelemetryConfig", "FleetRegistry",
            "default_fleet_detectors", "fleet_request_metrics",
            "fleet_request_records", "reconciled_terminal_statuses",

@@ -380,10 +380,11 @@ def fleet_request_records(router) -> List[Dict[str, Any]]:
     records, migration-aware (docs/OBSERVABILITY.md "Fleet
     observability"):
 
-    * a ``migrated`` close on one replica is a HOP — it folds into the
-      uid's temporally-next record (the continuation the router placed
-      elsewhere), so a migrated request yields ONE record attributed
-      to its finishing replica;
+    * a ``migrated`` or ``handed_off`` close on one replica is a HOP —
+      it folds into the uid's temporally-next record (the continuation
+      the router placed elsewhere), so a migrated or prefill→decode
+      handed-off request yields ONE record attributed to its finishing
+      replica;
     * an ``open`` record on a DEAD replica is the failover's hop (the
       engine died before closing it; the router re-placed or
       fleet-closed the work);
@@ -417,7 +418,7 @@ def fleet_request_records(router) -> List[Dict[str, Any]]:
             kept.append((t, name, rec, dead))
         cur = None
         for t, name, rec, dead in kept:
-            hop = rec.status == "migrated" \
+            hop = rec.status in ("migrated", "handed_off") \
                 or (dead and rec.status == "open")
             if cur is None:
                 cur = _merged_rec(uid)
@@ -489,8 +490,8 @@ def reconciled_terminal_statuses(router) -> Dict[str, int]:
     ``serving_requests_terminal_total`` sums with the migration/routing
     double counting reconciled out —
 
-    * ``migrated`` closures are dropped (internal hops, the request
-      lives on);
+    * ``migrated`` and ``handed_off`` closures are dropped (internal
+      hops, the request lives on);
     * per-replica ``shed`` closures that were fleet routing retries
       (phantoms, counted by ``serving_fleet_replica_shed_retries_
       total``) are subtracted;
@@ -507,7 +508,7 @@ def reconciled_terminal_statuses(router) -> Dict[str, int]:
             if not k:
                 continue
             status = dict(k).get("status")
-            if status is None or status == "migrated":
+            if status is None or status in ("migrated", "handed_off"):
                 continue
             tally[status] = tally.get(status, 0) + int(v)
     phantoms = int(router._c_phantom.value())

@@ -32,6 +32,31 @@ from ..inference.ragged.state import prefix_chain_digests
 
 PLACEMENT_POLICIES = ("affinity", "least_loaded", "round_robin")
 
+# per-replica roles for disaggregated serving (docs/SERVING.md
+# "Disaggregated pools & elasticity"): a "prefill" replica runs
+# chunk-free prompt ingestion and hands finished prefills off, a
+# "decode" replica hosts the token loops, "mixed" serves both (the
+# colocated default — a fleet of only mixed replicas behaves exactly
+# as before roles existed)
+REPLICA_ROLES = ("prefill", "decode", "mixed")
+
+
+def split_by_pool(order: Sequence[str], roles: Dict[str, str],
+                  pool: Optional[str]) -> List[str]:
+    """Stable-partition an already-ranked replica order for a pool-
+    targeted placement: replicas serving ``pool`` (their role IS the
+    pool, or ``mixed``) keep their rank ahead of everything else, and
+    the rest stay as a ranked FALLBACK — a pool with no capacity must
+    degrade to colocated placement, never to a lost request.
+    ``pool=None`` (no split: pre-disaggregation behavior) returns the
+    order unchanged."""
+    if pool is None:
+        return list(order)
+    want = (pool, "mixed")
+    pref = [n for n in order if roles.get(n, "mixed") in want]
+    rest = [n for n in order if roles.get(n, "mixed") not in want]
+    return pref + rest
+
 
 class CombinedDigestIndex:
     """Membership view over a replica's RESIDENT digest index plus its

@@ -33,7 +33,7 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
-from .placement import CombinedDigestIndex
+from .placement import REPLICA_ROLES, CombinedDigestIndex
 
 
 class CircuitBreaker:
@@ -113,12 +113,23 @@ class ReplicaHandle:
     bookkeeping that feeds the breaker after every stepped round."""
 
     def __init__(self, name: str, engine, threshold: int = 2,
-                 probe_interval: int = 8):
+                 probe_interval: int = 8, role: str = "mixed"):
+        if role not in REPLICA_ROLES:
+            raise ValueError(f"role={role!r}: expected one of "
+                             f"{REPLICA_ROLES}")
         self.name = name
         self.engine = engine
+        self.role = role
         self.breaker = CircuitBreaker(threshold, probe_interval)
         self._last_retries = int(engine.timings["step_retries"])
         self._last_steps = int(engine.timings["steps"])
+        # warm placement digests seeded from a PRIOR router generation's
+        # snapshot (router.restore_prefix_index): bytes digests that
+        # score affinity so a restarted fleet routes each prefix family
+        # back to its old replica — the engine re-prefills the first
+        # visit, every later one hits the rebuilt cache.  Advertised to
+        # placement only, never re-exported as real cache content
+        self.warm_digests: set = set()
 
     @property
     def dead(self) -> bool:
@@ -145,10 +156,12 @@ class ReplicaHandle:
         tier is on (two lookups — tiered chains score like resident
         ones).  :meth:`prefix_digests` is the exportable hex form."""
         tier = getattr(self.engine.state, "tier", None)
+        base = self.engine.state._hash_index
         if tier is not None:
-            return CombinedDigestIndex(self.engine.state._hash_index,
-                                       tier)
-        return self.engine.state._hash_index
+            base = CombinedDigestIndex(base, tier)
+        if self.warm_digests:
+            base = CombinedDigestIndex(base, self.warm_digests)
+        return base
 
     def load(self) -> int:
         """Live sequences + requests still waiting for first admission

@@ -35,6 +35,15 @@ PRs already built — nothing here invents a new one:
 * **fleet-level shed** — a request is rejected only when EVERY
   routable replica's own admission bound shed it (the 429-equivalent);
   one replica's backpressure is the next replica's placement.
+* **disaggregated pools** — replicas carry roles (``prefill`` /
+  ``decode`` / ``mixed``): with both pools present, new arrivals place
+  SLO-class-aware (interactive prompts onto chunk-free prefill
+  replicas, batch streams straight onto the decode pool), and a
+  request that finishes prefill on a pure-prefill replica is shipped
+  to a decode replica as routing plus block transfer
+  (``engine.handoff_out`` → ``load_snapshot(merge=True)``, the KV
+  chain riding the same ``export_tier_chain`` path an affinity-miss
+  restage uses) — docs/SERVING.md "Disaggregated pools & elasticity".
 
 Everything is step-counted and host-side: no wall-clock waits, no
 polling loops — the router's only clock is its own step counter, so
@@ -59,7 +68,8 @@ from ..utils.logging import logger
 from .fleet_telemetry import (FLEET_DUMP_VERSION, NOOP_CTX, FleetRegistry,
                               FleetTelemetry, FleetTelemetryConfig,
                               fleet_request_metrics)
-from .placement import PLACEMENT_POLICIES, rank_replicas
+from .placement import (PLACEMENT_POLICIES, REPLICA_ROLES, rank_replicas,
+                        split_by_pool)
 from .replica import ReplicaHandle
 
 # fleet-level view of engine health states, exported per replica as
@@ -90,9 +100,10 @@ class FleetConfig:
     # observability"): "on" constructs the FleetTelemetry object
     # (journeys, router spans, fleet anomaly detectors, capture
     # budget); "off" constructs NOTHING and adds zero clock reads per
-    # router step (the counted PR-10 bar).  "auto" resolves OFF today
-    # — ROADMAP item 3's signal-driven autoscaler is the intended
-    # flipper, exactly like the engines' anomaly/device_telemetry
+    # router step (the counted PR-10 bar).  "auto" resolves OFF until
+    # a signal consumer flips it: attaching the autoscaling actuator
+    # (serving/autoscaler.py) calls router.enable_telemetry(), exactly
+    # like the engines' anomaly/device_telemetry gates
     telemetry: str = "auto"
     telemetry_cfg: Optional[FleetTelemetryConfig] = None
     # fleet post-mortem bundles: router.debug_dump() target for the
@@ -123,11 +134,16 @@ class FleetConfig:
 @dataclasses.dataclass
 class _Migration:
     """One request record waiting for re-placement (failover, live
-    migration, or scale-down hand-off)."""
+    migration, scale-down, or a prefill→decode handoff).  ``pool``
+    targets the placement at one pool (ranked fallback to the rest —
+    a full pool degrades to colocated placement, never to a lost
+    request); ``via`` labels the journey's "placed" event."""
     rec: Dict
     source: str
     attempts: int = 0
     next_step: int = 0
+    pool: Optional[str] = None
+    via: str = "migration"
 
 
 class FleetRouter:
@@ -136,7 +152,8 @@ class FleetRouter:
     order is the deterministic rank tiebreak) or a sequence of engines
     auto-named ``r0, r1, ...``."""
 
-    def __init__(self, replicas, cfg: Optional[FleetConfig] = None):
+    def __init__(self, replicas, cfg: Optional[FleetConfig] = None,
+                 roles: Optional[Dict[str, str]] = None):
         self.cfg = cfg or FleetConfig()
         self._reps: Dict[str, ReplicaHandle] = {}
         self._block_size: Optional[int] = None
@@ -147,6 +164,13 @@ class FleetRouter:
         self._migrations: List[_Migration] = []
         self._steps = 0
         self._rr = 0                          # round-robin cursor
+        # uids already handed off prefill→decode once this life: a
+        # fallback placement that lands one back on a prefill replica
+        # must not re-extract it (no ping-pong); size-bounded
+        self._handed: set = set()
+        # the attached scaling actuator (serving/autoscaler.py); the
+        # router drives it once per step, after telemetry feeds
+        self._autoscaler = None
         # reconciliation ledgers (docs/OBSERVABILITY.md "Fleet
         # observability"): per-(uid, replica) phantom-shed counts
         # (engine shed closures that were fleet routing retries —
@@ -164,8 +188,9 @@ class FleetRouter:
         self.flight = FlightRecorder()
         self._autodumps = 0
         tmode = self.cfg.telemetry
-        # "auto" resolves OFF today — the signal consumer (ROADMAP
-        # item 3's autoscaler) is the flipper, like the engines' gates
+        # "auto" resolves OFF until a consumer flips it — attaching
+        # the autoscaler calls enable_telemetry(), like the engines'
+        # anomaly/device_telemetry gates
         self._ftel: Optional[FleetTelemetry] = FleetTelemetry(
             self.cfg.telemetry_cfg, self.metrics) \
             if tmode == "on" else None
@@ -175,7 +200,8 @@ class FleetRouter:
         items = replicas.items() if isinstance(replicas, dict) \
             else ((f"r{i}", e) for i, e in enumerate(replicas))
         for name, eng in items:
-            self.add_replica(name, eng)
+            self.add_replica(name, eng,
+                             role=(roles or {}).get(name, "mixed"))
         if not self._reps:
             raise ValueError("FleetRouter needs at least one replica")
 
@@ -242,6 +268,20 @@ class FleetRouter:
             "tier-fetch payloads rejected by digest/checksum "
             "verification on arrival (the chosen replica re-prefills "
             "instead)", int_valued=True)
+        self._c_handoffs = reg.counter(
+            "serving_fleet_handoffs_total",
+            "prefill→decode handoffs: requests extracted from a "
+            "prefill replica after first token and re-placed on the "
+            "decode pool (docs/SERVING.md \"Disaggregated pools & "
+            "elasticity\")", int_valued=True)
+        self._c_scale_ups = reg.counter(
+            "serving_fleet_scale_ups_total",
+            "replicas added by the autoscaling actuator (label "
+            "pool=)", int_valued=True)
+        self._c_scale_downs = reg.counter(
+            "serving_fleet_scale_downs_total",
+            "replicas drained away by the autoscaling actuator "
+            "(label pool=)", int_valued=True)
         self._g_replicas = reg.gauge(
             "serving_fleet_replicas", "replicas registered (incl. dead)")
         self._g_routable = reg.gauge(
@@ -251,6 +291,15 @@ class FleetRouter:
             "serving_fleet_replica_health",
             "per-replica health (label replica=): 0 healthy 1 degraded "
             "2 draining 3 dead 4 quarantined")
+        self._g_pool_replicas = reg.gauge(
+            "serving_fleet_pool_replicas",
+            "live replicas serving a pool (label pool=; mixed "
+            "replicas serve both, so the pools may overlap)")
+        self._g_pool_load = reg.gauge(
+            "serving_fleet_pool_load",
+            "summed live+queued requests across a pool's replicas "
+            "(label pool=) — the depth/width signal the autoscaler "
+            "sizes each pool by")
         reg.gauge_fn("serving_fleet_requests_migrating",
                      lambda: len(self._migrations),
                      "request records waiting for re-placement")
@@ -268,10 +317,16 @@ class FleetRouter:
     # ------------------------------------------------------------------
     # fleet membership
     # ------------------------------------------------------------------
-    def add_replica(self, name: str, engine: InferenceEngine) -> None:
+    def add_replica(self, name: str, engine: InferenceEngine,
+                    role: str = "mixed") -> None:
         """Register a replica (scale-up).  Fleets must share one KV
         block size — the chain digest is block-aligned, so a
-        heterogeneous fleet could never compare affinity keys."""
+        heterogeneous fleet could never compare affinity keys.
+        ``role`` joins it to a pool (docs/SERVING.md "Disaggregated
+        pools & elasticity"); a ``prefill`` replica's prompt ingestion
+        is made chunk-free here — the chunk cap exists to protect
+        decode TPOT on a replica that also decodes, which a pure
+        prefill replica never does."""
         if name in self._reps:
             raise ValueError(f"replica {name!r} already registered")
         bs = engine.icfg.kv_block_size
@@ -284,9 +339,14 @@ class FleetRouter:
                 "aligned and cannot mix sizes")
         self._max_blocks = max(self._max_blocks,
                                engine.max_blocks_per_seq)
+        if role == "prefill" and engine.ocfg.prefill_chunk is not None:
+            engine.ocfg = dataclasses.replace(engine.ocfg,
+                                              prefill_chunk=None)
+            logger.info("fleet: replica %s joins the prefill pool "
+                        "chunk-free (prefill_chunk cleared)", name)
         self._reps[name] = ReplicaHandle(
             name, engine, threshold=self.cfg.failure_threshold,
-            probe_interval=self.cfg.probe_interval_steps)
+            probe_interval=self.cfg.probe_interval_steps, role=role)
 
     def replica(self, name: str) -> ReplicaHandle:
         return self._reps[name]
@@ -297,6 +357,54 @@ class FleetRouter:
 
     def _routable(self) -> List[ReplicaHandle]:
         return [r for r in self._reps.values() if r.routable()]
+
+    def _roles(self) -> Dict[str, str]:
+        return {name: rep.role for name, rep in self._reps.items()}
+
+    def _disaggregated(self) -> bool:
+        """Pools are ACTIVE: at least one live prefill replica and at
+        least one live replica that can decode (decode or mixed).  An
+        all-mixed fleet — every pre-roles caller — never splits."""
+        has_prefill = has_decode = False
+        for rep in self._reps.values():
+            if rep.dead:
+                continue
+            if rep.role == "prefill":
+                has_prefill = True
+            else:
+                has_decode = True
+        return has_prefill and has_decode
+
+    def pool_members(self, pool: str) -> List[ReplicaHandle]:
+        """Live replicas serving ``pool`` — dedicated-role replicas
+        when the pool has any, else the mixed replicas standing in
+        for it (an all-mixed fleet IS both pools)."""
+        live = [r for r in self._reps.values() if not r.dead]
+        exact = [r for r in live if r.role == pool]
+        if exact:
+            return exact
+        return [r for r in live if r.role == "mixed"]
+
+    def _arrival_pool(self, slo_class: Optional[str]) -> Optional[str]:
+        """Which pool a NEW arrival targets.  None (no split) while
+        the fleet isn't disaggregated.  Batch-class streams place
+        straight onto the decode pool — their TTFT is not the SLO, and
+        keeping them off the prefill replicas keeps prefill-pool depth
+        (= interactive TTFT) low; everything else ingests chunk-free
+        on the prefill pool and hands off after first token."""
+        if not self._disaggregated():
+            return None
+        return "decode" if slo_class == "batch" else "prefill"
+
+    def enable_telemetry(self) -> None:
+        """Flip the ``telemetry="auto"`` fleet observability plane ON
+        — the autoscaler's attach path: the actuator is the signal
+        consumer "auto" was waiting for, exactly like the engines'
+        anomaly/device_telemetry gates.  Idempotent; a hard "off" is
+        respected (the operator said no)."""
+        if self._ftel is None and self.cfg.telemetry != "off":
+            self._ftel = FleetTelemetry(self.cfg.telemetry_cfg,
+                                        self.metrics)
 
     def _score_candidates(self, tokens, cands) -> Dict[str, int]:
         """Leading-run affinity scores for one prompt against every
@@ -319,12 +427,17 @@ class FleetRouter:
                     break
         return scores
 
-    def _rank(self, tokens) -> Tuple[List[str], Dict[str, int]]:
+    def _rank(self, tokens,
+              pool: Optional[str] = None
+              ) -> Tuple[List[str], Dict[str, int]]:
         """Rank routable replicas for one placement.  Half-open
         (probing) replicas rank strictly AFTER every closed one
         whatever their affinity — quarantine means minimal traffic, so
         they only receive work when no closed replica can take it (and
-        that one placement is the probe)."""
+        that one placement is the probe).  ``pool`` stable-partitions
+        each group so pool-serving replicas keep their rank ahead of
+        the rest (a ranked fallback, never a hard filter — a full pool
+        degrades to colocated placement, not a lost request)."""
         closed = [(rep.name, rep.digest_index(), rep.load())
                   for rep in self._routable()
                   if rep.breaker.state == "closed"]
@@ -332,13 +445,15 @@ class FleetRouter:
                    for rep in self._routable()
                    if rep.breaker.state == "half_open"]
         scores = self._score_candidates(tokens, closed + probing)
+        roles = self._roles() if pool is not None else {}
         order, _ = rank_replicas(self.cfg.placement, (), closed,
                                  rr_offset=self._rr, scores=scores)
+        order = split_by_pool(order, roles, pool)
         if probing:
             p_order, _ = rank_replicas(
                 self.cfg.placement, (), probing,
                 rr_offset=self._rr, scores=scores)
-            order = order + p_order
+            order = order + split_by_pool(p_order, roles, pool)
         return order, scores
 
     def _tier_fetch(self, uid: int, name: str, tokens) -> None:  # tpulint: serving-loop
@@ -414,14 +529,17 @@ class FleetRouter:
     # the engine-shaped request API
     # ------------------------------------------------------------------
     def put(self, uid: int, tokens: Sequence[int], priority: int = 0,
-            deadline_ms: Optional[float] = None) -> AdmissionVerdict:  # tpulint: serving-loop
+            deadline_ms: Optional[float] = None,
+            slo_class: Optional[str] = None) -> AdmissionVerdict:  # tpulint: serving-loop
         """Route a request.  Continuations forward to the owning
         replica (or join the request's queued migration record — the
         fed-back token is simply the next stream token).  NEW requests
         are placed by the configured policy; a replica's shed verdict
         sends the request to the NEXT candidate, and only when every
         routable replica sheds does the fleet shed (``replica=None`` on
-        the verdict — the 429-equivalent)."""
+        the verdict — the 429-equivalent).  ``slo_class`` (the
+        gateway's resolved ``x-slo-class``) steers the placement's pool
+        on a disaggregated fleet; it never changes admission."""
         owner = self._owner.get(uid)
         if owner is not None:
             v = self._reps[owner].engine.put(uid, tokens,
@@ -438,9 +556,10 @@ class FleetRouter:
             # a revived uid (fleet-shed then re-admitted) gets a FRESH
             # journey — the dead life's story must not leak into it
             ft.begin_journey(uid)
+        pool = self._arrival_pool(slo_class)
         with (ft.span("placement", uid=int(uid)) if ft is not None
               else NOOP_CTX):
-            order, scores = self._rank(tokens)
+            order, scores = self._rank(tokens, pool=pool)
             if self.cfg.placement == "round_robin" and order:
                 # the rotation cursor advances per ARRIVAL, here only —
                 # migration placements also rank (in _place_record) and
@@ -468,15 +587,20 @@ class FleetRouter:
                     # must not drop the now-live request as closed
                     self._closed.pop(uid, None)
                     self._reaped.discard(uid)
+                    self._handed.discard(uid)
                     self._c_placements.inc(policy=self.cfg.placement)
                     if scores.get(name, 0) > 0:
                         self._c_place_hits.inc()
                     if ft is not None:
                         ft.last_placed = name
+                        extra = {}
+                        if pool is not None:
+                            extra = {"pool": pool,
+                                     "slo": slo_class or "standard"}
                         ft.journey_event(
                             uid, "placed", self._steps, replica=name,
                             via="arrival", policy=self.cfg.placement,
-                            score=int(scores.get(name, 0)))
+                            score=int(scores.get(name, 0)), **extra)
                     # the chosen replica may be missing part of the
                     # prompt's chain that a PEER spilled to its tier:
                     # fetch it now, before first admission, so the
@@ -540,7 +664,7 @@ class FleetRouter:
         if last is None:
             return False
         rec, dead = last
-        return rec.status == "migrated" \
+        return rec.status in ("migrated", "handed_off") \
             or (dead and rec.status == "open")
 
     def _note_record_gap(self, uid: int, status: str) -> None:
@@ -616,13 +740,70 @@ class FleetRouter:
             for uid in rep.engine._drain_reaped():
                 self._note_engine_close(rep, uid)
             outs.update(o)
+        # handoffs enqueue BEFORE the migration pump so extraction and
+        # re-placement land in the SAME router step: the decode
+        # replica admits the record at its next schedule pass, inside
+        # its depth-2 dispatch-ahead window — arrival overlaps the
+        # step already in flight and TPOT never stalls on it
+        self._pump_handoffs(outs)
         self._pump_migrations()
         self._refresh_gauges()
         if self._ftel is not None:
             # fleet anomaly signals ride the counters and integer
             # loads this step already produced — no added clock reads
             self._ftel.feed_step(self)
+        if self._autoscaler is not None:
+            # the actuator reads the gauges/anomalies this step just
+            # refreshed and may add_replica/scale_down — membership
+            # changes take effect at the NEXT step's replica loop
+            self._autoscaler.on_router_step()
         return outs
+
+    def _pump_handoffs(self, outs: Dict[int, int]) -> None:  # tpulint: serving-loop
+        """Ship every request that finished prefill this step on a
+        pure-prefill replica to the decode pool (docs/SERVING.md
+        "Disaggregated pools & elasticity").  A uid emitting a token
+        on a prefill replica IS the prefill-done signal — its prompt
+        is fully ingested.  ``engine.handoff_out`` closes it there
+        (``handed_off``) with its KV chain staged into the source
+        tier; the record enqueues for decode-pool placement and the
+        migration pump places it within this same step, after which
+        the chain rides ``_tier_fetch`` to the destination.  The
+        driver's fed-back token joins the queued record exactly like
+        a migration continuation."""
+        if not self._disaggregated():
+            return
+        by_src: Dict[str, List[int]] = {}
+        for uid in outs:
+            name = self._owner.get(uid)
+            if name is None or uid in self._handed:
+                continue
+            rep = self._reps.get(name)
+            if rep is None or rep.dead or rep.role != "prefill":
+                continue
+            by_src.setdefault(name, []).append(uid)
+        for name, uids in by_src.items():
+            rep = self._reps[name]
+            with (self._ftel.span("handoff", replica=name,
+                                  n=len(uids))
+                  if self._ftel is not None else NOOP_CTX):
+                part = rep.engine.handoff_out(uids)
+                for rec in part["requests"]:
+                    uid = int(rec["uid"])
+                    self._owner.pop(uid, None)
+                    self._handed.add(uid)
+                    self._c_handoffs.inc()
+                    if self._ftel is not None:
+                        self._ftel.journey_event(
+                            uid, "handed_off", self._steps,
+                            replica=name, via="prefill_done")
+                    self._migrations.append(_Migration(
+                        rec=rec, source=name, next_step=self._steps,
+                        pool="decode", via="handoff"))
+            for uid in rep.engine._drain_reaped():
+                self._note_engine_close(rep, uid)  # "handed_off": early out
+        while len(self._handed) > 8192:
+            self._handed.pop()
 
     def flush(self, uid: int) -> None:
         """Client-side completion — forwards to the owner and records
@@ -740,16 +921,16 @@ class FleetRouter:
 
     def _note_engine_close(self, rep: ReplicaHandle, uid: int) -> None:
         """An engine-side terminal closure surfaced through that
-        replica's reaped set.  ``migrated`` is NOT a fleet closure —
-        the record is in flight to another replica.  A STALE report is
-        ignored: a uid shed on this replica and then re-admitted on
-        another before the reaped set drained is live THERE — closing
-        it here would orphan the revived request."""
+        replica's reaped set.  ``migrated`` / ``handed_off`` are NOT
+        fleet closures — the record is in flight to another replica.
+        A STALE report is ignored: a uid shed on this replica and then
+        re-admitted on another before the reaped set drained is live
+        THERE — closing it here would orphan the revived request."""
         own = self._owner.get(uid)
         if own is not None and own != rep.name:
             return
         s = rep.engine.query(uid)["status"]
-        if s == "migrated":
+        if s in ("migrated", "handed_off"):
             return
         if s in ("queued", "running"):
             # the engine reaps only at terminal close, so a LIVE status
@@ -835,7 +1016,8 @@ class FleetRouter:
             if m.next_step > self._steps:
                 still.append(m)
                 continue
-            name = self._place_record(m.rec, exclude=m.source)
+            name = self._place_record(m.rec, exclude=m.source,
+                                      pool=m.pool)
             if name is not None:
                 self._owner[uid] = name
                 self._c_migrations.inc()
@@ -843,7 +1025,14 @@ class FleetRouter:
                     self._ftel.last_migration_dest = name
                     self._ftel.journey_event(uid, "placed", self._steps,
                                              replica=name,
-                                             via="migration")
+                                             via=m.via)
+                if m.pool is not None:
+                    # handoff arrival: pull the chain the source just
+                    # staged into its tier (plus anything other peers
+                    # hold) so the destination restages the prefilled
+                    # KV instead of re-prefilling the prompt
+                    self._tier_fetch(uid, name,
+                                     m.rec.get("tokens") or ())
                 continue
             m.attempts += 1
             self._c_migration_retries.inc()
@@ -892,16 +1081,18 @@ class FleetRouter:
         self._migrations = still
 
     def _place_record(self, rec: Dict,
-                      exclude: Optional[str] = None) -> Optional[str]:
+                      exclude: Optional[str] = None,
+                      pool: Optional[str] = None) -> Optional[str]:
         """Place one migration record by the same affinity ranking new
         requests get (its stream's cached chain may still be resident
         somewhere).  The SOURCE replica is excluded — its cached-free
         chain makes it the top affinity score for its own evictee, and
-        a migration that lands back home moved nothing.
+        a migration that lands back home moved nothing.  ``pool``
+        ranks that pool's replicas first (handoffs target decode).
         ``load_snapshot(merge=True)`` bypasses admission bounds — the
         request was admitted by the fleet once; shedding it again
         would double-charge the client."""
-        order, _ = self._rank(rec.get("tokens") or ())
+        order, _ = self._rank(rec.get("tokens") or (), pool=pool)
         for name in order:
             if name == exclude:
                 continue
@@ -1084,9 +1275,45 @@ class FleetRouter:
             "counters": counters,
             "requests": reqs,
             "prefix_index": sorted(prefix),
+            # per-replica attribution (resident ∪ tiered digests) —
+            # what restore_prefix_index() needs to route each prefix
+            # family back to its old replica after a router restart;
+            # the union above keeps its pre-roles schema
+            "replica_prefix_index": {
+                name: sorted(rep.prefix_digests())
+                for name, rep in self._reps.items() if not rep.dead},
+            "roles": {name: rep.role
+                      for name, rep in self._reps.items()
+                      if not rep.dead},
             "replicas": sorted(name for name, rep in self._reps.items()
                                if not rep.dead),
         }
+
+    def restore_prefix_index(self, snap: Dict) -> int:
+        """Seed placement affinity from a PRIOR router generation's
+        :meth:`snapshot` (ROADMAP 1b: cache affinity survives a
+        restart).  Each named replica's digests load as warm
+        placement-only entries (``ReplicaHandle.warm_digests``):
+        affinity scoring sees them immediately, so the restarted fleet
+        routes every prefix family back to the replica that served it
+        — the first visit re-prefills honestly, every later one hits
+        the rebuilt cache.  Replicas the snapshot doesn't name (or
+        that no longer exist) are skipped; falls back to the fleet
+        union for pre-``replica_prefix_index`` snapshots.  Returns
+        the number of digests seeded."""
+        per = snap.get("replica_prefix_index")
+        if per is None:
+            union = snap.get("prefix_index") or ()
+            per = {name: union for name in self._reps}
+        n = 0
+        for name, hexes in per.items():
+            rep = self._reps.get(name)
+            if rep is None or rep.dead:
+                continue
+            for h in hexes:
+                rep.warm_digests.add(bytes.fromhex(h))
+                n += 1
+        return n
 
     # ------------------------------------------------------------------
     # observability
@@ -1103,6 +1330,11 @@ class FleetRouter:
             else:
                 code = _HEALTH_CODE.get(rep.engine.health_state(), 3)
             self._g_rep_health.set(code, replica=name)
+        for pool in ("prefill", "decode"):
+            members = self.pool_members(pool)
+            self._g_pool_replicas.set(len(members), pool=pool)
+            self._g_pool_load.set(sum(r.load() for r in members),
+                                  pool=pool)
 
     def health_state(self) -> str:
         """The fleet's cheap health-LADDER read, mirroring

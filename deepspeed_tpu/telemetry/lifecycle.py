@@ -68,9 +68,15 @@ QUEUE_WAIT_BUCKETS_MS = TTFT_BUCKETS_MS
 #                       engine, while the request lives on at the
 #                       fleet level (docs/SERVING.md "Fleet: routing,
 #                       failover, migration")
+#   handed_off        — prefill finished on a prefill-pool replica and
+#                       the request was shipped to a decode replica
+#                       (engine.handoff_out): like ``migrated``,
+#                       terminal on THIS engine while the stream lives
+#                       on at the fleet level (docs/SERVING.md
+#                       "Disaggregated pools & elasticity")
 TERMINAL_STATUSES = ("finished", "shed", "deadline_exceeded",
                      "context_exhausted", "cancelled", "released",
-                     "failed", "migrated")
+                     "failed", "migrated", "handed_off")
 
 
 @dataclasses.dataclass

@@ -151,6 +151,39 @@ def test_tiered_kv_leg_metrics_are_gated():
                for r in v["regressions"])
 
 
+def test_disagg_autoscale_leg_metrics_are_gated():
+    """The disagg_serving_bench / autoscale_serving_bench legs
+    (docs/SERVING.md "Disaggregated pools & elasticity"): their
+    headline metrics land top-level under names the EXISTING direction
+    rules gate — ``disagg_interactive_speedup`` (colocated TTFT p95
+    rounds over disaggregated: the >1.0 acceptance bar) up-is-better
+    via its ``speedup`` stem, both TTFT ms columns down-is-better,
+    goodput up-is-better — so a PR that erodes the disaggregation win
+    fails a same-fingerprint compare."""
+    assert metric_direction("disagg_interactive_speedup") == 1
+    assert metric_direction("disagg_ttft_p95_interactive_ms") == -1
+    assert metric_direction(
+        "disagg_colocated_ttft_p95_interactive_ms") == -1
+    assert metric_direction("disagg_goodput_tok_s") == 1
+    assert metric_direction("disagg_colocated_goodput_tok_s") == 1
+    # a speedup erosion actually trips the gate...
+    base = {"engine_version": "1", "config_hash": "aaaa",
+            "value": 100.0, "disagg_interactive_speedup": 2.0,
+            "disagg_ttft_p95_interactive_ms": 40.0}
+    worse = dict(base, disagg_interactive_speedup=1.0)
+    v = compare(base, worse)
+    assert not v["ok"]
+    assert any(r["metric"] == "disagg_interactive_speedup"
+               for r in v["regressions"])
+    # ...and so does the leg disappearing from the capture entirely
+    gone = {k: v2 for k, v2 in base.items()
+            if not k.startswith("disagg_")}
+    v = compare(base, gone)
+    assert not v["ok"]
+    assert set(v["only_old"]) == {"disagg_interactive_speedup",
+                                  "disagg_ttft_p95_interactive_ms"}
+
+
 def test_matching_fingerprint_enforces_and_exits_nonzero(tmp_path):
     old = {"engine_version": "1", "config_hash": "aaaa",
            "value": 100.0, "serving_decode_tok_s": 700.0}

@@ -17,9 +17,10 @@ import pytest
 
 from tools.loadgen import (Fault, Request, build_engine, chaos_smoke,
                            default_faults, fleet_chaos_smoke,
-                           http_chaos_smoke, http_smoke, make_trace,
-                           replay, run_sweep, smoke, summarize,
-                           tier_chaos_smoke)
+                           http_chaos_smoke, http_smoke,
+                           make_mixed_slo_trace, make_trace,
+                           replay, run_sweep, scale_chaos_smoke, smoke,
+                           summarize, tier_chaos_smoke)
 
 
 def test_make_trace_deterministic():
@@ -251,6 +252,80 @@ def test_fleet_chaos_observability_plane(fleet_chaos_out):
         assert out["variants"][name]["fleet_dumps"] >= 1
     assert out["checks"]["fleet_timeline_valid"]
     json.dumps(out)
+
+
+def test_make_mixed_slo_trace_deterministic_and_tagged():
+    """The shared mixed-SLO generator (disagg bench + scaling chaos +
+    ``--http`` replays): seeded-deterministic, every request tagged
+    with a gateway class whose priority matches the stock class map,
+    batch prompts longer than interactive ones, and deadlines off by
+    default (wall-clock expiry must not enter tier-1 parity)."""
+    from deepspeed_tpu.gateway.sloclass import default_slo_classes
+
+    a = make_mixed_slo_trace(seed=5, n_requests=20)
+    assert a == make_mixed_slo_trace(seed=5, n_requests=20)
+    assert a != make_mixed_slo_trace(seed=6, n_requests=20)
+    classes = default_slo_classes()
+    assert {q.slo for q in a} == {"interactive", "batch"}
+    for q in a:
+        assert q.priority == classes[q.slo].priority
+        assert q.deadline_ms is None
+    inter = [len(q.prompt) for q in a if q.slo == "interactive"]
+    batch = [len(q.prompt) for q in a if q.slo == "batch"]
+    assert max(inter) < min(batch)
+    # deadlines=True adopts the class map's deadlines verbatim
+    d = make_mixed_slo_trace(seed=5, n_requests=20, deadlines=True)
+    for q in d:
+        assert q.deadline_ms == classes[q.slo].deadline_ms
+
+
+@pytest.fixture(scope="module")
+def scale_chaos_out():
+    """One elasticity run shared by the assertions below (two fleets +
+    minted replicas + references of compile is the expensive part) —
+    identical to ``python -m tools.loadgen --scale-chaos``."""
+    return scale_chaos_smoke(seed=0)
+
+
+def test_scale_chaos_smoke_is_the_elasticity_acceptance_check(
+        scale_chaos_out):
+    """The disaggregation + elasticity bar (docs/SERVING.md
+    "Disaggregated pools & elasticity"): a seeded load swing through a
+    1-prefill + 1-decode fleet with the actuator attached scales the
+    prefill pool UP under the interactive burst and back DOWN through
+    the idle tail — with zero lost requests, exact greedy AND seeded
+    token parity against a fault-free single-engine reference
+    (handoffs and scale actions invisible in the streams), and
+    prefill->decode handoff hops visible in the journeys."""
+    out = scale_chaos_out
+    assert out["ok"] and all(out["checks"].values()), out["checks"]
+    for mode, var in out["variants"].items():
+        assert var["scale_ups"] >= 1, mode
+        assert var["scale_downs"] >= 1, mode
+        assert var["handoffs"] >= 1, mode
+        assert var["statuses"] == {"finished": 10}, mode
+        # per pool, the up-decision precedes the down-decision (the
+        # swing's shape survived hysteresis + cooldown)
+        for pool in ("prefill",):
+            acts = [d["action"] for d in var["decisions"]
+                    if d["pool"] == pool]
+            assert "scale_up" in acts and "scale_down" in acts, mode
+            assert acts.index("scale_up") < acts.index("scale_down")
+    json.dumps(out)
+
+
+def test_scale_chaos_cold_start_is_weight_streamed(scale_chaos_out):
+    """Satellite bar: scale-up cold start rides the NVMe weight store
+    (``WeightStreamColdStart``) — every variant restored minted-replica
+    weights from the spilled store, and the smoke's internal checks
+    verified the minted engines keep weights RESIDENT (no
+    ``weight_stream`` config, ``_stream is None`` — decode bursts /
+    spec decode are not forced off) while serving within the replay."""
+    out = scale_chaos_out
+    for mode, var in out["variants"].items():
+        assert var["cold_start_restores"] >= 1, mode
+        assert out["checks"][f"{mode}_minted_weights_resident"], mode
+        assert out["checks"][f"{mode}_cold_start_restored"], mode
 
 
 def test_replay_restart_needs_factory():

@@ -485,6 +485,198 @@ class TestFailoverMigration:
 
 
 # --------------------------------------------------------------------------
+# disaggregated prefill/decode pools + elasticity
+# --------------------------------------------------------------------------
+
+class TestDisaggregation:
+    """Disaggregated pools (docs/SERVING.md "Disaggregated pools &
+    elasticity"): role plumbing, SLO-steered pool placement, the
+    prefill->decode handoff with exact token parity, prefix-index
+    persistence across a router restart (ROADMAP 1b), and the
+    weight-stream scale-up cold start.  The full elasticity swing
+    (actuator scales up AND down under load, zero lost) lives in
+    tools/loadgen.scale_chaos_smoke, asserted tier-1 via
+    tests/test_loadgen.py."""
+
+    def test_roles_validated_and_prefill_chunk_cleared(self, model):
+        with pytest.raises(ValueError, match="role"):
+            FleetRouter({"r0": make_engine(model)}, roles={"r0": "gpu"})
+        eng = make_engine(model,
+                          overload=OverloadConfig(prefill_chunk=8))
+        router = FleetRouter({"p0": eng, "d0": make_engine(model)},
+                             roles={"p0": "prefill", "d0": "decode"})
+        assert router.replica("p0").role == "prefill"
+        assert router.replica("d0").role == "decode"
+        # a prefill replica ingests prompts chunk-FREE: its whole
+        # budget is one prompt's time-to-handoff, nothing decodes
+        # behind it worth interleaving for
+        assert eng.ocfg.prefill_chunk is None
+
+    def test_slo_class_steers_pool_placement(self, model):
+        router = FleetRouter({"p0": make_engine(model),
+                              "d0": make_engine(model)},
+                             roles={"p0": "prefill", "d0": "decode"})
+        # interactive (and untagged) arrivals prefill on the prefill
+        # pool; batch arrivals skip the handoff and place straight on
+        # decode
+        assert router.put(0, [1, 2, 3, 4],
+                          slo_class="interactive").replica == "p0"
+        assert router.put(1, [5, 6, 7, 8],
+                          slo_class="batch").replica == "d0"
+        assert router.put(2, [9, 10, 11, 12]).replica == "p0"
+        # a mixed fleet ignores the tag: no pool split to steer
+        mixed = FleetRouter({"r0": make_engine(model),
+                             "r1": make_engine(model)})
+        v = mixed.put(0, [1, 2, 3, 4], slo_class="interactive")
+        assert v.admitted
+
+    def test_prefill_done_hands_off_with_exact_parity(self, model):
+        """First token on a prefill replica triggers the handoff: the
+        request's record (and, tier on, its KV chain) moves to the
+        decode pool, the stream stays token-identical to a
+        single-engine run — greedy and seeded — and the journey shows
+        handed_off -> placed(decode) -> closed."""
+        prompts = {0: [3, 1, 4, 1, 5, 9, 2, 6], 1: [2, 7, 1, 8, 2, 8]}
+        for sp, rng in ((None, None),
+                        (SamplingParams(temperature=0.7, top_k=40,
+                                        max_new_tokens=1 << 30),
+                         jax.random.PRNGKey(7))):
+            ref = drive(FleetRouter({"solo": make_engine(model)}),
+                        prompts, n_tok=5, sampling=sp, rng=rng)
+            router = FleetRouter(
+                {"p0": make_engine(model), "d0": make_engine(model)},
+                FleetConfig(telemetry="on"),
+                roles={"p0": "prefill", "d0": "decode"})
+            got = drive(router, prompts, n_tok=5, sampling=sp, rng=rng)
+            assert got == ref, "handoff changed a token stream"
+            assert int(router.metrics.get(
+                "serving_fleet_handoffs_total").value()) == len(prompts)
+            for u in prompts:
+                assert router.query(u)["status"] == "finished"
+                # the prefill replica closed its side terminal
+                # handed_off (in TERMINAL_STATUSES — tpulint's
+                # terminal-exhaustive family counts it)
+                assert router.replica("p0").engine.query(
+                    u)["status"] == "handed_off"
+                j = router.request_journey(u) or []
+                evs = [e["event"] for e in j]
+                assert "handed_off" in evs
+                k = evs.index("handed_off")
+                assert "placed" in evs[k:]
+                placed_after = next(e for e in j[k:]
+                                    if e["event"] == "placed")
+                assert placed_after["replica"] == "d0"
+                assert j[-1]["event"] == "closed"
+
+    def test_prefix_index_survives_router_restart(self, model):
+        """ROADMAP 1b: the fleet snapshot persists each replica's
+        prefix index; a restarted router seeded through
+        ``restore_prefix_index`` routes every prefix family back to
+        its old replica — the post-restart placement affinity MATCHES
+        the continuing fleet's, and beats a cold restart that lost the
+        index."""
+        import dataclasses
+
+        from tools.loadgen import _fleet_prefix_trace, replay_fleet
+
+        trace = _fleet_prefix_trace(seed=1, n_requests=12,
+                                    n_families=3, prefix_blocks=3)
+        first, rest = trace[:6], trace[6:]
+
+        def fresh():
+            return FleetRouter(
+                {f"r{i}": make_engine(model, num_kv_blocks=48)
+                 for i in range(3)})
+
+        def run(router, reqs):
+            res = replay_fleet(
+                router, [dataclasses.replace(q) for q in reqs])
+            return res["placements"]
+
+        routerA = fresh()
+        p1 = run(routerA, first)
+        # each family's phase-1 home, keyed by its shared prefix
+        fam_home = {}
+        for q in first:
+            fam_home.setdefault(tuple(q.prompt[:24]), p1[q.uid])
+        snap = routerA.snapshot()
+        assert "replica_prefix_index" in snap
+
+        def home_match(placements):
+            return sum(
+                1 for q in rest
+                if placements[q.uid] == fam_home[tuple(q.prompt[:24])]
+            ) / len(rest)
+
+        # continuing fleet: affinity keeps every family home
+        match_cont = home_match(run(routerA, rest))
+        # warm restart: fresh engines (caches EMPTY), index restored
+        warm = fresh()
+        assert warm.restore_prefix_index(snap) > 0
+        assert any(warm.replica(n).warm_digests
+                   for n in warm.replica_names)
+        match_warm = home_match(run(warm, rest))
+        # cold restart: the index is gone with the process
+        match_cold = home_match(run(fresh(), rest))
+        assert match_cont == 1.0
+        assert match_warm == match_cont, \
+            f"post-restart affinity {match_warm} != continuing " \
+            f"{match_cont}"
+        assert match_warm > match_cold
+        # and the warm router's placement plane counted real affinity
+        hits = int(sum(v for _, v in warm.metrics.get(
+            "serving_fleet_placement_affinity_hits_total").series()))
+        assert hits > 0
+
+    def test_scale_up_cold_start_through_weight_stream(self, model,
+                                                       tmp_path):
+        """Satellite bar: ``add_replica`` cold start rides the NVMe
+        weight store — the minted engine's block weights are
+        bit-identical restores of the template's, weights stay
+        RESIDENT (no ``weight_stream`` config: decode bursts / spec
+        decode are not forced off), and first tokens flow within a
+        bounded step count."""
+        from deepspeed_tpu.serving import WeightStreamColdStart
+
+        template = make_engine(model)
+        cold = WeightStreamColdStart(template,
+                                     lambda: make_engine(model),
+                                     str(tmp_path / "wstore"))
+        eng = cold("decode")
+        assert cold.restores == 1
+        for a, b in zip(jax.tree.leaves(template.params["blocks"]),
+                        jax.tree.leaves(eng.params["blocks"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # resident-weight modes are NOT forced off the minted replica
+        assert eng._stream is None
+        assert eng.icfg.weight_stream is None
+        router = FleetRouter({"p0": make_engine(model)})
+        router.add_replica("as-decode-1", eng, role="decode")
+        assert router.replica("as-decode-1").role == "decode"
+        v = router.put(0, [1, 2, 3, 4], slo_class="batch")
+        assert v.admitted and v.replica == "as-decode-1"
+        for n in range(4):                   # bounded: never a wedge
+            outs = router.step()
+            if 0 in outs:
+                break
+        assert 0 in outs, "minted replica never emitted a first token"
+
+    def test_autoscaler_config_validation(self):
+        from deepspeed_tpu.serving import AutoscalerConfig
+
+        with pytest.raises(ValueError, match="dead band|down_load"):
+            AutoscalerConfig(up_load=1.0, down_load=2.0)
+        with pytest.raises(ValueError, match="minimums"):
+            AutoscalerConfig(min_prefill=0)
+        with pytest.raises(ValueError, match="maximums"):
+            AutoscalerConfig(min_decode=3, max_decode=2)
+        with pytest.raises(ValueError, match="hysteresis"):
+            AutoscalerConfig(hysteresis_steps=0)
+        with pytest.raises(ValueError, match="evaluate_every"):
+            AutoscalerConfig(evaluate_every=0)
+
+
+# --------------------------------------------------------------------------
 # drain / snapshot — the engine-shaped seam verbs
 # --------------------------------------------------------------------------
 

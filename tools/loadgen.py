@@ -65,6 +65,10 @@ class Request:
     priority: int = 0
     deadline_ms: Optional[float] = None
     max_new: int = 4
+    # gateway SLO class tag ("interactive" / "standard" / "batch"):
+    # rides `x-slo-class` on the wire and `router.put(slo_class=)`
+    # in-process, steering the disaggregated pool split.  None = untagged
+    slo: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -144,6 +148,51 @@ def make_trace(seed: int = 0, n_requests: int = 32, qps: float = 2.0,
                 deadline_ms=deadline_ms,
                 max_new=int(r.randint(out_lens[0], out_lens[1] + 1))))
             i += 1
+    return out
+
+
+def make_mixed_slo_trace(seed: int = 0, n_requests: int = 16,
+                         qps: float = 8.0, step_ms: float = 50.0,
+                         interactive_frac: float = 0.5,
+                         prompt_lens: Tuple[int, int] = (6, 20),
+                         batch_prompt_lens: Tuple[int, int] = (28, 64),
+                         out_lens: Tuple[int, int] = (2, 5),
+                         batch_out_lens: Tuple[int, int] = (4, 8),
+                         deadlines: bool = False,
+                         vocab: int = 120, uid0: int = 0) -> List[Request]:
+    """Seeded mixed-SLO trace — the ONE workload shape the disagg
+    bench leg, the scaling chaos leg, and the ``--http`` replays all
+    share: TTFT-sensitive ``interactive`` requests (short prompts,
+    short outputs) interleaved with throughput-oriented ``batch``
+    requests (long prompts — the head-of-line blockers disaggregation
+    exists to get out of the interactive path), each tagged with the
+    gateway SLO class it would present as ``x-slo-class`` on the wire.
+    Priorities come from :func:`default_slo_classes` so in-process and
+    over-HTTP replays admit identically; deadlines stay None unless
+    ``deadlines=True`` — wall-clock expiry would make tier-1 token
+    parity machine-dependent."""
+    from deepspeed_tpu.gateway.sloclass import default_slo_classes
+
+    classes = default_slo_classes()
+    r = np.random.RandomState(seed + 41)
+    out: List[Request] = []
+    t = 0.0
+    for i in range(n_requests):
+        t += float(r.exponential(1.0 / max(qps, 1e-9)))
+        interactive = bool(r.random_sample() < interactive_frac)
+        name = "interactive" if interactive else "batch"
+        plo, phi = prompt_lens if interactive else batch_prompt_lens
+        olo, ohi = out_lens if interactive else batch_out_lens
+        cls = classes[name]
+        out.append(Request(
+            uid=uid0 + i,
+            step=int(t * 1e3 / step_ms),
+            prompt=[int(x) for x in
+                    r.randint(1, vocab, int(r.randint(plo, phi + 1)))],
+            priority=cls.priority,
+            deadline_ms=cls.deadline_ms if deadlines else None,
+            max_new=int(r.randint(olo, ohi + 1)),
+            slo=name))
     return out
 
 
@@ -734,18 +783,21 @@ def chaos_smoke(seed: int = 0) -> Dict:
 # --------------------------------------------------------------------------
 
 def build_fleet(n_replicas: int = 3, model=None, fleet_cfg=None,
-                **engine_kw):
+                roles: Optional[Dict[str, str]] = None, **engine_kw):
     """A :class:`~deepspeed_tpu.serving.FleetRouter` over ``n_replicas``
     tiny engines sharing one model (names ``r0..``); engine keywords
     ride through :func:`build_engine`, fleet knobs through
-    ``fleet_cfg`` (a :class:`FleetConfig` — None takes the defaults)."""
+    ``fleet_cfg`` (a :class:`FleetConfig` — None takes the defaults).
+    ``roles`` maps replica names to pool roles (``prefill`` /
+    ``decode`` / ``mixed``) for a disaggregated fleet — unnamed
+    replicas stay ``mixed``."""
     from deepspeed_tpu.serving import FleetRouter
 
     engines = {}
     for i in range(n_replicas):
         eng, model = build_engine(model=model, **engine_kw)
         engines[f"r{i}"] = eng
-    return FleetRouter(engines, fleet_cfg), model
+    return FleetRouter(engines, fleet_cfg, roles=roles), model
 
 
 def check_fleet_invariants(router) -> None:
@@ -877,7 +929,8 @@ def replay_fleet(router, trace: List[Request],
         for q in arrivals.get(step, ()):
             t_arrive[q.uid] = time.perf_counter()
             v = router.put(q.uid, q.prompt, priority=q.priority,
-                           deadline_ms=q.deadline_ms)
+                           deadline_ms=q.deadline_ms,
+                           slo_class=q.slo)
             verdicts[q.uid] = v.status
             placements[q.uid] = v.replica
             if v.admitted:
@@ -1553,6 +1606,265 @@ def fleet_bench(seed: int = 0, n_requests: int = 18) -> Dict:
             "round_robin": rr}
 
 
+def scale_chaos_smoke(seed: int = 0) -> Dict:
+    """The disaggregation + elasticity acceptance bar (docs/SERVING.md
+    "Disaggregated pools & elasticity"): a 1-prefill + 1-decode fleet
+    (KV tier ON, so prefill->decode handoffs ship finished chains over
+    the tier-export path instead of re-prefilling) with the
+    signal-driven :class:`Autoscaler` attached, driven through a
+    seeded load swing — an interactive burst that must scale the
+    prefill pool UP, then a lone long batch tail that keeps the fleet
+    stepping at near-zero prefill load until it scales back DOWN —
+    under greedy and seeded sampling.  Asserts:
+
+    * attaching the actuator flips the router's ``telemetry="auto"``
+      plane ON (it resolved OFF before — the actuator IS the consumer
+      "auto" waits for);
+    * the swing produces >= 1 prefill scale-UP and >= 1 prefill
+      scale-DOWN decision (hysteresis + cooldown respected by
+      construction — the knobs are step counts);
+    * scale-up cold start rides :class:`WeightStreamColdStart`: the
+      minted replica restored its block weights from the NVMe weight
+      store, and its engine keeps them RESIDENT (``_stream is None``,
+      no ``weight_stream`` config — decode bursts / spec decode are
+      not forced off);
+    * zero lost requests (every uid exactly one fleet-terminal
+      ``finished`` — the scale-down drain re-places, never sheds) and
+      EXACT token parity for every stream — handed-off and
+      scaled-around alike — against a fault-free single-engine
+      reference, greedy and seeded;
+    * interactive journeys show the prefill->decode ``handed_off`` hop
+      and re-placement; scale decisions land in the router's flight
+      recorder and the ``serving_fleet_scale_*`` counters;
+    * per-step fleet invariants + zero block leaks on live replicas."""
+    import os
+    import tempfile
+
+    import jax
+
+    from deepspeed_tpu.inference import FailureConfig, SamplingParams
+    from deepspeed_tpu.serving import (Autoscaler, AutoscalerConfig,
+                                       FleetConfig, FleetRouter,
+                                       WeightStreamColdStart)
+
+    # the swing: a compressed interactive-heavy burst (arrivals land in
+    # the first few steps) that overruns one prefill replica, then ONE
+    # long batch tail that keeps the replay loop alive while the
+    # prefill pool idles through cooldown + hysteresis into scale-down
+    burst = make_mixed_slo_trace(seed, n_requests=9, qps=60.0,
+                                 interactive_frac=0.75,
+                                 batch_prompt_lens=(24, 40))
+    tail_r = np.random.RandomState(seed + 53)
+    tail = Request(uid=900, step=max(q.step for q in burst) + 4,
+                   prompt=[int(x) for x in tail_r.randint(1, 120, 12)],
+                   priority=2, max_new=24, slo="batch")
+    trace = burst + [tail]
+
+    samplers = {
+        "greedy": (SamplingParams(max_new_tokens=1 << 30), None),
+        "seeded": (SamplingParams(temperature=0.8, top_k=40,
+                                  max_new_tokens=1 << 30),
+                   jax.random.PRNGKey(37)),
+    }
+    model_box: list = []
+    out: Dict = {"variants": {}}
+    checks: Dict[str, bool] = {}
+    for mode, (sp, rng) in samplers.items():
+        root = tempfile.mkdtemp(prefix=f"scale_chaos_{mode}_")
+        mint_n = [0]
+
+        def eng_factory(tag, tiered=True):
+            kw = dict(kv_tier="on",
+                      kv_tier_dir=os.path.join(root, tag)) \
+                if tiered else {}
+            eng, m = build_engine(
+                None, model=model_box[0] if model_box else None,
+                prefix_cache="on",
+                failure=FailureConfig(dispatch_timeout_ms=None), **kw)
+            if not model_box:
+                model_box.append(m)
+            return eng
+
+        def mint_build():
+            mint_n[0] += 1
+            return eng_factory(f"mint{mint_n[0]}")
+
+        router = FleetRouter(
+            {"p0": eng_factory("p0"), "d0": eng_factory("d0")},
+            FleetConfig(),        # telemetry default "auto": OFF here
+            roles={"p0": "prefill", "d0": "decode"})
+        checks[f"{mode}_telemetry_auto_off"] = router._ftel is None
+        cold = WeightStreamColdStart(router.replica("d0").engine,
+                                     mint_build,
+                                     os.path.join(root, "wstore"))
+        scaler = Autoscaler(router, cold, AutoscalerConfig(
+            max_prefill=2, max_decode=2, up_load=1.5, down_load=0.75,
+            hysteresis_steps=2, cooldown_steps=4))
+        checks[f"{mode}_telemetry_auto_on"] = router._ftel is not None
+
+        # fault-free SINGLE-ENGINE reference: the pool split, the
+        # handoffs, and every scale action must be invisible in the
+        # token streams ((uid, position)-folded sampling keys)
+        ref = eng_factory("ref", tiered=False)
+        refs = replay(ref, trace, [], sampling=sp, rng=rng)["tokens"]
+
+        res = replay_fleet(router, trace, [], sampling=sp, rng=rng,
+                           check_invariants=True)
+
+        checks[f"{mode}_zero_lost"] = all(
+            s == "finished" for s in res["status"].values())
+        checks[f"{mode}_parity"] = all(
+            res["tokens"].get(q.uid, []) == refs.get(q.uid, [])
+            for q in trace)
+        summ = scaler.summary()
+        ups = [d for d in summ["decisions"]
+               if d["action"] == "scale_up" and d["pool"] == "prefill"]
+        downs = [d for d in summ["decisions"]
+                 if d["action"] == "scale_down"
+                 and d["pool"] == "prefill"]
+        # the scale counters are labeled pool= — sum the series
+        ctr = {n: int(sum(v for _, v in
+                          router.metrics.get(n).series()))
+               for n in ("serving_fleet_scale_ups_total",
+                         "serving_fleet_scale_downs_total")}
+        checks[f"{mode}_scaled_up"] = len(ups) >= 1 \
+            and ctr["serving_fleet_scale_ups_total"] >= 1
+        checks[f"{mode}_scaled_down"] = len(downs) >= 1 \
+            and ctr["serving_fleet_scale_downs_total"] >= 1
+        checks[f"{mode}_scale_decisions_in_flight"] = any(
+            e["kind"] == "scale_decision"
+            for e in router.flight.events())
+        checks[f"{mode}_cold_start_restored"] = cold.restores >= 1
+        minted = [n for n in router.replica_names
+                  if n.startswith("as-")]
+        checks[f"{mode}_minted_weights_resident"] = bool(minted) and all(
+            router.replica(n).engine._stream is None
+            and router.replica(n).engine.icfg.weight_stream is None
+            for n in minted)
+        # interactive journeys: the prefill->decode hop is visible —
+        # handed_off on the prefill owner, then placed on a decode-pool
+        # replica, and the journey closes
+        handed = 0
+        jok = True
+        for q in burst:
+            if q.slo != "interactive":
+                continue
+            j = router.request_journey(q.uid) or []
+            evs = [e["event"] for e in j]
+            if "handed_off" in evs:
+                handed += 1
+                k = evs.index("handed_off")
+                jok = jok and "placed" in evs[k:] \
+                    and j[-1]["event"] == "closed"
+        checks[f"{mode}_handoffs_journeyed"] = handed >= 1 and jok
+        checks[f"{mode}_handoff_counter"] = int(router.metrics.get(
+            "serving_fleet_handoffs_total").value()) >= handed
+        # live replicas fully reclaimed their pools
+        clean = True
+        for n in router.replica_names:
+            rep = router.replica(n)
+            if rep.dead:
+                continue
+            al = rep.engine.state.allocator
+            al.assert_invariants()
+            clean &= al.free_blocks == al.total_blocks
+        checks[f"{mode}_no_leak"] = clean
+        out["variants"][mode] = {
+            "steps": res["steps"],
+            "statuses": {s: list(res["status"].values()).count(s)
+                         for s in set(res["status"].values())},
+            "decisions": summ["decisions"],
+            "scale_ups": summ["scale_ups"],
+            "scale_downs": summ["scale_downs"],
+            "handoffs": int(router.metrics.get(
+                "serving_fleet_handoffs_total").value()),
+            "cold_start_restores": cold.restores,
+        }
+    out["checks"] = checks
+    out["ok"] = all(checks.values())
+    if not out["ok"]:
+        raise AssertionError(
+            "scale chaos smoke failed: "
+            f"{json.dumps({k: v for k, v in checks.items() if not v})}")
+    return out
+
+
+def disagg_bench(seed: int = 0, n_requests: int = 24) -> Dict:
+    """The disaggregation BENCH leg (docs/SERVING.md "Disaggregated
+    pools & elasticity"): ONE seeded mixed-SLO trace
+    (:func:`make_mixed_slo_trace` — the same generator the scaling
+    chaos leg and the ``--http`` replays share) through two arms at
+    EQUAL replica count:
+
+    * **colocated** — 3 mixed replicas, chunked prefill on (the
+      strongest colocated baseline: batch prompts already yield the
+      token budget in slices);
+    * **disaggregated** — 2 prefill + 1 decode replicas; interactive
+      requests prefill chunk-FREE on the prefill pool and hand their
+      chains to the decode replica, batch requests place straight on
+      decode.
+
+    Records interactive TTFT p95 per arm in deterministic step rounds
+    (arrival step -> first-token step, inclusive: >= 1) and wall ms,
+    goodput, handoff counts, and the headline
+    ``disagg_interactive_speedup`` ratio (colocated p95 rounds over
+    disaggregated p95 rounds — > 1.0 means moving batch prompts out of
+    the interactive path bought TTFT at identical hardware)."""
+    from deepspeed_tpu.inference import FailureConfig, SamplingParams
+    from deepspeed_tpu.inference.overload import OverloadConfig
+    from deepspeed_tpu.serving import FleetConfig
+
+    sp = SamplingParams(max_new_tokens=1 << 30)
+    trace = make_mixed_slo_trace(seed, n_requests=n_requests, qps=12.0,
+                                 interactive_frac=0.5)
+    interactive = {q.uid for q in trace if q.slo == "interactive"}
+    arrive = {q.uid: q.step for q in trace}
+    model_box: list = []
+
+    def run(roles, chunk):
+        router, _ = build_fleet(
+            3, model=model_box[0] if model_box else None,
+            fleet_cfg=FleetConfig(telemetry="on"),
+            roles=roles, prefix_cache="on",
+            overload=OverloadConfig(prefill_chunk=chunk),
+            failure=FailureConfig(dispatch_timeout_ms=None))
+        if not model_box:
+            model_box.append(_)
+        t0 = time.perf_counter()
+        res = replay_fleet(router, trace, [], sampling=sp)
+        wall = time.perf_counter() - t0
+        n_tok = sum(len(t) for t in res["tokens"].values())
+        # TTFT in whole step ROUNDS (arrival step to first-token step,
+        # inclusive), the machine-independent form; wall ms rides along
+        rounds = [res["ttft_steps"][u] + 1 for u in res["ttft_steps"]
+                  if u in interactive]
+        ms = [m for u, m in res["ttft_ms"].items() if u in interactive]
+        return {
+            "roles": {n: router.replica(n).role
+                      for n in router.replica_names},
+            "finished": sum(1 for s in res["status"].values()
+                            if s == "finished"),
+            "goodput_tok_s": round(n_tok / max(wall, 1e-9), 2),
+            "ttft_p95_interactive_rounds": _pct(rounds, 95),
+            "ttft_p95_interactive_ms": _pct(ms, 95),
+            "handoffs": int(router.metrics.get(
+                "serving_fleet_handoffs_total").value()),
+        }, res
+
+    colocated, _res_c = run(None, 8)
+    disagg, _res_d = run({"r0": "prefill", "r1": "prefill",
+                          "r2": "decode"}, 8)
+    speedup = (colocated["ttft_p95_interactive_rounds"]
+               / max(disagg["ttft_p95_interactive_rounds"], 1e-9)) \
+        if colocated["ttft_p95_interactive_rounds"] is not None \
+        and disagg["ttft_p95_interactive_rounds"] is not None else None
+    return {"seed": seed, "requests": n_requests,
+            "interactive": len(interactive),
+            "colocated": colocated, "disagg": disagg,
+            "disagg_interactive_speedup":
+                round(speedup, 4) if speedup is not None else None}
+
+
 def tiered_kv_bench(seed: int = 0) -> Dict:
     """BENCH leg for the tiered KV cache (docs/KV_TIERING.md): a
     revisit-heavy shared-prefix workload whose prefix working set is
@@ -1860,7 +2172,9 @@ def replay_http(host: str, port: int, trace: List[Request],
     the (uid, position)-folded sampling keys make seeded streams
     byte-comparable to the in-process reference.  ``disconnects``:
     ``{uid: token_offset}`` — those clients abandon their connection
-    mid-stream (the failure mode only a network creates).
+    mid-stream (the failure mode only a network creates).  A request's
+    own ``slo`` tag (``make_mixed_slo_trace``) rides as its
+    ``x-slo-class`` header, overriding the replay-wide ``slo``.
 
     Returns the wire-side analogue of :func:`replay`'s bookkeeping:
     per-uid tokens/statuses plus client-measured TTFT/TPOT and HTTP
@@ -1883,7 +2197,8 @@ def replay_http(host: str, port: int, trace: List[Request],
         if q.deadline_ms is not None:
             payload["deadline_ms"] = q.deadline_ms
         try:
-            r = http_completion(host, port, payload, slo=slo,
+            r = http_completion(host, port, payload,
+                                slo=q.slo if q.slo is not None else slo,
                                 disconnect_after=disconnects.get(q.uid))
         except (OSError, ValueError, ConnectionError) as e:
             r = {"code": None, "tokens": [], "ttft_ms": None,
@@ -2274,6 +2589,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="tiered-KV chaos leg: spill-file corruption "
                          "rejected by checksum + replica killed "
                          "mid-restage, zero lost, exact parity")
+    ap.add_argument("--scale-chaos", action="store_true",
+                    help="disaggregated-pool elasticity leg: seeded "
+                    "load swing scales the prefill pool up and back "
+                    "down, zero lost, exact parity, handoff journeys")
+    ap.add_argument("--disagg-bench", action="store_true",
+                    help="disaggregation bench: colocated vs "
+                    "prefill/decode pools at equal replica count under "
+                    "one mixed-SLO trace")
     ap.add_argument("--fleet-bench", action="store_true",
                     help="fleet bench sweep: 1 vs 3 replicas with a "
                     "mid-sweep kill, affinity vs round-robin")
@@ -2305,6 +2628,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.tier_chaos:
         result = tier_chaos_smoke(args.seed)
+    elif args.scale_chaos:
+        result = scale_chaos_smoke(args.seed)
+    elif args.disagg_bench:
+        result = disagg_bench(args.seed)
     elif args.fleet_chaos:
         result = fleet_chaos_smoke(args.seed)
     elif args.fleet_bench:
