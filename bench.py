@@ -1238,7 +1238,7 @@ def serving_bench(on_tpu: bool):
         kv_block_size=64 if on_tpu else 16,
         num_kv_blocks=1024 if on_tpu else 32,
         decode_burst=8 if on_tpu else 2,
-        device_telemetry="on", anomaly="on"))
+        device_telemetry="on", anomaly="on", slo="on"))
     r = np.random.RandomState(0)
     sp = SamplingParams(temperature=0.0, max_new_tokens=1 << 30)
     vocab = model.config.vocab_size
@@ -1253,8 +1253,10 @@ def serving_bench(on_tpu: bool):
     #                         must not contaminate the reported aggregate
 
     # --- TTFT: enqueue all prompts, time each seq's first sampled token
+    # (alternating SLO classes so the embedded scorecard is per-class)
     for uid in range(n_seqs):
-        eng.put(uid, list(r.randint(0, vocab, prompt_len)))
+        eng.put(uid, list(r.randint(0, vocab, prompt_len)),
+                slo_class="interactive" if uid % 2 == 0 else "batch")
     t0 = time.perf_counter()
     ttft = {}
     while len(ttft) < n_seqs:
@@ -1296,7 +1298,11 @@ def serving_bench(on_tpu: bool):
             # streaming-detector tally of the leg (anomaly counts are
             # report-only in benchdiff — a noisy rig fires latency
             # detectors without being a regression)
-            "serving_anomalies": eng.anomaly_summary()}
+            "serving_anomalies": eng.anomaly_summary(),
+            # per-class SLO scorecard (docs/OBSERVABILITY.md "SLOs &
+            # error budgets"); benchdiff reports attainment/budget
+            # deltas report-only, same policy as the anomaly counts
+            "serving_slo": eng.slo_scorecard()}
 
 
 if __name__ == "__main__":

@@ -100,6 +100,15 @@ class GatewayConfig:
     check_invariants: bool = False   # allocator/record checks per pump
     journey_retention: int = 256     # wire journeys kept (ring)
 
+    # ops plane (docs/OBSERVABILITY.md "SLOs & error budgets"): the
+    # ``GET /debug/*`` surface — "auto"|"on"|"off", auto resolves OFF
+    # (exposing internals on the wire is an operator opt-in, never
+    # ambient).  ops_token guards the MUTATING endpoints (``POST
+    # /debug/dump`` / ``/debug/capture``): with no token configured
+    # they refuse (403) even when the read surface is on.
+    ops: str = "auto"
+    ops_token: Optional[str] = None
+
 
 class _Finish:
     """Queue sentinel: the stream ended with ``reason``."""
@@ -124,6 +133,35 @@ class _Stream:
     finished: bool = False
     finish_reason: Optional[str] = None
     disconnected: bool = False
+
+
+def _query_params(query: str) -> Dict[str, Optional[str]]:
+    """Minimal ``k=v&flag`` query parsing for the ops routes (no
+    percent-decoding — ops values are ints and bare flags)."""
+    params: Dict[str, Optional[str]] = {}
+    for part in query.split("&"):
+        if not part:
+            continue
+        k, sep, v = part.partition("=")
+        params[k] = v if sep else None
+    return params
+
+
+def _jsonable(obj):
+    """Config objects -> JSON-safe trees for ``GET /debug/config``:
+    dataclasses expand field-by-field, anything non-primitive falls
+    back to ``repr`` (a resolved config must always serialize — an
+    exotic field value can't take the route down)."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: _jsonable(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)}
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if obj is None or isinstance(obj, (str, int, float, bool)):
+        return obj
+    return repr(obj)
 
 
 # engine-side terminal statuses -> the finish_reason the wire reports
@@ -158,6 +196,10 @@ class Gateway:
             raise GatewayError(
                 f"default_slo_class {self.cfg.default_slo_class!r} is not "
                 f"in the class map {sorted(self._slo)}")
+        if self.cfg.ops not in ("auto", "on", "off"):
+            raise GatewayError(
+                f"ops={self.cfg.ops!r}: expected 'auto', 'on', or 'off'")
+        self._ops_on = self.cfg.ops == "on"
 
         # ONE engine thread: every backend touch is serialized here
         self._exec = ThreadPoolExecutor(
@@ -597,6 +639,12 @@ class Gateway:
                 self._c_requests.inc(route="completions")
                 watcher = await self._route_completions(
                     reader, writer, headers, body)
+            elif self._ops_on \
+                    and target.partition("?")[0].startswith("/debug/"):
+                # ops OFF intentionally skips this branch: the whole
+                # surface 404s below, indistinguishable from absent
+                self._c_requests.inc(route="debug")
+                await self._route_debug(method, target, headers, writer)
             elif target in ("/healthz", "/metrics", "/v1/completions"):
                 await self._send_error(writer, protocol.ProtocolError(
                     405, "method_not_allowed",
@@ -656,6 +704,171 @@ class Gateway:
         await self._send(writer, protocol.http_response(
             200, text.encode("utf-8"),
             content_type="text/plain; version=0.0.4"))
+
+    # ------------------------------------------------------------------
+    # ops plane: /debug/* (docs/OBSERVABILITY.md "SLOs & error
+    # budgets").  Read-only routes are gated by GatewayConfig.ops;
+    # the mutators additionally by the ops token.  Every backend
+    # touch still rides the single-executor _call seam.
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _require_method(method: str, want: str, path: str) -> None:
+        if method != want:
+            raise protocol.ProtocolError(
+                405, "method_not_allowed",
+                f"{method} not supported on {path}")
+
+    def _check_ops_token(self, headers: Dict[str, str]) -> None:
+        """Mutating-endpoint gate: no configured token refuses outright
+        (403 — a deployment opts into remote dump/capture by setting
+        one); a missing header is 401 (client never authenticated), a
+        mismatched one 403."""
+        if not self.cfg.ops_token:
+            raise protocol.ProtocolError(
+                403, "ops_mutations_disabled",
+                "mutating /debug/* requires GatewayConfig.ops_token "
+                "to be configured")
+        got = headers.get("x-ops-token")
+        if got is None:
+            raise protocol.ProtocolError(
+                401, "missing_ops_token",
+                "x-ops-token header required")
+        if got != self.cfg.ops_token:
+            raise protocol.ProtocolError(
+                403, "bad_ops_token", "x-ops-token mismatch")
+
+    async def _send_json(self, writer, obj) -> None:
+        await self._send(writer, protocol.http_response(
+            200, json.dumps(obj).encode("utf-8")))
+
+    async def _route_debug(self, method: str, target: str,
+                           headers: Dict[str, str], writer) -> None:
+        path, _, query = target.partition("?")
+        if path == "/debug/slo":
+            self._require_method(method, "GET", path)
+            await self._send_json(
+                writer, await self._call(self.backend.slo_scorecard))
+        elif path.startswith("/debug/journeys/"):
+            self._require_method(method, "GET", path)
+            await self._route_debug_journey(path, writer)
+        elif path == "/debug/anomalies":
+            self._require_method(method, "GET", path)
+            params = _query_params(query)
+            if "tail" in params:
+                await self._anomaly_tail(writer, params.get("tail"))
+            else:
+                await self._send_json(
+                    writer, await self._call(self._ops_anomalies))
+        elif path == "/debug/config":
+            self._require_method(method, "GET", path)
+            await self._send_json(writer,
+                                  await self._call(self._ops_config))
+        elif path == "/debug/dump":
+            self._require_method(method, "POST", path)
+            self._check_ops_token(headers)
+            d = await self._call(self.backend.ops_dump)
+            await self._send_json(writer, {"ok": d is not None,
+                                           "dump": d})
+        elif path == "/debug/capture":
+            self._require_method(method, "POST", path)
+            self._check_ops_token(headers)
+            got = await self._call(self.backend.arm_budgeted_capture,
+                                   "ops")
+            await self._send_json(writer, {"ok": got is not None,
+                                           "capture": got})
+        else:
+            raise protocol.ProtocolError(
+                404, "not_found", f"no ops route {path!r}")
+
+    async def _route_debug_journey(self, path: str, writer) -> None:
+        tail = path[len("/debug/journeys/"):]
+        try:
+            uid = int(tail)
+        except ValueError:
+            raise protocol.ProtocolError(
+                400, "bad_uid",
+                f"journey uid must be an int, got {tail!r}")
+        wire = self.wire_journey(uid)
+        fleet = await self._call(self.backend.request_journey, uid) \
+            if self._is_fleet else None
+        if wire is None and fleet is None:
+            raise protocol.ProtocolError(
+                404, "unknown_uid",
+                f"no journey recorded for uid {uid}")
+        await self._send_json(writer, {"uid": uid, "wire": wire,
+                                       "fleet": fleet})
+
+    # ---- ops probes (run on the engine thread) -----------------------
+    def _ops_anomalies(self) -> Dict:
+        summ = self.backend.anomaly_summary()
+        if summ is None:
+            return {"enabled": False}
+        return {"enabled": True, **summ}
+
+    def _anomaly_ring(self) -> Tuple[int, List[Dict]]:
+        """(total fires, full event ring) — the tail's polling read."""
+        if self._is_fleet:
+            ftel = self.backend._ftel
+            mon = None if ftel is None else ftel.monitor
+        else:
+            mon = self.backend._anom
+        if mon is None:
+            return 0, []
+        return mon.total(), [e.as_dict() for e in list(mon.events)]
+
+    def _ops_config(self) -> Dict:
+        from ..telemetry import config_fingerprint
+        be = self.backend
+        bcfg = be.cfg if self._is_fleet else be.icfg
+        gw = _jsonable(self.cfg)
+        # never serve the secret back over the surface it guards
+        gw["ops_token"] = "<set>" if self.cfg.ops_token else None
+        return {"fingerprint": config_fingerprint(),
+                "gateway": gw, "backend": _jsonable(bcfg),
+                "slo_classes": _jsonable(self._slo)}
+
+    async def _anomaly_tail(self, writer,
+                            limit_raw: Optional[str]) -> None:
+        """SSE live tail of anomaly fires (``GET /debug/anomalies?
+        tail``): replay the recent ring, then poll the monitor on the
+        engine thread and emit each new fire as one frame.  ``?tail=N``
+        closes after N frames (the deterministic form tests and
+        one-shot CLIs use); bare ``?tail`` follows until the client
+        disconnects or the gateway drains."""
+        limit: Optional[int] = None
+        if limit_raw:
+            try:
+                limit = max(int(limit_raw), 0)
+            except ValueError:
+                raise protocol.ProtocolError(
+                    400, "bad_tail", f"tail must be an int, "
+                    f"got {limit_raw!r}")
+        await self._send(writer, protocol.sse_head(), sse=True)
+        sent = 0
+        total, ring = await self._call(self._anomaly_ring)
+        try:
+            for ev in ring[-8:]:
+                if limit is not None and sent >= limit:
+                    break
+                await self._send(writer, protocol.sse_event(ev),
+                                 sse=True)
+                sent += 1
+            seen = total
+            while not (self._shutting or self._dead) \
+                    and (limit is None or sent < limit):
+                await asyncio.sleep(0.05)
+                total, ring = await self._call(self._anomaly_ring)
+                new = min(total - seen, len(ring))
+                seen = total
+                for ev in ring[len(ring) - new:] if new > 0 else ():
+                    if limit is not None and sent >= limit:
+                        break
+                    await self._send(writer, protocol.sse_event(ev),
+                                     sse=True)
+                    sent += 1
+            await self._send(writer, protocol.SSE_DONE, sse=True)
+        except (ConnectionError, OSError):
+            pass                 # tail reader went away — that's fine
 
     def _wire_depth(self) -> int:
         return sum(1 for s in self._streams.values() if not s.finished)
@@ -746,19 +959,14 @@ class Gateway:
                     f"uid {uid} is already known to the engine "
                     f"(status {st!r})")
         try:
-            if self._is_fleet:
-                # the fleet router routes the class itself too: a
-                # disaggregated fleet places interactive arrivals on
-                # the prefill pool and batch on decode (single engines
-                # don't take the kwarg — class already folded above)
-                verdict = await self._call(
-                    self.backend.put, uid, req.prompt,
-                    priority=priority, deadline_ms=deadline_ms,
-                    slo_class=cls)
-            else:
-                verdict = await self._call(
-                    self.backend.put, uid, req.prompt,
-                    priority=priority, deadline_ms=deadline_ms)
+            # both backends take the class: the fleet router routes by
+            # it (interactive arrivals land on the prefill pool, batch
+            # on decode) and either backend's SLO tracker evaluates
+            # the request under it (telemetry/slo.py)
+            verdict = await self._call(
+                self.backend.put, uid, req.prompt,
+                priority=priority, deadline_ms=deadline_ms,
+                slo_class=cls)
         except Exception:
             unreserve()
             raise

@@ -35,8 +35,9 @@ from ..comm.mesh import FSDP_AXIS, MeshTopology, TENSOR_AXIS
 from ..models.transformer import Model, TransformerConfig
 from ..telemetry import (AnomalyConfig, AnomalyMonitor, CounterDictView,
                          DeviceTelemetry, FlightRecorder, MetricsRegistry,
-                         ProfilerCapture, RequestTracker, SpanTracer,
-                         default_serving_detectors)
+                         ProfilerCapture, RequestTracker, SloObjective,
+                         SloTracker, SpanTracer, default_serving_detectors,
+                         default_slo_objectives)
 from ..utils.logging import logger
 from .failures import (FATAL_ENGINE, POISON_STEP,
                        DispatchTimeoutError, EngineDeadError,
@@ -283,6 +284,19 @@ class InferenceConfig:
     # the owning process's in-memory index (restart discards them)
     kv_tier_dir: Optional[str] = None
     kv_tier_nvme_mb: float = 256.0
+    # per-class SLO scorecard + error-budget burn-rate signals
+    # (telemetry/slo.py, docs/OBSERVABILITY.md "SLOs & error budgets"):
+    # "on" attaches an SloTracker to the request tracker's existing
+    # first-token / close-out stamp sites (zero new clock reads — the
+    # scorecard evaluates timestamps already on the record) and, when
+    # the anomaly plane is also on, registers the per-class
+    # ``slo_burn_rate_<class>`` burn detectors into its catalog (a
+    # burning budget breadcrumbs the flight recorder and arms a
+    # budgeted capture like any other anomaly).  Off constructs
+    # nothing; "auto" resolves OFF today.
+    slo: str = "auto"
+    # class -> SloObjective map; None = default_slo_objectives()
+    slo_objectives: Optional[Dict[str, "SloObjective"]] = None
 
 
 # attn-impl probe results, memoized per (backend, shape signature)
@@ -741,6 +755,27 @@ class InferenceEngine:
                 max_captures=self._acfg.max_captures)
             if self.icfg.profile_steps > 0:
                 self._cap.arm(self.icfg.profile_steps, "config")
+        # --- per-class SLO scorecard (telemetry/slo.py): None when off
+        # — the request tracker's hook sites are then a single
+        # attribute test (the zero-cost bar, extended by test); on, it
+        # rides the tracker's existing stamp sites (zero new clock
+        # reads) and registers its burn detectors into the anomaly
+        # catalog when that plane is also on
+        smode = self.icfg.slo
+        if smode not in ("auto", "on", "off"):
+            raise ValueError(f"slo={smode!r}: expected 'auto', 'on', "
+                             "or 'off'")
+        # "auto" resolves OFF today, like every telemetry gate here
+        self._slo = None
+        if smode == "on":
+            self._slo = SloTracker(
+                self.icfg.slo_objectives or default_slo_objectives(),
+                reg)
+            self.requests.slo = self._slo
+            if self._anom is not None:
+                self._slo.bind(self._anom,
+                               lambda: self._steps_done,
+                               self._on_anomaly)
 
     def _prefix_hit_rate(self):
         prompt = self.timings["prompt_tokens"]
@@ -804,6 +839,10 @@ class InferenceEngine:
             self._anom_prev.clear()
         if self._cap is not None:
             self._cap.reset_budget()
+        # rearm the SLO windows + burn detectors alongside the counters
+        # they quotient over (attainment restarts exact)
+        if self._slo is not None:
+            self._slo.reset()
 
     def device_snapshot(self) -> Optional[Dict]:
         """JSON-able device-telemetry summary (per-program cost
@@ -820,6 +859,18 @@ class InferenceEngine:
         if self._anom is None:
             return None
         return {**self._anom.summary(), "captures": self.capture_dirs}
+
+    def slo_scorecard(self) -> Dict:
+        """The per-class SLO scorecard (telemetry/slo.py,
+        docs/OBSERVABILITY.md "SLOs & error budgets"): per-objective
+        good/evaluated counter pairs with their attainment quotient,
+        the class error budget, and the burn detector's fast/slow
+        rates.  ``{"enabled": False}`` when ``InferenceConfig.slo``
+        resolves off — the shape the gateway's ``GET /debug/slo``
+        serves either way."""
+        if self._slo is None:
+            return {"enabled": False}
+        return self._slo.scorecard()
 
     @property
     def capture_dirs(self) -> List[str]:
@@ -848,6 +899,19 @@ class InferenceEngine:
                 "InferenceConfig.profile / FailureConfig.flight_dir")
         return cap.arm(steps or self._acfg.capture_steps, reason,
                        budgeted=False)
+
+    def arm_budgeted_capture(self, reason: str = "ops") -> Optional[str]:
+        """Arm a capture window under the SAME budget the anomaly path
+        uses (``AnomalyConfig.max_captures``, one window at a time) —
+        the form the gateway's ``POST /debug/capture`` rides, so a wire
+        client can never open an unbounded window.  Returns the capture
+        dir, or None when no directory is configured, the budget is
+        exhausted, or a window is already armed/active (all the quiet
+        degradations the anomaly path has)."""
+        cap = self._ensure_capture()
+        if cap is None:
+            return None
+        return cap.arm(self._acfg.capture_steps, reason, budgeted=True)
 
     def _ensure_capture(self, out_dir: Optional[str] = None):
         """The capture manager, constructed on first need from the
@@ -1582,7 +1646,8 @@ class InferenceEngine:
     # request API (reference: engine_v2.put :107)
     # ------------------------------------------------------------------
     def put(self, uid: int, tokens: Sequence[int], priority: int = 0,
-            deadline_ms: Optional[float] = None) -> AdmissionVerdict:
+            deadline_ms: Optional[float] = None,
+            slo_class: Optional[str] = None) -> AdmissionVerdict:
         """Enqueue a new request or continue a known one; returns an
         :class:`AdmissionVerdict` (truthy iff the tokens entered the
         engine) instead of growing the backlog unboundedly.
@@ -1593,22 +1658,28 @@ class InferenceEngine:
         status ``deadline_exceeded``.  Both only matter on the FIRST
         put for a uid; continuations keep the admitted values and are
         never shed (the request already holds KV or a queue place).
-        With the default :class:`OverloadConfig` (unbounded queue) the
-        verdict is always truthy — legacy callers that ignore the
-        return value see the legacy behavior."""
+        ``slo_class`` tags the lifecycle record with the class the
+        request was admitted under — pure attribution for the SLO
+        scorecard (telemetry/slo.py); it changes no admission or
+        scheduling decision here (class->priority/deadline folding is
+        the gateway's job, class->pool the fleet router's).  With the
+        default :class:`OverloadConfig` (unbounded queue) the verdict
+        is always truthy — legacy callers that ignore the return value
+        see the legacy behavior."""
         now = time.perf_counter()
         toks = [int(t) for t in tokens]
         if uid in self._meta or uid in self.state.seqs \
                 or uid in self._pending:
-            self.requests.on_arrival(uid, now)
+            self.requests.on_arrival(uid, now, slo_class=slo_class)
             self._pending.setdefault(uid, []).extend(toks)
             return AdmissionVerdict(True, "continued")
         if self._draining or self._health == "dead":
             # the drain/death contract: admission is stopped for NEW
             # requests (the continuation branch above still lands —
             # in-flight work must be able to finish); the record exists
-            # so the router sees shed-at-drain, not silence
-            self.requests.on_arrival(uid, now)
+            # so the router sees shed-at-drain, not silence (and the
+            # class tag keeps the shed attributable to its SLO budget)
+            self.requests.on_arrival(uid, now, slo_class=slo_class)
             self.requests.on_finish(uid, now, status="shed")
             return AdmissionVerdict(False, "shed",
                                     reason="engine is "
@@ -1635,7 +1706,7 @@ class InferenceEngine:
         if action == "shed":
             # terminal from birth: the record exists (the load harness
             # counts shed vs finished) but never holds KV or budget
-            self.requests.on_arrival(uid, now)
+            self.requests.on_arrival(uid, now, slo_class=slo_class)
             self.requests.on_finish(uid, now, status="shed")
             return AdmissionVerdict(False, "shed",
                                     reason="admission queue bound")
@@ -1650,7 +1721,7 @@ class InferenceEngine:
                                       degraded=(action == "degrade"))
         if deadline_ms is not None:
             self._deadline_uids.add(uid)
-        self.requests.on_arrival(uid, now)
+        self.requests.on_arrival(uid, now, slo_class=slo_class)
         self._pending.setdefault(uid, []).extend(toks)
         if self._spec is not None:
             # seed the prompt-lookup history with the prompt (emitted
@@ -2357,6 +2428,14 @@ class InferenceEngine:
                    else self.devtel.snapshot(),
                    "anomalies": self.anomaly_summary()})
 
+    def ops_dump(self) -> Optional[str]:
+        """The gateway ``POST /debug/dump`` seam: one flight-recorder
+        artifact into ``FailureConfig.flight_dir`` through the same
+        collision-safe writer the failure path uses.  Returns the
+        written path, or None when no flight_dir is configured — a
+        wire client can name neither the path nor the budget."""
+        return self._flight_autodump("ops")
+
     def debug_dump(self, path: Optional[str] = None,
                    reason: str = "debug") -> Dict:
         """On-demand flight-recorder snapshot (docs/OBSERVABILITY.md
@@ -2480,6 +2559,7 @@ class InferenceEngine:
             "deadline_ms": remaining,
             "preemptions": rec.preemptions if rec else 0,
             "retries": rec.retries if rec else 0,
+            "slo": rec.slo_class if rec else None,
             "exact": exact,
         }
 
@@ -2679,7 +2759,11 @@ class InferenceEngine:
         tm = self.timings
         for rec in snap["requests"]:
             uid = int(rec["uid"])
-            self.requests.on_arrival(uid, now)
+            # the class tag travels with the record, so a migrated /
+            # handed-off / restored request is still charged to its SLO
+            # budget on the replica that finishes it
+            self.requests.on_arrival(uid, now,
+                                     slo_class=rec.get("slo"))
             if not rec.get("exact", True) or not rec.get("tokens"):
                 # device-side tokens died with the old engine: the one
                 # honest outcome is terminal (and reaped, so drivers

@@ -54,6 +54,26 @@ _SIGNAL_POOL = {
     "failover_migration_storm": None,
 }
 
+# SLO burn signals (``slo_burn_rate_<class>``, telemetry/slo.py) map by
+# the burning class: interactive/standard budgets burn on TTFT — queue
+# admission pressure, a prefill problem; a batch budget burns on TPOT —
+# decode throughput.  Unknown classes lean prefill (admission is the
+# commonest bottleneck and a wrong lean is bounded by pool maximums).
+_SLO_BURN_POOL = {
+    "interactive": "prefill",
+    "standard": "prefill",
+    "batch": "decode",
+}
+_SLO_BURN_PREFIX = "slo_burn_rate_"
+
+
+def _signal_pool(sig: str) -> Optional[str]:
+    if sig in _SIGNAL_POOL:
+        return _SIGNAL_POOL[sig]
+    if sig.startswith(_SLO_BURN_PREFIX):
+        return _SLO_BURN_POOL.get(sig[len(_SLO_BURN_PREFIX):], "prefill")
+    return None
+
 
 @dataclasses.dataclass
 class AutoscalerConfig:
@@ -142,7 +162,7 @@ class Autoscaler:
             if total > self._last_anomalies:
                 veto = True
                 for sig in counts:
-                    p = _SIGNAL_POOL.get(sig)
+                    p = _signal_pool(sig)
                     if p is not None:
                         fired_pools.add(p)
             self._last_anomalies = total

@@ -55,6 +55,8 @@ from ..telemetry import (AnomalyConfig, AnomalyMonitor, MetricsRegistry,
 from ..telemetry.anomaly import (EwmaMadDetector,
                                  RollingPercentileDetector,
                                  ThresholdDetector)
+from ..telemetry.slo import (BurnRateDetector, SloObjective, SloTracker,
+                             default_slo_objectives)
 from ..telemetry.metrics import Histogram, _fmt, _prom_label_str, _prom_name
 from ..utils.logging import logger
 
@@ -120,6 +122,14 @@ class FleetTelemetryConfig:
     # where anomaly-armed replica captures land; None falls back to
     # FleetConfig.flight_dir (the post-mortem dir is a sensible home)
     capture_dir: Optional[str] = None
+    # fleet-level SLO burn detectors (telemetry/slo.py): the class ->
+    # SloObjective map the per-class ``slo_burn_rate_<class>`` signals
+    # normalise against.  None takes default_slo_objectives(); a fleet
+    # whose replicas run custom objectives should mirror them here.
+    # The signals only move when replicas export the serving_slo_*
+    # composite counters (InferenceConfig.slo on) — an all-off fleet
+    # feeds nothing.
+    slo_objectives: Optional[Dict[str, SloObjective]] = None
 
 
 def default_fleet_detectors(cfg: FleetTelemetryConfig) -> Dict[str, object]:
@@ -174,6 +184,13 @@ class FleetTelemetry:
         self._journeys: Dict[int, List[Dict[str, Any]]] = {}
         self._prev: Dict[str, float] = {}     # detector feed scratch
         self._storm: Deque[Tuple[int, int]] = deque()
+        # SLO burn-rate scratch: per-(replica, class) last-seen
+        # (good, evaluated) composite-counter readings, per-class
+        # per-replica bad tallies since the last fire (implication),
+        # and the set of lazily-watched burn signals
+        self._slo_prev: Dict[Tuple[str, str], Tuple[int, int]] = {}
+        self._slo_bad: Dict[str, Dict[str, int]] = {}
+        self._slo_signals: set = set()
         self._captures_used = 0
         # completed/armed anomaly captures: {signal, replica, dir, step}
         self.captures: List[Dict[str, Any]] = []
@@ -236,9 +253,12 @@ class FleetTelemetry:
             ev = mon.observe("placement_imbalance", mx / total, step)
             if ev is not None:
                 fired.append((ev, mx_name))
-        # affinity hit rate over this step's placements
-        placements = sum(
-            v for _, v in router._c_placements.series())
+        # per-class SLO error-budget burn (replica composite counters)
+        self._feed_slo_burn(router, step, fired)
+        # affinity hit rate over this step's placements (labeled
+        # counter: series_sum folds every policy= series)
+        placements = router.metrics.series_sum(
+            "serving_fleet_placements_total")
         hits = router._c_place_hits.value()
         dp = placements - prev.get("placements", 0)
         dh = hits - prev.get("hits", 0)
@@ -278,6 +298,99 @@ class FleetTelemetry:
                 fired.append((ev, hi_name))
         for ev, name in fired:
             self._on_anomaly(router, ev, name)
+
+    def _feed_slo_burn(self, router, step: int,
+                       fired: List[Tuple[object, Optional[str]]]) -> None:
+        """Fleet-level error-budget burn: diff each live replica's
+        ``serving_slo_*_total`` composite counters (objective=requests,
+        bumped by :class:`~..telemetry.slo.SloTracker` at request
+        close-out — counter reads only, no clocks) and replay the
+        deltas as per-request pass/fail bits through per-class
+        request-counted :class:`BurnRateDetector` windows.  A fire
+        implicates the replica that contributed the most bad requests
+        since the last fire (tie-break by name) so the capture lands
+        where the budget is burning."""
+        mon, prev = self.monitor, self._slo_prev
+        objs = self.cfg.slo_objectives or default_slo_objectives()
+        # per-class delta aggregation across live replicas
+        goods: Dict[str, int] = {}
+        bads: Dict[str, int] = {}
+        for name, rep in router._reps.items():
+            if rep.dead:
+                continue
+            m_good = rep.engine.metrics.get("serving_slo_good_total")
+            m_eval = rep.engine.metrics.get("serving_slo_evaluated_total")
+            if m_good is None or m_eval is None:
+                continue  # replica runs with SLO tracking off
+            for key, ev_v in m_eval.series():
+                labels = dict(key)
+                if labels.get("objective") != SloTracker.COMPOSITE:
+                    continue
+                cls = labels.get("class")
+                if cls is None:
+                    continue
+                good_v = m_good.value(**labels)
+                pg, pe = prev.get((name, cls), (0, 0))
+                dg = max(int(good_v) - pg, 0)
+                de = max(int(ev_v) - pe, 0)
+                prev[(name, cls)] = (int(good_v), int(ev_v))
+                if de <= 0:
+                    continue
+                db = max(de - dg, 0)
+                goods[cls] = goods.get(cls, 0) + (de - db)
+                bads[cls] = bads.get(cls, 0) + db
+                if db:
+                    tally = self._slo_bad.setdefault(cls, {})
+                    tally[name] = tally.get(name, 0) + db
+        for cls in sorted(set(goods) | set(bads)):
+            sig = f"slo_burn_rate_{cls}"
+            if sig not in self._slo_signals:
+                self._slo_signals.add(sig)
+                mon.watch(sig, BurnRateDetector.for_objective(
+                    objs.get(cls) or SloObjective()))
+            # goods first so a mixed step's bads land on the freshest
+            # window state (order within a step is not observable
+            # per-request; bads-last is the deterministic choice) —
+            # the detector's bit convention is 1.0 = VIOLATION
+            bits = [0.0] * goods.get(cls, 0) + [1.0] * bads.get(cls, 0)
+            for bit in bits:
+                ev = mon.observe(sig, bit, step)
+                if ev is not None:
+                    tally = self._slo_bad.pop(cls, {})
+                    impl = max(tally.items(),
+                               key=lambda kv: (kv[1], kv[0]),
+                               default=None)
+                    fired.append((ev, impl[0] if impl else None))
+
+    def ops_capture(self, router, reason: str = "ops",
+                    replica: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        """Operator-requested budgeted capture (the gateway's
+        ``POST /debug/capture``): same budget + directory rules as an
+        anomaly-armed capture, aimed at ``replica`` (default: the
+        busiest routable one).  Returns ``{replica, dir}`` or ``None``
+        when the budget is spent / nowhere to write / no target."""
+        name = replica
+        if name is None or name not in router._reps \
+                or router._reps[name].dead:
+            live = [(rep.load(), n) for n, rep in router._reps.items()
+                    if rep.routable()]
+            if not live:
+                return None
+            name = max(live)[1]
+        if self._captures_used >= self.cfg.max_captures:
+            return None
+        d = self.cfg.capture_dir or router.cfg.flight_dir
+        if not d:
+            return None
+        got = router._reps[name].engine.capture(
+            steps=self.cfg.capture_steps, reason=reason,
+            out_dir=os.path.join(d, "captures", name))
+        if got is None:
+            return None
+        self._captures_used += 1
+        self.captures.append({"signal": reason, "replica": name,
+                              "dir": got, "step": int(router._steps)})
+        return {"replica": name, "dir": got}
 
     def _on_anomaly(self, router, ev, replica: Optional[str]) -> None:
         """One fired fleet detector: breadcrumb the router's flight
@@ -329,6 +442,8 @@ class FleetTelemetry:
         self.monitor.reset()
         self._prev.clear()
         self._storm.clear()
+        self._slo_prev.clear()
+        self._slo_bad.clear()
         self._captures_used = 0
         self.captures.clear()
         self._journeys.clear()

@@ -63,7 +63,8 @@ from ..inference.engine import InferenceEngine
 from ..inference.failures import EngineDeadError
 from ..inference.overload import AdmissionVerdict
 from ..inference.ragged.state import iter_prefix_chain_digests
-from ..telemetry import FlightRecorder, MetricsRegistry, config_fingerprint
+from ..telemetry import (FlightRecorder, MetricsRegistry,
+                         config_fingerprint, merge_scorecards)
 from ..utils.logging import logger
 from .fleet_telemetry import (FLEET_DUMP_VERSION, NOOP_CTX, FleetRegistry,
                               FleetTelemetry, FleetTelemetryConfig,
@@ -309,7 +310,7 @@ class FleetRouter:
                      "before the first placement)")
 
     def _placement_hit_rate(self) -> Optional[float]:
-        total = sum(v for _, v in self._c_placements.series())
+        total = self.metrics.series_sum("serving_fleet_placements_total")
         if not total:
             return None
         return self._c_place_hits.value() / total
@@ -544,7 +545,8 @@ class FleetRouter:
         if owner is not None:
             v = self._reps[owner].engine.put(uid, tokens,
                                              priority=priority,
-                                             deadline_ms=deadline_ms)
+                                             deadline_ms=deadline_ms,
+                                             slo_class=slo_class)
             return v._replace(replica=owner)
         for m in self._migrations:
             if m.rec["uid"] == uid:
@@ -568,7 +570,8 @@ class FleetRouter:
             for name in order:
                 v = self._reps[name].engine.put(uid, tokens,
                                                 priority=priority,
-                                                deadline_ms=deadline_ms)
+                                                deadline_ms=deadline_ms,
+                                                slo_class=slo_class)
                 for eu in v.evicted_uids:
                     # evict-lowest backpressure shed a queued request on
                     # that replica: terminal at the fleet level too
@@ -1399,6 +1402,42 @@ class FleetRouter:
         if self._ftel is None:
             return None
         return self._ftel.summary()
+
+    def slo_scorecard(self) -> Dict:
+        """The FLEET SLO scorecard (telemetry/slo.py): per-replica
+        engine scorecards merged by ``merge_scorecards`` — counter
+        pairs sum (the fleet attainment is the quotient of the summed
+        exported counters, exactly what the ``serving_fleet_slo_*``
+        rollups scrape), burn rates take the worst replica.  Replicas
+        with SLO tracking off merge as disabled; an all-off fleet
+        reports ``{"enabled": False}``."""
+        return merge_scorecards(
+            {name: rep.engine.slo_scorecard()
+             for name, rep in self._reps.items()})
+
+    def arm_budgeted_capture(self, reason: str = "ops",
+                             replica: Optional[str] = None
+                             ) -> Optional[Dict]:
+        """Arm ONE budgeted capture window through the fleet-telemetry
+        capture budget (``FleetTelemetryConfig.max_captures`` — the
+        same budget anomaly-armed captures draw from), on ``replica``
+        or the busiest routable one.  The gateway ``POST
+        /debug/capture`` seam: returns ``{"replica", "dir"}`` or None
+        when telemetry is off, the budget is exhausted, no directory
+        is configured, or no replica can take the window."""
+        if self._ftel is None:
+            return None
+        return self._ftel.ops_capture(self, reason=reason,
+                                      replica=replica)
+
+    def ops_dump(self) -> Optional[str]:
+        """The gateway ``POST /debug/dump`` seam: one budgeted fleet
+        bundle through the ``_autodump`` path (``FleetConfig.
+        max_autodumps`` per router generation, into ``flight_dir``).
+        Returns the bundle directory, or None when the budget is
+        exhausted or no flight_dir is configured — a wire client can
+        name neither the path nor the budget."""
+        return self._autodump("ops")
 
     def reset_metrics(self) -> None:
         """Reset the ROUTER-side telemetry: fleet counters/gauges, the

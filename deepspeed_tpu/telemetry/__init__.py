@@ -28,6 +28,8 @@ from .anomaly import (AnomalyConfig, AnomalyEvent, AnomalyMonitor,
                       ThresholdDetector, default_serving_detectors,
                       default_training_detectors)
 from .profiler import ProfilerCapture, profiler_available
+from .slo import (BurnRateDetector, DEFAULT_SLO_CLASS, SloObjective,
+                  SloTracker, default_slo_objectives, merge_scorecards)
 
 __all__ = ["SpanTracer", "MetricsRegistry", "Counter", "Gauge", "FnGauge",
            "Histogram", "CounterDictView", "parse_prometheus_text",
@@ -40,4 +42,6 @@ __all__ = ["SpanTracer", "MetricsRegistry", "Counter", "Gauge", "FnGauge",
            "EwmaMadDetector", "RollingPercentileDetector",
            "ThresholdDetector", "default_serving_detectors",
            "default_training_detectors", "ProfilerCapture",
-           "profiler_available"]
+           "profiler_available", "SloObjective", "SloTracker",
+           "BurnRateDetector", "DEFAULT_SLO_CLASS",
+           "default_slo_objectives", "merge_scorecards"]

@@ -117,6 +117,10 @@ class RequestRecord:
     # (ROADMAP item 4) needs to drive spec_decode="auto" from data.
     drafted_tokens: int = 0
     accepted_tokens: int = 0
+    # SLO class the request was admitted under (gateway header / fleet
+    # routing) — the key the scorecard evaluates it by; None = the
+    # tracker's default class (telemetry/slo.py)
+    slo_class: Optional[str] = None
 
     @property
     def queue_wait_ms(self) -> Optional[float]:
@@ -165,6 +169,7 @@ class RequestRecord:
                            ("e2e_ms", self.e2e_ms))}
         ar = self.acceptance_rate
         return {"uid": self.uid,
+                "slo_class": self.slo_class,
                 "prompt_tokens": self.prompt_tokens,
                 "cached_tokens": self.cached_tokens,
                 "generated_tokens": self.generated_tokens,
@@ -185,6 +190,13 @@ class RequestTracker:
     def __init__(self, registry: MetricsRegistry,
                  max_finished: int = 4096):
         self.registry = registry
+        # optional SloTracker sink (telemetry/slo.py), attached by the
+        # engine when InferenceConfig.slo resolves ON.  None = SLO
+        # tracking off: the two hook sites below are a single attribute
+        # test — the zero-cost-off bar.  When attached, both hooks
+        # evaluate from timestamps ALREADY stamped on the record (zero
+        # new clock reads on the hot path).
+        self.slo = None
         self.open: Dict[int, RequestRecord] = {}  # tpulint: live-set
         self.finished: Deque[RequestRecord] = deque(maxlen=max_finished)
         self._h_ttft = registry.histogram(
@@ -245,13 +257,18 @@ class RequestTracker:
     # ------------------------------------------------------------------
     # lifecycle events (all O(1) dict/float work)
     # ------------------------------------------------------------------
-    def on_arrival(self, uid: int,
-                   now: Optional[float] = None) -> RequestRecord:
+    def on_arrival(self, uid: int, now: Optional[float] = None,
+                   slo_class: Optional[str] = None) -> RequestRecord:
         rec = self.open.get(uid)
         if rec is not None:
-            return rec                       # continuation put
+            # continuation put: a late class tag fills the blank, but
+            # never overwrites the class the request arrived under
+            if slo_class is not None and rec.slo_class is None:
+                rec.slo_class = slo_class
+            return rec
         rec = RequestRecord(uid, now if now is not None
-                            else time.perf_counter())
+                            else time.perf_counter(),
+                            slo_class=slo_class)
         self.open[uid] = rec
         self._forgotten.pop(uid, None)       # the uid lives again
         self._c_arrived.inc()
@@ -288,6 +305,10 @@ class RequestTracker:
             rec.t_tail_start = t_dispatch \
                 if (t_dispatch is not None and n > 1) else now
             self._h_ttft.observe((now - rec.t_arrival) * 1e3)
+            if self.slo is not None:
+                # same statement the TTFT histogram observes at —
+                # the scorecard reads the stamps just stored
+                self.slo.on_first_token(rec)
         rec.t_last_token = now
         rec.generated_tokens += n
 
@@ -340,6 +361,10 @@ class RequestTracker:
             self._h_tpot.observe(tpot)
         self._c_finished.inc()
         self._c_terminal.inc(status=status)
+        if self.slo is not None:
+            # terminal close-out: the record carries every timestamp
+            # the scorecard needs — no clock is read here
+            self.slo.on_close(rec)
         if len(self.finished) == self.finished.maxlen:
             old = self.finished[0]          # about to be ring-evicted
             self._status_refs[old.uid] -= 1

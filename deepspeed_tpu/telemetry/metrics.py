@@ -304,6 +304,23 @@ class MetricsRegistry:
     def get(self, name: str) -> Optional[Metric]:
         return self._metrics.get(name)
 
+    def series_sum(self, name: str) -> float:
+        """Sum of a metric's value across ALL its label sets — the
+        scalar a dashboard wants from a labeled counter (e.g. total
+        scale-ups regardless of ``pool=``).  0.0 for an unknown name or
+        an empty metric; histograms sum their observation totals (the
+        ``_count`` a Prometheus ``sum()`` over buckets would yield)."""
+        m = self._metrics.get(name)
+        if m is None:
+            return 0.0
+        if isinstance(m, Histogram):
+            return float(sum(m._totals.values()))
+        if not m._values and not isinstance(m, FnGauge):
+            # Metric.series() yields a synthetic ((), 0.0) placeholder
+            # for empty metrics; the SUM of nothing is a plain 0.0
+            return 0.0
+        return float(sum(v for _, v in m.series()))
+
     def __iter__(self) -> Iterator[Metric]:
         return iter(self._metrics.values())
 

@@ -20,8 +20,9 @@ from tools.benchdiff import (compare, diff_files, main,  # noqa: E402
 
 def test_smoke_is_the_acceptance_check():
     out = smoke()
-    assert out["ok"] and len(out["checks"]) == 8
+    assert out["ok"] and len(out["checks"]) == 9
     assert "anomaly_delta_reports_not_gates" in out["checks"]
+    assert "slo_delta_reports_not_gates" in out["checks"]
 
 
 def test_anomaly_deltas_report_only():

@@ -698,7 +698,8 @@ class _StubEngine:
         self.metrics = MetricsRegistry()
         self.timings = {"step_retries": 0, "steps": 0}
 
-    def put(self, uid, tokens, priority=0, deadline_ms=None):
+    def put(self, uid, tokens, priority=0, deadline_ms=None,
+            slo_class=None):
         self._pending[uid] = list(tokens)
         return self._verdict
 

@@ -560,3 +560,175 @@ def test_refuses_to_start_on_dead_engine(model):
     eng._health = "dead"
     with pytest.raises(GatewayError, match="DEAD"):
         spawn_gateway(eng, GatewayConfig())
+
+
+# --------------------------------------------------------------------------
+# the ops plane: /debug/* gating, token auth, budgets
+# (docs/OBSERVABILITY.md "SLOs & error budgets")
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ops_gw(model, tmp_path_factory):
+    """A gateway with the ops plane ON and a token configured, over an
+    SLO-tracking engine with a flight dir (dump + capture budgets are
+    real)."""
+    from deepspeed_tpu.inference import FailureConfig
+
+    d = tmp_path_factory.mktemp("ops_plane")
+    eng, _ = build_engine(model=model, slo="on", anomaly="on",
+                          failure=FailureConfig(flight_dir=str(d)))
+    h = spawn_gateway(eng, GatewayConfig(ops="on", ops_token="s3cret"))
+    yield h, eng
+    h.stop()
+
+
+def _post(h, path, token=None):
+    from tools.loadgen import http_post
+    headers = {"x-ops-token": token} if token is not None else {}
+    return http_post(h.host, h.port, path, headers=headers)
+
+
+def test_ops_default_off_whole_surface_404s(gw):
+    """ops='auto' resolves OFF: every /debug/* path — reads AND
+    mutators, known and unknown — 404s exactly like an absent route
+    (no probe-able difference)."""
+    h, _ = gw
+    for path in ("/debug/slo", "/debug/anomalies", "/debug/config",
+                 "/debug/journeys/1", "/debug/nope"):
+        code, _, body = http_get(h.host, h.port, path)
+        assert code == 404, (path, code)
+        assert json.loads(body)["error"]["code"] == "not_found"
+    from tools.loadgen import http_post
+    code, _, body = http_post(h.host, h.port, "/debug/dump",
+                              headers={"x-ops-token": "anything"})
+    assert code == 404
+    assert json.loads(body)["error"]["code"] == "not_found"
+
+
+def test_ops_invalid_value_rejected(model):
+    eng, _ = build_engine(model=model)
+    with pytest.raises(GatewayError, match="ops="):
+        spawn_gateway(eng, GatewayConfig(ops="sometimes"))
+
+
+def test_ops_unknown_debug_route_404(ops_gw):
+    h, _ = ops_gw
+    code, _, body = http_get(h.host, h.port, "/debug/nope")
+    assert code == 404
+    assert json.loads(body)["error"]["code"] == "not_found"
+
+
+def test_ops_wrong_method_405(ops_gw):
+    h, _ = ops_gw
+    code, _, body = _post(h, "/debug/slo", token="s3cret")
+    assert code == 405
+    code, _, body = http_get(h.host, h.port, "/debug/dump")
+    assert code == 405
+    assert json.loads(body)["error"]["code"] == "method_not_allowed"
+
+
+def test_ops_mutator_auth_ladder(ops_gw):
+    """Missing header -> 401; wrong token -> 403; both refused BEFORE
+    any backend touch."""
+    h, _ = ops_gw
+    code, _, body = _post(h, "/debug/dump")
+    assert code == 401
+    assert json.loads(body)["error"]["code"] == "missing_ops_token"
+    code, _, body = _post(h, "/debug/capture", token="wrong")
+    assert code == 403
+    assert json.loads(body)["error"]["code"] == "bad_ops_token"
+
+
+def test_ops_mutators_disabled_without_configured_token(model):
+    """ops='on' with no ops_token: reads serve, mutators are 403 even
+    with a (necessarily wrong) token — a deployment opts into remote
+    dump/capture explicitly."""
+    eng, _ = build_engine(model=model, slo="on")
+    h = spawn_gateway(eng, GatewayConfig(ops="on"))
+    try:
+        code, _, _ = http_get(h.host, h.port, "/debug/slo")
+        assert code == 200
+        code, _, body = _post(h, "/debug/dump", token="guess")
+        assert code == 403
+        assert json.loads(body)["error"]["code"] == \
+            "ops_mutations_disabled"
+    finally:
+        h.stop()
+
+
+def test_ops_slo_scorecard_matches_backend(ops_gw):
+    h, eng = ops_gw
+    http_completion(h.host, h.port, {"prompt": [3, 4, 5],
+                                     "max_tokens": 2}, slo="interactive")
+    code, _, body = http_get(h.host, h.port, "/debug/slo")
+    assert code == 200
+    assert json.loads(body) == json.loads(
+        json.dumps(eng.slo_scorecard()))
+    assert json.loads(body)["enabled"] is True
+
+
+def test_ops_journey_routes(ops_gw):
+    h, _ = ops_gw
+    r = http_completion(h.host, h.port, {"uid": 4100,
+                                         "prompt": [9, 8, 7],
+                                         "max_tokens": 2})
+    assert r["code"] == 200
+    code, _, body = http_get(h.host, h.port, "/debug/journeys/4100")
+    assert code == 200
+    j = json.loads(body)
+    phases = [e["phase"] for e in j["wire"]]
+    assert phases[0] == "received" and "closed" in phases
+    assert j["fleet"] is None          # engine backend: no fleet leg
+    code, _, body = http_get(h.host, h.port, "/debug/journeys/abc")
+    assert code == 400
+    assert json.loads(body)["error"]["code"] == "bad_uid"
+    code, _, body = http_get(h.host, h.port, "/debug/journeys/999999")
+    assert code == 404
+    assert json.loads(body)["error"]["code"] == "unknown_uid"
+
+
+def test_ops_anomalies_and_config(ops_gw):
+    h, eng = ops_gw
+    code, _, body = http_get(h.host, h.port, "/debug/anomalies")
+    assert code == 200
+    summ = json.loads(body)
+    assert summ["enabled"] is True and "by_signal" in summ
+    code, _, body = http_get(h.host, h.port, "/debug/config")
+    assert code == 200
+    cfgd = json.loads(body)
+    assert cfgd["fingerprint"]
+    # the secret never round-trips over the surface it guards
+    assert cfgd["gateway"]["ops_token"] == "<set>"
+    assert "s3cret" not in body.decode("utf-8")
+    assert cfgd["backend"]["slo"] == "on"
+
+
+def test_ops_anomaly_tail_closes_deterministically(ops_gw):
+    h, _ = ops_gw
+    code, headers, body = http_get(h.host, h.port,
+                                   "/debug/anomalies?tail=0")
+    assert code == 200
+    assert headers["content-type"].startswith("text/event-stream")
+    assert body == protocol.SSE_DONE
+    code, _, body = http_get(h.host, h.port,
+                             "/debug/anomalies?tail=x")
+    assert code == 400
+    assert json.loads(body)["error"]["code"] == "bad_tail"
+
+
+def test_ops_mutators_respect_budgets(ops_gw):
+    """POST /debug/dump writes one bundle; POST /debug/capture arms one
+    window and a second POST while it is armed reports ok=False — a
+    wire client can never open an unbounded window."""
+    h, eng = ops_gw
+    code, _, body = _post(h, "/debug/dump", token="s3cret")
+    assert code == 200
+    d = json.loads(body)
+    assert d["ok"] is True and d["dump"]
+    code, _, body = _post(h, "/debug/capture", token="s3cret")
+    assert code == 200
+    first = json.loads(body)
+    assert first["ok"] is True and first["capture"]
+    code, _, body = _post(h, "/debug/capture", token="s3cret")
+    assert code == 200
+    assert json.loads(body) == {"ok": False, "capture": None}

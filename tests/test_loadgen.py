@@ -19,8 +19,9 @@ from tools.loadgen import (Fault, Request, build_engine, chaos_smoke,
                            default_faults, fleet_chaos_smoke,
                            http_chaos_smoke, http_smoke,
                            make_mixed_slo_trace, make_trace,
-                           replay, run_sweep, scale_chaos_smoke, smoke,
-                           summarize, tier_chaos_smoke)
+                           replay, run_sweep, scale_chaos_smoke,
+                           slo_burn_smoke, smoke, summarize,
+                           tier_chaos_smoke)
 
 
 def test_make_trace_deterministic():
@@ -421,3 +422,44 @@ def test_http_chaos_drain_contract(http_chaos_out):
     assert out["checks"]["drain_backend_drained"]
     assert out["drain"]["late"]["code"] == 503
     assert all(r == "length" for r in out["drain"]["inflight"].values())
+
+
+@pytest.fixture(scope="module")
+def slo_burn_out():
+    """One SLO burn-rate drill shared by the assertions below,
+    identical to ``python -m tools.loadgen --slo-burn``."""
+    return slo_burn_smoke(seed=0)
+
+
+def test_slo_burn_smoke_is_the_slo_acceptance_check(slo_burn_out):
+    """The SLO acceptance bar (docs/OBSERVABILITY.md "SLOs & error
+    budgets"): a latency-spike fault concentrated on ``interactive``
+    traffic burns that class's TTFT budget fast enough to trip the
+    multi-window burn-rate detector — which fires ONLY after the
+    spike, leaves a ``fleet_anomaly`` breadcrumb in the flight
+    recorder, and arms a budgeted deep capture on the implicated
+    replica that runs to completion."""
+    out = slo_burn_out
+    assert out["ok"] and all(out["checks"].values()), out["checks"]
+    assert out["fires"] >= 1
+    json.dumps(out)
+
+
+def test_slo_burn_charges_only_the_burning_class(slo_burn_out):
+    """Per-class budget isolation: the batch class rode the same fleet
+    through the same spike but its scorecard is untouched — exact
+    good==evaluated parity, zero budget consumed, zero burn rate."""
+    out = slo_burn_out
+    assert out["checks"]["batch_parity_exact"]
+    card = out["scorecard"]["classes"]
+    assert card["interactive"]["error_budget"]["consumed_bad"] >= 10
+    assert card["batch"]["error_budget"]["consumed_bad"] == 0
+    assert card["batch"]["burn_rate"]["fast"] == 0.0
+
+
+def test_slo_burn_scorecard_serves_over_the_wire(slo_burn_out):
+    """The ops plane serves the SAME truth: ``GET /debug/slo`` and
+    ``GET /debug/journeys/{uid}`` round-tripped through a loopback
+    gateway match the in-process scorecard/journey exactly."""
+    assert slo_burn_out["checks"]["debug_slo_matches"]
+    assert slo_burn_out["checks"]["debug_journey_matches"]

@@ -1,0 +1,396 @@
+"""Per-class SLO scorecard + error-budget burn-rate signals
+(docs/OBSERVABILITY.md "SLOs & error budgets"): SloObjective
+validation, the deterministic multi-window BurnRateDetector, the
+SloTracker's evaluation semantics (attainment == the exported counter
+quotient by construction; hop closures skipped; shed/failed charged to
+availability), scorecard/merge shapes, the engine gate
+(InferenceConfig.slo), and the ZERO-COST bars: off constructs nothing,
+and ON adds no perf_counter reads to a warm serving step."""
+
+import json
+import time
+from types import SimpleNamespace
+
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.inference import (InferenceConfig, InferenceEngine,
+                                     SamplingParams)
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.telemetry import (BurnRateDetector, MetricsRegistry,
+                                     SloObjective, SloTracker,
+                                     default_slo_objectives,
+                                     merge_scorecards)
+from deepspeed_tpu.telemetry.slo import DEFAULT_SLO_CLASS
+
+
+def tiny_model(**over):
+    kw = dict(vocab_size=128, num_layers=2, d_model=64, num_heads=4,
+              num_kv_heads=2, d_ff=128, max_seq_len=128)
+    kw.update(over)
+    return build_model("llama-tiny", **kw)
+
+
+def make_engine(m, **over):
+    kw = dict(token_budget=32, max_seqs=4, kv_block_size=16,
+              num_kv_blocks=64, kv_dtype=jnp.float32,
+              param_dtype=jnp.float32)
+    kw.update(over)
+    return InferenceEngine(m, InferenceConfig(**kw))
+
+
+@pytest.fixture(scope="module")
+def model():
+    return tiny_model()
+
+
+def run_requests(eng, *uids, max_new=2):
+    """Drive every uid to a terminal close the way the loadgen harness
+    does: unbounded sampling, each emitted token fed back via ``put``,
+    the caller flushing after ``max_new`` tokens."""
+    sp = SamplingParams(temperature=0.0, max_new_tokens=1 << 30)
+    remaining = {u: max_new for u in uids}
+    for _ in range(64):
+        for uid, tok in eng.step(sampling=sp).items():
+            if uid not in remaining:
+                continue
+            remaining[uid] -= 1
+            if remaining[uid] <= 0:
+                del remaining[uid]
+                eng.flush(uid)
+            else:
+                eng.put(uid, [int(tok)])
+        if not remaining and all(
+                eng.query(u)["status"] not in ("queued", "running")
+                for u in uids):
+            return
+    raise AssertionError("requests failed to close")
+
+
+def rec(status="finished", slo_class=None, ttft_ms=None, tpot_ms=None,
+        e2e_ms=None):
+    """A record stub carrying exactly the attributes the tracker
+    evaluates — the tracker must read stamps already on the record,
+    never a clock."""
+    return SimpleNamespace(status=status, slo_class=slo_class,
+                           ttft_ms=ttft_ms, tpot_ms=tpot_ms,
+                           e2e_ms=e2e_ms)
+
+
+# --------------------------------------------------------------------------
+# SloObjective validation
+# --------------------------------------------------------------------------
+
+class TestSloObjective:
+    def test_defaults_valid(self):
+        SloObjective()
+        for obj in default_slo_objectives().values():
+            assert 0.0 < obj.target < 1.0
+
+    @pytest.mark.parametrize("kw", [
+        {"target": 0.0}, {"target": 1.0}, {"availability": 0.0},
+        {"availability": 1.5}, {"window": 0}, {"fast_window": 0},
+        {"fast_window": 64, "slow_window": 32}, {"ttft_ms": 0.0},
+        {"tpot_ms": -1.0}, {"e2e_ms": 0.0}, {"fast_burn": 0.0},
+        {"slow_burn": -2.0},
+    ])
+    def test_rejects_bad_values(self, kw):
+        with pytest.raises(ValueError):
+            SloObjective(**kw)
+
+
+# --------------------------------------------------------------------------
+# BurnRateDetector: deterministic multi-window burn
+# --------------------------------------------------------------------------
+
+class TestBurnRateDetector:
+    def test_no_fire_until_fast_window_full(self):
+        det = BurnRateDetector(target=0.95, fast_window=4,
+                               slow_window=8, fast_burn=10.0,
+                               slow_burn=5.0)
+        # 3 straight violations: over budget but the window isn't full
+        for _ in range(3):
+            assert det.observe(1.0) is None
+        fired = det.observe(1.0)          # 4th fills the window
+        assert fired is not None
+        budget, fast = fired
+        assert budget == pytest.approx(0.05)
+        assert fast == pytest.approx(1.0 / 0.05)  # all-bad window
+
+    def test_needs_both_windows_over(self):
+        # slow window long enough that early goods hold the slow rate
+        # under threshold even when the fast window is all-bad
+        det = BurnRateDetector(target=0.5, fast_window=2,
+                               slow_window=8, fast_burn=1.5,
+                               slow_burn=1.5)
+        for _ in range(6):
+            assert det.observe(0.0) is None
+        assert det.observe(1.0) is None   # slow 1/7 -> burn 0.29 < 1.5
+        assert det.observe(1.0) is None   # fast 2.0 but slow 2/8 = 0.5
+        # keep burning: slow catches up and both cross
+        fired = None
+        for _ in range(8):
+            fired = det.observe(1.0) or fired
+        assert fired is not None
+
+    def test_rates_and_reset(self):
+        det = BurnRateDetector(target=0.9, fast_window=2, slow_window=4)
+        det.observe(1.0)
+        det.observe(0.0)
+        assert det.fast_rate == pytest.approx(0.5 / 0.1)
+        assert det.slow_rate == pytest.approx(0.5 / 0.1)
+        det.reset()
+        assert det.fast_rate == 0.0 and det.slow_rate == 0.0
+
+    def test_for_objective_copies_knobs(self):
+        obj = SloObjective(target=0.8, fast_window=3, slow_window=9,
+                           fast_burn=2.0, slow_burn=1.5)
+        det = BurnRateDetector.for_objective(obj)
+        assert det.target == 0.8
+        assert det._fast.maxlen == 3 and det._slow.maxlen == 9
+        assert det.fast_burn == 2.0 and det.slow_burn == 1.5
+
+    def test_replay_deterministic(self):
+        bits = [1.0, 0.0, 1.0, 1.0, 1.0, 0.0, 1.0, 1.0] * 4
+        def run():
+            det = BurnRateDetector(target=0.9, fast_window=4,
+                                   slow_window=8, fast_burn=5.0,
+                                   slow_burn=3.0)
+            return [det.observe(b) for b in bits]
+        assert run() == run()
+
+
+# --------------------------------------------------------------------------
+# SloTracker semantics
+# --------------------------------------------------------------------------
+
+def make_tracker(**objectives):
+    reg = MetricsRegistry()
+    objs = objectives or {
+        "interactive": SloObjective(ttft_ms=100.0, tpot_ms=50.0,
+                                    e2e_ms=1000.0),
+        "standard": SloObjective(e2e_ms=5000.0),
+    }
+    return SloTracker(objs, reg, default_class="standard"), reg
+
+
+class TestSloTracker:
+    def test_needs_objectives(self):
+        with pytest.raises(ValueError):
+            SloTracker({}, MetricsRegistry())
+
+    def test_attainment_is_counter_quotient(self):
+        tr, reg = make_tracker()
+        tr.on_close(rec(slo_class="interactive", ttft_ms=50.0,
+                        tpot_ms=10.0, e2e_ms=500.0))
+        tr.on_close(rec(slo_class="interactive", ttft_ms=500.0,
+                        tpot_ms=10.0, e2e_ms=500.0))   # ttft violation
+        labels = {"class": "interactive", "objective": "requests"}
+        good = reg.get("serving_slo_good_total").value(**labels)
+        total = reg.get("serving_slo_evaluated_total").value(**labels)
+        assert (good, total) == (1, 2)
+        card = tr.scorecard()
+        comp = card["classes"]["interactive"]["objectives"]["requests"]
+        assert comp["good"] == 1 and comp["evaluated"] == 2
+        assert comp["attainment"] == pytest.approx(good / total)
+
+    def test_untagged_record_uses_default_class(self):
+        tr, _ = make_tracker()
+        tr.on_close(rec(e2e_ms=100.0))
+        card = tr.scorecard()
+        assert card["default_class"] == "standard"
+        assert card["classes"]["standard"]["error_budget"][
+            "evaluated"] == 1
+        assert card["classes"]["interactive"]["error_budget"][
+            "evaluated"] == 0
+
+    def test_unknown_class_not_evaluated(self):
+        tr, reg = make_tracker()
+        tr.on_close(rec(slo_class="mystery", e2e_ms=1.0))
+        assert reg.series_sum("serving_slo_evaluated_total") == 0
+
+    def test_hop_closures_skipped(self):
+        tr, reg = make_tracker()
+        for status in ("migrated", "handed_off"):
+            tr.on_close(rec(status=status, slo_class="standard",
+                            e2e_ms=1.0))
+        assert reg.series_sum("serving_slo_evaluated_total") == 0
+
+    def test_shed_and_failed_charge_availability(self):
+        tr, _ = make_tracker()
+        tr.on_close(rec(status="shed", slo_class="standard"))
+        tr.on_close(rec(status="failed", slo_class="standard"))
+        tr.on_close(rec(status="finished", slo_class="standard",
+                        e2e_ms=1.0))
+        objs = tr.scorecard()["classes"]["standard"]["objectives"]
+        assert objs["availability"]["good"] == 1
+        assert objs["availability"]["evaluated"] == 3
+        assert objs["requests"]["good"] == 1
+
+    def test_deadline_exceeded_is_bad(self):
+        tr, _ = make_tracker()
+        tr.on_close(rec(status="deadline_exceeded",
+                        slo_class="standard"))
+        objs = tr.scorecard()["classes"]["standard"]["objectives"]
+        # a deadline miss is still AVAILABLE (the engine answered) but
+        # fails the deadline objective and the composite
+        assert objs["availability"]["good"] == 1
+        assert objs["deadline"]["good"] == 0
+        assert objs["requests"]["good"] == 0
+
+    def test_first_token_evaluates_ttft_only(self):
+        tr, reg = make_tracker()
+        tr.on_first_token(rec(slo_class="interactive", ttft_ms=60.0))
+        labels = {"class": "interactive", "objective": "ttft"}
+        assert reg.get("serving_slo_good_total").value(**labels) == 1
+        # ttft is not part of standard's contract: no evaluation
+        tr.on_first_token(rec(slo_class="standard", ttft_ms=60.0))
+        assert reg.series_sum("serving_slo_evaluated_total") == 1
+
+    def test_error_budget_math(self):
+        tr, _ = make_tracker(cls=SloObjective(e2e_ms=100.0, target=0.9))
+        for i in range(10):
+            tr.on_close(rec(slo_class="cls",
+                            e2e_ms=50.0 if i < 8 else 500.0))
+        eb = tr.scorecard()["classes"]["cls"]["error_budget"]
+        assert eb["evaluated"] == 10
+        assert eb["allowed_bad"] == pytest.approx(1.0)
+        assert eb["consumed_bad"] == 2
+        assert eb["remaining"] == pytest.approx(-1.0)
+        assert eb["burn_total"] == pytest.approx(2.0)
+
+    def test_scorecard_json_able_and_reset(self):
+        tr, reg = make_tracker()
+        tr.on_close(rec(slo_class="interactive", ttft_ms=500.0,
+                        tpot_ms=10.0, e2e_ms=500.0))
+        card = tr.scorecard()
+        assert json.loads(json.dumps(card)) == card
+        assert card["enabled"] is True
+        br = card["classes"]["interactive"]["burn_rate"]
+        assert br["fast"] > 0.0
+        tr.reset()
+        reg.reset()
+        card2 = tr.scorecard()
+        assert card2["classes"]["interactive"]["burn_rate"]["fast"] == 0.0
+        assert card2["classes"]["interactive"]["error_budget"][
+            "evaluated"] == 0
+
+
+# --------------------------------------------------------------------------
+# merge_scorecards (the fleet rollup)
+# --------------------------------------------------------------------------
+
+class TestMergeScorecards:
+    def test_all_disabled(self):
+        merged = merge_scorecards({"r0": {"enabled": False},
+                                   "r1": {"enabled": False}})
+        assert merged == {"enabled": False, "replicas": ["r0", "r1"]}
+
+    def test_counters_sum_and_burn_maxes(self):
+        def one(goods, bads, fast):
+            tr, _ = make_tracker(cls=SloObjective(e2e_ms=100.0,
+                                                  target=0.9))
+            for _ in range(goods):
+                tr.on_close(rec(slo_class="cls", e2e_ms=1.0))
+            for _ in range(bads):
+                tr.on_close(rec(slo_class="cls", e2e_ms=900.0))
+            card = tr.scorecard()
+            card["classes"]["cls"]["burn_rate"]["fast"] = fast
+            return card
+
+        merged = merge_scorecards({"r0": one(3, 1, 2.5),
+                                   "r1": one(5, 0, 0.5),
+                                   "off": {"enabled": False}})
+        assert merged["enabled"] is True
+        cls = merged["classes"]["cls"]
+        comp = cls["objectives"]["requests"]
+        assert comp["good"] == 8 and comp["evaluated"] == 9
+        assert comp["attainment"] == pytest.approx(round(8 / 9, 4))
+        assert cls["error_budget"]["consumed_bad"] == 1
+        assert cls["burn_rate"]["fast"] == 2.5
+        assert set(merged["replicas"]) == {"r0", "r1", "off"}
+
+
+# --------------------------------------------------------------------------
+# the engine gate + the zero-cost bars
+# --------------------------------------------------------------------------
+
+class TestEngineGate:
+    def test_auto_resolves_off(self, model):
+        eng = make_engine(model)
+        assert eng._slo is None
+        assert eng.slo_scorecard() == {"enabled": False}
+        assert eng.requests.slo is None
+        assert eng.metrics.get("serving_slo_good_total") is None
+
+    def test_invalid_value_rejected(self, model):
+        with pytest.raises(ValueError, match="slo="):
+            make_engine(model, slo="maybe")
+
+    def test_off_never_observes(self, model, monkeypatch):
+        def forbidden(*a, **k):
+            raise AssertionError("SLO hook ran with slo off")
+        monkeypatch.setattr(SloTracker, "on_first_token", forbidden)
+        monkeypatch.setattr(SloTracker, "on_close", forbidden)
+        eng = make_engine(model)
+        eng.put(0, list(range(1, 9)))
+        run_requests(eng, 0)
+
+    def test_on_attributes_class_and_counts(self, model):
+        eng = make_engine(model, slo="on")
+        eng.put(0, list(range(1, 9)), slo_class="interactive")
+        eng.put(1, list(range(1, 9)))            # -> default class
+        run_requests(eng, 0, 1)
+        card = eng.slo_scorecard()
+        assert card["enabled"] is True
+        comp_i = card["classes"]["interactive"]["objectives"]["requests"]
+        comp_d = card["classes"][DEFAULT_SLO_CLASS]["objectives"][
+            "requests"]
+        assert comp_i["evaluated"] == 1
+        assert comp_d["evaluated"] == 1
+        # exported pair agrees with the card (the dashboard quotient)
+        labels = {"class": "interactive", "objective": "requests"}
+        assert eng.metrics.get("serving_slo_evaluated_total").value(
+            **labels) == 1
+
+    def test_on_adds_no_clock_reads_per_warm_step(self, model):
+        """InferenceConfig.slo='on' must add ZERO perf_counter reads to
+        a warm serving step relative to 'off' — the tracker evaluates
+        timestamps the lifecycle tracker already stamped (the ISSUE's
+        acceptance bar, counted the same way the device-telemetry bar
+        is)."""
+        sp = SamplingParams(temperature=0.0, max_new_tokens=1 << 30)
+        counts = {}
+        for mode in ("off", "on"):
+            eng = make_engine(model, slo=mode)
+            eng.put(0, list(range(1, 9)))
+            while True:                          # warm to first token
+                if 0 in eng.step(sampling=sp):
+                    break
+            eng.put(1, [5])
+            real = time.perf_counter
+            n = [0]
+
+            def counting():
+                n[0] += 1
+                return real()
+            time.perf_counter = counting
+            try:
+                eng.step(sampling=sp)
+            finally:
+                time.perf_counter = real
+            counts[mode] = n[0]
+        assert counts["on"] == counts["off"], counts
+
+    def test_reset_metrics_rearms(self, model):
+        eng = make_engine(model, slo="on")
+        eng.put(0, list(range(1, 9)), slo_class="interactive")
+        run_requests(eng, 0)
+        assert eng.slo_scorecard()["classes"]["interactive"][
+            "error_budget"]["evaluated"] == 1
+        eng.reset_metrics()
+        card = eng.slo_scorecard()
+        assert card["classes"]["interactive"]["error_budget"][
+            "evaluated"] == 0
+        for cls in card["classes"].values():
+            assert cls["burn_rate"]["fast"] == 0.0

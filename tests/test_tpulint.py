@@ -333,6 +333,21 @@ def test_mutation_deleted_allocator_free_is_caught(tmp_path):
     assert f.end_line is not None      # points back at the ledger decl
 
 
+def test_mutation_deleted_slo_eval_bump_is_caught(tmp_path):
+    """Delete the evaluated-side increment from ``SloTracker._observe``
+    (the ONE paired-counter site the scorecard's "attainment == counter
+    quotient by construction" claim rests on): ``_c_good`` then bumps
+    without its declared pair ``_c_eval`` — counter-pairing must see
+    the severed ``# tpulint: pair=_c_good/_c_eval`` contract."""
+    findings = _mutate_and_lint(
+        tmp_path, "deepspeed_tpu/telemetry/slo.py",
+        "self._c_eval.inc(**labels)",
+        "counter-pairing")
+    assert len(findings) == 1, [f.human() for f in findings]
+    f = findings[0]
+    assert "_c_good" in f.message and "_c_eval" in f.message
+    assert f.end_line is not None      # points back at the pair decl
+
 # --------------------------------------------------------------------------
 # pass 1: module/symbol table + call graph
 # --------------------------------------------------------------------------

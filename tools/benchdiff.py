@@ -149,6 +149,37 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
             continue
         if (o or 0) != (n or 0):
             anomaly_deltas.append({"metric": k, "old": o, "new": n})
+    # SLO scorecard deltas (``<leg>_slo`` subtrees, the scorecard
+    # bench legs embed): per-class composite attainment and remaining
+    # error budget — REPORTED, never gated, exactly like the anomaly
+    # deltas (attainment moves with rig noise; a class suddenly
+    # burning its budget is reviewer material next to a green diff)
+    slo_deltas: List[Dict[str, Any]] = []
+    for k in sorted(set(old) | set(new)):
+        if not k.endswith("_slo"):
+            continue
+        ov, nv = old.get(k), new.get(k)
+        ocl = ov.get("classes") if isinstance(ov, dict) else None
+        ncl = nv.get("classes") if isinstance(nv, dict) else None
+        ocl = ocl if isinstance(ocl, dict) else {}
+        ncl = ncl if isinstance(ncl, dict) else {}
+        for cls in sorted(set(ocl) | set(ncl)):
+            for path, leaf in ((("objectives", "requests", "attainment"),
+                                "attainment"),
+                               (("error_budget", "remaining"),
+                                "budget_remaining")):
+                def _dig(tree):
+                    node = tree.get(cls)
+                    for part in path:
+                        if not isinstance(node, dict):
+                            return None
+                        node = node.get(part)
+                    return node
+                o, n = _dig(ocl), _dig(ncl)
+                if o != n:
+                    slo_deltas.append(
+                        {"metric": f"{k}.{cls}.{leaf}",
+                         "old": o, "new": n})
     return {
         "fingerprint_match": match,
         "old_fingerprint": {"config_hash": old_fp[0],
@@ -164,6 +195,7 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
         "only_new": only_new,
         "anomaly_deltas": anomaly_deltas,
         "fleet_anomaly_deltas": fleet_anomaly_deltas,
+        "slo_deltas": slo_deltas,
         "ok": match is False or not regressions,
     }
 
@@ -198,6 +230,9 @@ def _render(v: Dict[str, Any]) -> str:
                      f"{e['new']} (report-only, never gates)")
     for e in v.get("fleet_anomaly_deltas", []):
         lines.append(f"  fleet-anom {e['metric']}: {e['old']} -> "
+                     f"{e['new']} (report-only, never gates)")
+    for e in v.get("slo_deltas", []):
+        lines.append(f"  slo        {e['metric']}: {e['old']} -> "
                      f"{e['new']} (report-only, never gates)")
     lines.append(f"  unchanged: {v['unchanged']}, "
                  f"new-only legs: {len(v['only_new'])}")
@@ -284,6 +319,25 @@ def smoke() -> Dict[str, Any]:
     assert v_fl["anomaly_deltas"] == [], v_fl  # not double-reported
     assert compare(fl_base, fl_base)["fleet_anomaly_deltas"] == []
 
+    # SLO scorecard deltas (``<leg>_slo``): per-class composite
+    # attainment and budget drops REPORT under slo_deltas and CANNOT
+    # fail a run even under a matching fingerprint
+    def _card(att, remaining):
+        return {"enabled": True, "classes": {"interactive": {
+            "objectives": {"requests": {"attainment": att,
+                                        "target": 0.95}},
+            "error_budget": {"remaining": remaining}}}}
+    slo_base = dict(base, serving_slo=_card(1.0, 25))
+    slo_new = dict(base, serving_slo=_card(0.5, 0))
+    v_slo = compare(slo_base, slo_new)
+    assert v_slo["ok"], v_slo                  # reports, never gates
+    assert v_slo["slo_deltas"] == [
+        {"metric": "serving_slo.interactive.attainment",
+         "old": 1.0, "new": 0.5},
+        {"metric": "serving_slo.interactive.budget_remaining",
+         "old": 25, "new": 0}], v_slo
+    assert compare(slo_base, slo_base)["slo_deltas"] == []
+
     return {"ok": True,
             "checks": ["enforced_regression_fails",
                        "latency_regression_fails",
@@ -292,7 +346,8 @@ def smoke() -> Dict[str, Any]:
                        "dropped_leg_fails",
                        "within_threshold_passes",
                        "anomaly_delta_reports_not_gates",
-                       "fleet_anomaly_delta_reports_not_gates"]}
+                       "fleet_anomaly_delta_reports_not_gates",
+                       "slo_delta_reports_not_gates"]}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

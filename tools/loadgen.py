@@ -422,6 +422,9 @@ def summarize(eng, res: Dict, trace: List[Request]) -> Dict:
         "statuses": statuses,
         "anomalies": None if anom is None else {
             "total": anom["total"], "by_signal": anom["by_signal"]},
+        # per-class SLO attainment + budget burn (telemetry/slo.py) —
+        # None while InferenceConfig.slo is off
+        "slo": slo_columns(eng.slo_scorecard()),
         "preemptions": rm["aggregate"]["preemptions"],
         "open_records": rm["aggregate"]["open"],
         "parity": parity,
@@ -439,6 +442,30 @@ def by_pri(trace: List[Request], uid: int) -> int:
         if q.uid == uid:
             return q.priority
     return 0
+
+
+def slo_columns(card: Optional[Dict]) -> Optional[Dict]:
+    """Per-class attainment + error-budget-burn columns for one
+    SLO-curve row, flattened from an ``slo_scorecard()`` dict
+    (telemetry/slo.py) — None while SLO tracking is off, so the rows
+    stay schema-stable either way."""
+    if not card or not card.get("enabled"):
+        return None
+    out = {}
+    for cls, entry in sorted(card["classes"].items()):
+        eb = entry["error_budget"]
+        br = entry["burn_rate"]
+        composite = entry["objectives"].get("requests", {})
+        out[cls] = {
+            "attainment": composite.get("attainment"),
+            "target": eb["target"],
+            "evaluated": eb["evaluated"],
+            "budget_remaining": eb["remaining"],
+            "budget_burn": eb["burn_total"],
+            "burn_fast": br["fast"],
+            "burn_slow": br["slow"],
+        }
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -476,12 +503,13 @@ def run_sweep(qps_list: Sequence[float], n_requests: int = 32,
     from deepspeed_tpu.inference.overload import OverloadConfig
 
     if eng is None:
-        # anomaly detectors ride every sweep leg, so the SLO curves
-        # carry per-QPS anomaly counts next to their latency numbers
+        # anomaly detectors + the SLO tracker ride every sweep leg, so
+        # the SLO curves carry per-QPS anomaly counts and per-class
+        # attainment/budget-burn columns next to their latency numbers
         # (reset_metrics between legs rearms baselines + counters)
         eng, _ = build_engine(OverloadConfig(
             max_queued_requests=2 * 4, shed_policy=shed_policy,
-            prefill_chunk=8, aging_ms=200.0), anomaly="on")
+            prefill_chunk=8, aging_ms=200.0), anomaly="on", slo="on")
     legs = {}
     uid0 = 0
     for qps in qps_list:
@@ -2081,6 +2109,29 @@ def http_get(host: str, port: int, path: str,
     return code, headers, body
 
 
+def http_post(host: str, port: int, path: str,
+              payload: Optional[Dict] = None,
+              headers: Optional[Dict[str, str]] = None,
+              timeout: float = 30.0) -> Tuple[int, Dict[str, str], bytes]:
+    """One blocking non-streaming POST (the ops-plane mutators —
+    ``headers`` carries ``x-ops-token``)."""
+    import socket
+
+    body = json.dumps(payload or {}).encode("utf-8")
+    extra = "".join(f"{k}: {v}\r\n"
+                    for k, v in (headers or {}).items())
+    with socket.create_connection((host, port), timeout=timeout) as sock:
+        sock.sendall((f"POST {path} HTTP/1.1\r\nHost: loadgen\r\n"
+                      f"Content-Type: application/json\r\n"
+                      f"Content-Length: {len(body)}\r\n{extra}"
+                      "Connection: close\r\n\r\n").encode("ascii") + body)
+        f = sock.makefile("rb")
+        code, resp_headers = _http_read_head(f)
+        resp_body = f.read()
+        f.close()
+    return code, resp_headers, resp_body
+
+
 def http_completion(host: str, port: int, payload: Dict,
                     slo: Optional[str] = None, timeout: float = 120.0,
                     disconnect_after: Optional[int] = None) -> Dict:
@@ -2252,10 +2303,13 @@ def replay_http(host: str, port: int, trace: List[Request],
     }
 
 
-def summarize_http(res: Dict, trace: List[Request]) -> Dict:
+def summarize_http(res: Dict, trace: List[Request],
+                   scorecard: Optional[Dict] = None) -> Dict:
     """The same SLO-curve shape :func:`summarize` emits, from wire
     measurements — so in-process and over-HTTP legs are directly
-    comparable columns in the BENCH JSON."""
+    comparable columns in the BENCH JSON.  ``scorecard`` (the backend's
+    ``slo_scorecard()``) adds the same per-class attainment/budget-burn
+    columns the in-process rows carry."""
     statuses: Dict[str, int] = {}
     for s in res["statuses"].values():
         statuses[s] = statuses.get(s, 0) + 1
@@ -2270,6 +2324,7 @@ def summarize_http(res: Dict, trace: List[Request]) -> Dict:
         "goodput_tok_s": round(n_tok / max(res["wall_s"], 1e-9), 2),
         "ttft_ms_p50": _pct(ttft, 50), "ttft_ms_p95": _pct(ttft, 95),
         "tpot_ms_p50": _pct(tpot, 50), "tpot_ms_p95": _pct(tpot, 95),
+        "slo": slo_columns(scorecard),
     }
 
 
@@ -2301,7 +2356,11 @@ def http_smoke(seed: int = 0) -> Dict:
       gateway's per-pump invariant checks armed the whole run;
     * ``/healthz`` serves the health ladder and ``/metrics`` parses
       with the existing Prometheus parser, gateway counters present
-      and consistent with the traffic."""
+      and consistent with the traffic;
+    * the ops plane round-trips: ``GET /debug/slo`` and
+      ``GET /debug/journeys/{uid}`` over loopback HTTP equal the
+      in-process ``slo_scorecard()`` / ``wire_journey()`` truth
+      EXACTLY (the wire is a serializer, never a second computation)."""
     import jax
 
     from deepspeed_tpu.inference import SamplingParams
@@ -2323,11 +2382,20 @@ def http_smoke(seed: int = 0) -> Dict:
         eng_ref, model = build_engine(model=model)
         ref = replay(eng_ref, trace, [], sampling=sp, rng=rng)
         h, eng, model = _spawn_http_gateway(model=model, sampling=sp,
-                                            seed=gw_seed)
+                                            seed=gw_seed, slo="on",
+                                            gateway_kw={"ops": "on"})
         res = replay_http(h.host, h.port, trace, step_ms=5.0)
         hz_code, _, hz_body = http_get(h.host, h.port, "/healthz")
         m_code, _, m_body = http_get(h.host, h.port, "/metrics")
         metrics = parse_prometheus_text(m_body.decode("utf-8"))
+        # ops-plane round-trip while the gateway is still up: the wire
+        # bodies must equal the in-process truth exactly (the replay is
+        # over, so nothing moves between the two reads)
+        slo_code, _, slo_body = http_get(h.host, h.port, "/debug/slo")
+        j_uid = trace[0].uid
+        j_code, _, j_body = http_get(h.host, h.port,
+                                     f"/debug/journeys/{j_uid}")
+        card = eng.slo_scorecard()
         h.stop()
         eng.state.allocator.assert_invariants()
         agg = eng.request_metrics()["aggregate"]
@@ -2345,7 +2413,14 @@ def http_smoke(seed: int = 0) -> Dict:
         checks[f"{mode}_metrics"] = m_code == 200 \
             and streams is not None \
             and sum(streams["samples"].values()) >= len(trace)
-        out["variants"][mode] = summarize_http(res, trace)
+        checks[f"{mode}_debug_slo"] = slo_code == 200 \
+            and card.get("enabled") is True \
+            and json.loads(slo_body) == json.loads(json.dumps(card))
+        checks[f"{mode}_debug_journey"] = j_code == 200 \
+            and json.loads(j_body)["wire"] == json.loads(
+                json.dumps(h.gateway.wire_journey(j_uid)))
+        out["variants"][mode] = summarize_http(res, trace,
+                                               scorecard=card)
     out["checks"] = checks
     out["ok"] = all(checks.values())
     if not out["ok"]:
@@ -2501,6 +2576,162 @@ def http_chaos_smoke(seed: int = 0) -> Dict:
     return out
 
 
+def slo_burn_smoke(seed: int = 0) -> Dict:
+    """Tier-1 SLO error-budget burn drill (docs/OBSERVABILITY.md "SLOs
+    & error budgets"): an injected ``latency_spike`` host stall burns
+    the INTERACTIVE class's error budget end-to-end on a 2-replica
+    fleet.  Asserts:
+
+    * the fleet ``slo_burn_rate_interactive`` detector fires (multi-
+      window: fast AND slow over budget) and only after the spike;
+    * the fire breadcrumbs the router's flight recorder and a budgeted
+      capture COMPLETES on the implicated replica (the one that closed
+      the most burning requests);
+    * ``GET /debug/slo`` and ``GET /debug/journeys/{uid}`` over
+      loopback HTTP equal the in-process scorecard / journey exactly;
+    * the unaffected BATCH class's parity is exact: every batch
+      request evaluated good, burn rates pinned at zero.
+    """
+    import tempfile
+
+    from deepspeed_tpu.gateway import GatewayConfig, spawn_gateway
+    from deepspeed_tpu.inference import FailureConfig, SamplingParams
+    from deepspeed_tpu.serving import FleetConfig
+    from deepspeed_tpu.serving.fleet_telemetry import FleetTelemetryConfig
+    from deepspeed_tpu.telemetry import SloObjective
+
+    # tight drill objectives: the interactive TTFT bar sits well above
+    # a warm step's wall TTFT but far below the injected stall, so the
+    # spike-window arrivals are exactly the budget burners; batch gets
+    # a bar nothing here can miss.  Small burn windows so the drill's
+    # ~10 burning requests fill the fast window.
+    objectives = {
+        "interactive": SloObjective(ttft_ms=150.0, target=0.95,
+                                    fast_window=8, slow_window=16),
+        "batch": SloObjective(e2e_ms=600_000.0, target=0.9,
+                              fast_window=8, slow_window=16),
+        "standard": SloObjective(e2e_ms=600_000.0, target=0.9,
+                                 fast_window=8, slow_window=16),
+    }
+    capdir = tempfile.mkdtemp(prefix="slo_burn_")
+    router, model = build_fleet(
+        2,
+        fleet_cfg=FleetConfig(
+            telemetry="on", flight_dir=capdir,
+            telemetry_cfg=FleetTelemetryConfig(
+                capture_dir=capdir, capture_steps=2,
+                slo_objectives=objectives)),
+        slo="on", slo_objectives=objectives,
+        failure=FailureConfig(dispatch_timeout_ms=None))
+    sp = SamplingParams(max_new_tokens=1 << 30)
+
+    r = np.random.RandomState(seed + 71)
+
+    def mk(uid, step, slo, max_new=3):
+        return Request(uid=uid, step=step,
+                       prompt=[int(x) for x in r.randint(1, 120, 6)],
+                       priority=0 if slo == "interactive" else 2,
+                       max_new=max_new, slo=slo)
+
+    # warm both replicas' program buckets outside the drill, then
+    # reset BOTH sides' telemetry (replica registries + tracker
+    # windows, fleet detectors + scratch) so compile time never reads
+    # as a burning budget
+    warm = [mk(6900 + i, i % 2, "interactive") for i in range(4)]
+    replay_fleet(router, warm, [], sampling=sp)
+    for n in router.replica_names:
+        router.replica(n).engine.reset_metrics()
+    router.reset_metrics()
+
+    spike_step = 6
+    trace = (
+        # pre-spike context: honest-TTFT goods in both classes
+        [mk(7000 + i, i, "interactive") for i in range(4)]
+        + [mk(7100 + i, i, "batch", max_new=4) for i in range(4)]
+        # the burn cluster: arrivals AT the spike step — first tokens
+        # land behind the stall, TTFT >= the spike >> the 150 ms bar.
+        # Staggered max_new spreads the close-outs over ~4 steps so the
+        # fire's budgeted capture window (capture_steps=2) COMPLETES
+        # while the tail of the cluster is still generating
+        + [mk(7200 + i, spike_step, "interactive", max_new=2 + i % 4)
+           for i in range(10)]
+        # a standard-class tail trickling in AFTER the spike keeps both
+        # replicas stepping past the fire so the capture window closes;
+        # standard is not parity-asserted, so the tail is inert
+        + [mk(7300 + i, spike_step + 2 + 2 * i, "standard", max_new=4)
+           for i in range(6)]
+    )
+    res = replay_fleet(router, trace,
+                       [Fault("latency_spike", step=spike_step,
+                              ms=500.0)],
+                       sampling=sp, check_invariants=True)
+
+    checks: Dict[str, bool] = {}
+    checks["all_finished"] = all(s == "finished"
+                                 for s in res["status"].values())
+    mon = router._ftel.monitor
+    checks["burn_fired"] = mon.counts.get(
+        "slo_burn_rate_interactive", 0) >= 1
+    fires = [e for e in mon.events
+             if e.signal == "slo_burn_rate_interactive"]
+    checks["burn_after_spike"] = bool(fires) and all(
+        e.step >= spike_step for e in fires)
+    # breadcrumb + budgeted capture on the implicated replica
+    crumbs = [e for e in router.flight.events()
+              if e.get("kind") == "fleet_anomaly"
+              and e.get("signal") == "slo_burn_rate_interactive"]
+    checks["flight_breadcrumb"] = len(crumbs) >= 1
+    caps = [c for c in router._ftel.captures
+            if c["signal"] == "slo_burn_rate_interactive"]
+    checks["capture_on_implicated"] = bool(crumbs) and bool(caps) \
+        and caps[0]["replica"] == crumbs[0].get("replica")
+    checks["capture_completed"] = bool(caps) and any(
+        caps[0]["dir"] in router.replica(c["replica"]).engine.capture_dirs
+        for c in caps)
+    # scorecard truth: interactive burned, batch untouched (parity
+    # EXACT — the per-class counters are independent by construction)
+    card = router.slo_scorecard()
+    inter = card["classes"]["interactive"]
+    batch = card["classes"]["batch"]
+    checks["interactive_burned"] = \
+        inter["error_budget"]["consumed_bad"] >= 10
+    checks["batch_parity_exact"] = (
+        batch["objectives"]["requests"]["good"] == 4
+        and batch["objectives"]["requests"]["evaluated"] == 4
+        and batch["error_budget"]["consumed_bad"] == 0
+        and batch["burn_rate"]["fast"] == 0.0
+        and batch["burn_rate"]["slow"] == 0.0)
+
+    # wire reads over a gateway fronting the SAME router: the bodies
+    # must equal the in-process truth exactly (the replay is over —
+    # the gateway's idle pumping moves no SLO state)
+    h = spawn_gateway(router, GatewayConfig(ops="on"))
+    slo_code, _, slo_body = http_get(h.host, h.port, "/debug/slo")
+    j_uid = 7200
+    j_code, _, j_body = http_get(h.host, h.port,
+                                 f"/debug/journeys/{j_uid}")
+    h.stop()
+    checks["debug_slo_matches"] = slo_code == 200 \
+        and json.loads(slo_body) == json.loads(
+            json.dumps(router.slo_scorecard()))
+    checks["debug_journey_matches"] = j_code == 200 \
+        and json.loads(j_body)["fleet"] == json.loads(
+            json.dumps(router.request_journey(j_uid)))
+
+    out = {
+        "seed": seed, "spike_step": spike_step,
+        "fires": mon.counts.get("slo_burn_rate_interactive", 0),
+        "captures": caps, "slo": slo_columns(card),
+        "scorecard": card,
+        "checks": checks, "ok": all(checks.values()),
+    }
+    if not out["ok"]:
+        raise AssertionError(
+            "slo burn drill failed: "
+            f"{json.dumps({k: v for k, v in checks.items() if not v})}")
+    return out
+
+
 def http_bench(seed: int = 0, n_requests: int = 16) -> Dict:
     """The BENCH sockets leg: one seeded bursty trace through (a) the
     in-process ``replay`` driver and (b) real loopback sockets against
@@ -2611,6 +2842,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--http-chaos", action="store_true",
                     help="wire chaos: mid-stream client disconnects "
                     "(engine-side cancel) + SIGTERM-style drain")
+    ap.add_argument("--slo-burn", action="store_true",
+                    help="SLO error-budget burn drill: a latency spike "
+                    "burns the interactive budget, the burn-rate "
+                    "anomaly fires + captures, /debug/slo matches "
+                    "in-process truth, unaffected classes stay exact")
     ap.add_argument("--http-bench", action="store_true",
                     help="in-process vs over-HTTP SLO curves with the "
                     "measured wire overhead ratio")
@@ -2642,6 +2878,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         result = http_smoke(args.seed)
     elif args.http_chaos:
         result = http_chaos_smoke(args.seed)
+    elif args.slo_burn:
+        result = slo_burn_smoke(args.seed)
     elif args.http_bench:
         result = http_bench(args.seed)
     elif args.chaos:
